@@ -1,0 +1,35 @@
+"""keystone_tpu — a TPU-native ML pipeline framework.
+
+A from-scratch rebuild of the capabilities of KeystoneML
+(stephentu/keystone, the AMPLab Scala/Spark pipeline framework) on
+JAX/XLA/Pallas.  Composable Transformer/Estimator pipelines for classical
+large-scale ML: dense image features (SIFT/LCS/DAISY, Fisher vectors,
+random-patch convolutions), random-feature and n-gram featurization, and
+distributed linear/kernel solvers (block least squares, weighted block LS,
+L-BFGS, kernel ridge regression).
+
+Architecture (see SURVEY.md for the reference layer map):
+
+  - ``keystone_tpu.parallel``  — device mesh, shardings, collectives
+    (replaces Spark treeReduce/broadcast: reference src/main/scala layer L0).
+  - ``keystone_tpu.workflow``  — Transformer/Estimator/Pipeline DSL, DAG,
+    executor, whole-pipeline optimizer (reference workflow/ layer L3).
+  - ``keystone_tpu.models``    — learning nodes / solvers (reference
+    nodes/learning/ layer L4).
+  - ``keystone_tpu.ops``       — feature ops: images, stats, nlp, util
+    (reference nodes/{images,stats,nlp,misc,util}/ layer L4).
+  - ``keystone_tpu.loaders``   — dataset loaders (reference loaders/ L2).
+  - ``keystone_tpu.evaluation``— evaluators (reference evaluation/ L5).
+  - ``keystone_tpu.pipelines`` — example applications (reference
+    pipelines/ L6).
+  - ``keystone_tpu.utils``     — image types, matrix helpers, stats.
+"""
+
+__version__ = "0.1.0"
+
+from keystone_tpu.workflow import (  # noqa: F401
+    Transformer,
+    Estimator,
+    LabelEstimator,
+    Pipeline,
+)

@@ -1,0 +1,59 @@
+"""Collective helpers — the treeReduce/treeAggregate replacements.
+
+The reference's only "collectives" are Spark ``treeReduce``/``treeAggregate``
+(logarithmic aggregation of per-partition Gramians / gradients / moments to
+the driver) and ``broadcast`` (SURVEY.md §2.9).  Here:
+
+  - Inside ``shard_map``-decorated code, :func:`psum` is a literal
+    all-reduce over ICI.
+  - In jit-with-sharding code, :func:`sharded_gram` / :func:`sharded_matmul`
+    express the per-partition-gemm + treeReduce pair as one einsum whose
+    contraction over the row-sharded axis XLA lowers to a
+    reduce-scatter/all-reduce — the idiomatic TPU form of call stack
+    SURVEY.md §3.2.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.parallel import mesh as _mesh
+
+
+def psum(x, axis_name: str = _mesh.DATA_AXIS):
+    """All-reduce sum over a mesh axis (use inside shard_map/pmap)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str = _mesh.DATA_AXIS):
+    return lax.pmean(x, axis_name)
+
+
+def tree_psum(tree, axis_name: str = _mesh.DATA_AXIS):
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def sharded_matmul(a, b, out_spec: Optional[P] = None, mesh=None):
+    """``a.T @ b`` with rows of a/b sharded over 'data'.
+
+    This is the single communication pattern behind every reference solver
+    (per-partition ``AᵀB`` gemm + treeReduce; e.g.
+    nodes/learning/LinearMapper.scala § LinearMapEstimator): contraction
+    over the sharded row axis; XLA inserts the all-reduce.  The result is
+    constrained replicated (or ``out_spec``) — the broadcast analogue.
+    """
+    mesh = mesh or _mesh.current_mesh()
+    out = jnp.matmul(a.T, b, preferred_element_type=jnp.float32)
+    return lax.with_sharding_constraint(
+        out, NamedSharding(mesh, out_spec if out_spec is not None else P())
+    )
+
+
+def sharded_gram(a, mesh=None):
+    """``a.T @ a`` (Gramian) over row-sharded ``a``, replicated result."""
+    return sharded_matmul(a, a, mesh=mesh)

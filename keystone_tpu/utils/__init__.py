@@ -1,0 +1,16 @@
+from keystone_tpu.utils.image import (  # noqa: F401
+    Image,
+    ImageMetadata,
+    image_from_array,
+)
+from keystone_tpu.utils.matrix import (  # noqa: F401
+    rows_to_matrix,
+    matrix_to_rows,
+    shuffle_rows,
+)
+from keystone_tpu.utils.stats import (  # noqa: F401
+    about_eq,
+    rand_matrix_cauchy,
+    rand_matrix_gaussian,
+    rand_matrix_uniform,
+)

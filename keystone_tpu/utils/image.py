@@ -1,0 +1,75 @@
+"""Image representation.
+
+The reference carries three flat-``Array[Double]`` image classes with
+different memory orders, chosen per-op for cache locality
+(utils/Image.scala § ChannelMajorArrayVectorizedImage,
+ColumnMajorArrayVectorizedImage, RowMajorArrayVectorizedImage).  On TPU
+the memory-order menagerie is pointless: XLA owns layout.  An image is a
+dense ``(H, W, C)`` float array (NHWC when batched, the TPU-friendly conv
+layout), and `Image` is a thin metadata-carrying wrapper used at pipeline
+boundaries; all compute ops take/return bare arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageMetadata:
+    """Dimensions record (utils/Image.scala § ImageMetadata)."""
+
+    x_dim: int  # height
+    y_dim: int  # width
+    num_channels: int
+
+    @property
+    def shape(self):
+        return (self.x_dim, self.y_dim, self.num_channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class Image:
+    """An (H, W, C) image; ``data`` is a jnp/np array."""
+
+    data: jnp.ndarray
+
+    @property
+    def metadata(self) -> ImageMetadata:
+        h, w, c = self.data.shape
+        return ImageMetadata(h, w, c)
+
+    def get(self, x: int, y: int, c: int):
+        return self.data[x, y, c]
+
+    def to_vector(self) -> jnp.ndarray:
+        return self.data.reshape(-1)
+
+
+def image_from_array(arr) -> Image:
+    arr = jnp.asarray(arr)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.ndim != 3:
+        raise ValueError(f"expected (H,W[,C]) array, got shape {arr.shape}")
+    return Image(arr)
+
+
+def grayscale(images: jnp.ndarray) -> jnp.ndarray:
+    """Luminance conversion for batched NHWC images (utils/ImageUtils.scala).
+
+    Uses the same equal-weight channel mean the reference's GrayScaler
+    applies (it averages channels rather than using Rec.601 weights).
+    """
+    if images.shape[-1] == 1:
+        return images[..., 0]
+    return jnp.mean(images, axis=-1)
+
+
+def to_numpy(img) -> np.ndarray:
+    if isinstance(img, Image):
+        return np.asarray(img.data)
+    return np.asarray(img)

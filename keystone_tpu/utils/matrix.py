@@ -1,0 +1,44 @@
+"""Matrix helpers (utils/MatrixUtils.scala).
+
+The reference's ``rowsToMatrix`` is the per-partition batching primitive
+every solver uses (stack an iterator of row vectors into one DenseMatrix so
+work happens as BLAS gemm).  On TPU the data model is *already* batched —
+a Dataset is a sharded (n, d) array — so these helpers exist mainly at
+host/ingest boundaries and for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rows_to_matrix(rows: Iterable) -> jnp.ndarray:
+    """Stack row vectors into an (n, d) matrix."""
+    rows = list(rows)
+    if not rows:
+        return jnp.zeros((0, 0), dtype=jnp.float32)
+    return jnp.stack([jnp.asarray(r) for r in rows], axis=0)
+
+
+def matrix_to_rows(mat) -> list:
+    """Inverse of :func:`rows_to_matrix` (utils/MatrixUtils.scala § matrixToRowArray)."""
+    return [mat[i] for i in range(mat.shape[0])]
+
+
+def shuffle_rows(mat, seed: int = 0) -> jnp.ndarray:
+    """Row permutation with a fixed seed (MatrixUtils.shuffleArray analogue)."""
+    mat = jnp.asarray(mat)
+    perm = np.random.default_rng(seed).permutation(mat.shape[0])
+    return mat[jnp.asarray(perm)]
+
+
+def block_ranges(dim: int, block_size: int) -> Sequence[tuple]:
+    """[(start, end), ...] covering ``dim`` in blocks of ``block_size``.
+
+    The feature-block decomposition used by the block solvers
+    (nodes/util/VectorSplitter.scala).
+    """
+    return [(s, min(s + block_size, dim)) for s in range(0, dim, block_size)]

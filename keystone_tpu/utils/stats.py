@@ -1,0 +1,30 @@
+"""Random matrices and numeric test helpers (utils/Stats.scala)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def about_eq(a, b, thresh: float = 1e-8) -> bool:
+    """Elementwise |a-b| <= thresh, reduced with all() (Stats.aboutEq)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.shape != b.shape:
+        return False
+    return bool(np.all(np.abs(a - b) <= thresh))
+
+
+def rand_matrix_gaussian(key, rows: int, cols: int, dtype=jnp.float32):
+    return jax.random.normal(key, (rows, cols), dtype=dtype)
+
+
+def rand_matrix_uniform(key, rows: int, cols: int, dtype=jnp.float32):
+    return jax.random.uniform(key, (rows, cols), dtype=dtype)
+
+
+def rand_matrix_cauchy(key, rows: int, cols: int, dtype=jnp.float32):
+    """Standard Cauchy draws (used by CosineRandomFeatures' Laplacian kernel
+    variant, nodes/stats/CosineRandomFeatures.scala)."""
+    return jax.random.cauchy(key, (rows, cols), dtype=dtype)

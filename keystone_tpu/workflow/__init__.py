@@ -1,0 +1,46 @@
+"""Workflow core: Transformer/Estimator/Pipeline DSL, DAG, executor,
+whole-pipeline optimizer (reference src/main/scala/workflow/, SURVEY.md §2.1)."""
+
+from keystone_tpu.workflow.dataset import Dataset, as_dataset  # noqa: F401
+from keystone_tpu.workflow.transformer import (  # noqa: F401
+    Cacher,
+    Identity,
+    LambdaTransformer,
+    Transformer,
+    transformer,
+)
+from keystone_tpu.workflow.estimator import Estimator, LabelEstimator  # noqa: F401
+from keystone_tpu.workflow.graph import (  # noqa: F401
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    GatherOperator,
+    Graph,
+    NodeId,
+    Operator,
+    SinkId,
+    SourceId,
+    TransformerOperator,
+)
+from keystone_tpu.workflow.executor import GraphExecutor  # noqa: F401
+from keystone_tpu.workflow.optimizer import (  # noqa: F401
+    AutoMaterializeRule,
+    EquivalentNodeMergeRule,
+    FixedPoint,
+    FusedTransformer,
+    NodeChoiceRule,
+    Once,
+    Optimizer,
+    Rule,
+    RuleBatch,
+    StageFusionRule,
+    default_optimizer,
+)
+from keystone_tpu.workflow.pipeline import (  # noqa: F401
+    FittedPipeline,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineEnv,
+)

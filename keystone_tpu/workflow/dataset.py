@@ -1,0 +1,122 @@
+"""The core data abstraction: a Dataset is a sharded batched array.
+
+The reference's unit of distributed data is ``RDD[T]`` — a partitioned
+collection of single datums, batched into per-partition matrices only
+inside solvers (utils/MatrixUtils.scala § rowsToMatrix).  On TPU the
+efficient form is the opposite: data lives batched from the start as a
+device array with its leading axis sharded over the mesh 'data' axis;
+"partitions" are the per-device shards XLA sees.
+
+Three payload kinds flow through pipelines:
+  - device arrays: (n, ...) jnp arrays, the normal case;
+  - ragged arrays: (n, max_k, d) with a boolean (n, max_k) mask — e.g.
+    per-image SIFT descriptor sets (pad-and-mask, SURVEY.md §7 hard part d);
+  - host lists: arbitrary Python objects (e.g. raw text for NLP nodes),
+    which stay on host until a featurizer produces arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel import mesh as _mesh
+
+
+class Dataset:
+    """A (possibly padded) batch with true length ``n``."""
+
+    def __init__(
+        self,
+        data: Any,
+        n: Optional[int] = None,
+        mask: Optional[jnp.ndarray] = None,
+        shard: bool = True,
+    ):
+        if isinstance(data, (list, tuple)) and not _all_arrays(data):
+            # Host payload (strings, PyTrees, variable-shape objects).
+            self._host: Optional[list] = list(data)
+            self._array = None
+            self.n = len(self._host) if n is None else n
+            self.mask = None
+        else:
+            arr = data
+            if isinstance(arr, (list, tuple)):
+                arr = np.stack([np.asarray(a) for a in arr], axis=0)
+            true_n = arr.shape[0] if n is None else n
+            self._host = None
+            self._array = _mesh.shard_batch(arr) if shard else jnp.asarray(arr)
+            self.n = true_n
+            self.mask = mask
+
+    # ------------------------------------------------------------ access
+    @property
+    def is_host(self) -> bool:
+        return self._host is not None
+
+    @property
+    def array(self) -> jnp.ndarray:
+        """Padded, device-resident array. Rows >= n are padding."""
+        if self._array is None:
+            raise TypeError("host-payload Dataset has no array; featurize it first")
+        return self._array
+
+    @property
+    def items(self) -> list:
+        if self._host is not None:
+            return self._host
+        return [np.asarray(self._array[i]) for i in range(self.n)]
+
+    def numpy(self) -> np.ndarray:
+        """Unpadded host copy."""
+        return np.asarray(self.array)[: self.n]
+
+    def __len__(self) -> int:
+        return self.n
+
+    # --------------------------------------------------------- derivation
+    def with_array(self, arr, mask=None) -> "Dataset":
+        """New Dataset sharing this one's true length (padding preserved)."""
+        d = Dataset.__new__(Dataset)
+        d._host = None
+        d._array = arr
+        d.n = self.n
+        d.mask = mask if mask is not None else None
+        return d
+
+    def with_items(self, items: Sequence) -> "Dataset":
+        d = Dataset.__new__(Dataset)
+        d._host = list(items)
+        d._array = None
+        d.n = self.n
+        d.mask = None
+        return d
+
+    def cache(self) -> "Dataset":
+        """Force materialization (the Cacher analogue, nodes/util/Cacher.scala).
+
+        JAX arrays are already materialized once computed; this blocks on
+        completion so downstream timing/profiling sees real costs.
+        """
+        if self._array is not None:
+            self._array.block_until_ready()
+        return self
+
+    def __repr__(self):
+        if self.is_host:
+            return f"Dataset(host, n={self.n})"
+        return f"Dataset(shape={tuple(self.array.shape)}, n={self.n})"
+
+
+def _all_arrays(seq) -> bool:
+    return len(seq) > 0 and all(
+        isinstance(x, (np.ndarray, jnp.ndarray)) and hasattr(x, "shape") for x in seq
+    ) and len({np.shape(x) for x in seq}) == 1
+
+
+def as_dataset(x, shard: bool = True) -> Dataset:
+    if isinstance(x, Dataset):
+        return x
+    return Dataset(x, shard=shard)

@@ -1,0 +1,128 @@
+"""Demand-driven, memoizing DAG executor.
+
+Reference: workflow/GraphExecutor.scala § GraphExecutor — a topological
+demand-driven walk that memoizes per-node results ("Expressions"); fit
+nodes execute once and their fitted transformers are reused by all
+dependents.
+
+Results here are:
+  - DatasetExpr: a sharded device-array Dataset (or host list)
+  - DatumExpr: a single value
+  - TransformerExpr: a fitted Transformer (output of estimator nodes)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from keystone_tpu.workflow import graph as G
+from keystone_tpu.workflow.dataset import Dataset, as_dataset
+from keystone_tpu.workflow.estimator import Estimator, LabelEstimator
+from keystone_tpu.workflow.transformer import Transformer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DatumExpr:
+    value: Any
+
+
+@dataclasses.dataclass
+class DatasetExpr:
+    dataset: Dataset
+
+
+@dataclasses.dataclass
+class TransformerExpr:
+    transformer: Transformer
+
+
+class GraphExecutor:
+    def __init__(self, graph: G.Graph, profile: bool = False):
+        self.graph = graph
+        self.results: Dict[G.GraphId, Any] = {}
+        self.profile = profile
+        self.timings: Dict[G.NodeId, float] = {}
+
+    def execute(self, target: G.GraphId):
+        if isinstance(target, G.SinkId):
+            target = self.graph.sink_dependencies[target]
+        return self._eval(target)
+
+    def _eval(self, target: G.GraphId):
+        if target in self.results:
+            return self.results[target]
+        if isinstance(target, G.SourceId):
+            raise RuntimeError(
+                f"unbound source {target}: apply the pipeline to data before executing"
+            )
+        op = self.graph.operators[target]
+        deps = [self._eval(d) for d in self.graph.dependencies[target]]
+        t0 = time.perf_counter() if self.profile else 0.0
+        result = self._execute_op(op, deps)
+        if self.profile:
+            if isinstance(result, DatasetExpr):
+                result.dataset.cache()
+            self.timings[target] = time.perf_counter() - t0
+        self.results[target] = result
+        return result
+
+    def _execute_op(self, op: G.Operator, deps):
+        if isinstance(op, G.DatasetOperator):
+            return DatasetExpr(as_dataset(op.dataset))
+        if isinstance(op, G.DatumOperator):
+            return DatumExpr(op.datum)
+        if isinstance(op, G.TransformerOperator):
+            return _apply_transformer(op.transformer, deps)
+        if isinstance(op, G.EstimatorOperator):
+            return _fit_estimator(op.estimator, deps)
+        if isinstance(op, G.DelegatingOperator):
+            t = deps[0]
+            if not isinstance(t, TransformerExpr):
+                raise TypeError("DelegatingOperator expects a fitted transformer dep 0")
+            return _apply_transformer(t.transformer, deps[1:])
+        if isinstance(op, G.GatherOperator):
+            return _gather(deps)
+        raise TypeError(f"unknown operator {op!r}")
+
+
+def _apply_transformer(t: Transformer, deps):
+    if len(deps) != 1:
+        raise ValueError(f"{t.label}: transformers are unary, got {len(deps)} deps")
+    d = deps[0]
+    if isinstance(d, DatasetExpr):
+        return DatasetExpr(t.apply_dataset(d.dataset))
+    if isinstance(d, DatumExpr):
+        return DatumExpr(t.apply_one(d.value))
+    raise TypeError(f"{t.label}: cannot apply to {d!r}")
+
+
+def _gather(deps):
+    import jax.numpy as jnp
+
+    if all(isinstance(d, DatasetExpr) for d in deps):
+        base = deps[0].dataset
+        arrs = [d.dataset.array for d in deps]
+        return DatasetExpr(base.with_array(jnp.concatenate(arrs, axis=-1)))
+    if all(isinstance(d, DatumExpr) for d in deps):
+        import jax.numpy as jnp
+
+        return DatumExpr(jnp.concatenate([jnp.asarray(d.value) for d in deps], axis=-1))
+    raise TypeError("Gather expects homogeneous dataset or datum deps")
+
+
+def _fit_estimator(est: Estimator, deps):
+    data = deps[0]
+    if not isinstance(data, DatasetExpr):
+        raise TypeError(f"{est.label}.fit expects a dataset dependency")
+    if isinstance(est, LabelEstimator):
+        if len(deps) < 2 or not isinstance(deps[1], DatasetExpr):
+            raise TypeError(f"{est.label}.fit expects (data, labels) dataset deps")
+        fitted = est.fit_dataset(data.dataset, deps[1].dataset)
+    else:
+        fitted = est.fit_dataset(data.dataset)
+    return TransformerExpr(fitted)

@@ -1,0 +1,312 @@
+"""Whole-pipeline optimizer.
+
+Reference: workflow/Optimizer.scala — a Catalyst-style rule executor
+(batches with Once/FixedPoint strategies) over the pipeline Graph, with
+three rule families (SURVEY.md §2.1):
+
+  - EquivalentNodeMergeRule: CSE — merge structurally identical subgraphs
+    so e.g. two branches sharing SIFT compute it once.
+  - AutoCacheRule: decide which shared outputs to materialize.
+  - NodeOptimizationRule: per-node physical operator choice from sampled
+    data statistics.
+
+The TPU twist (SURVEY.md §7): XLA already does CSE/fusion *within* a
+compiled stage; this optimizer works *across* stages — it decides
+materialization points, and it fuses maximal linear chains of device
+transformers into single jit-compiled stages (StageFusionRule), so a
+featurization chain costs one XLA program, not one dispatch per node.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+import jax
+
+from keystone_tpu.workflow import graph as G
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Cacher, Transformer
+
+logger = logging.getLogger(__name__)
+
+
+class Rule:
+    name: str = "rule"
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        raise NotImplementedError
+
+
+class Once:
+    def __init__(self):
+        self.max_iterations = 1
+
+
+class FixedPoint:
+    def __init__(self, max_iterations: int = 20):
+        self.max_iterations = max_iterations
+
+
+class RuleBatch:
+    def __init__(self, name: str, strategy, rules: Sequence[Rule]):
+        self.name = name
+        self.strategy = strategy
+        self.rules = list(rules)
+
+
+class Optimizer:
+    """Executes rule batches until their strategy is exhausted or the graph
+    stops changing (workflow/Optimizer.scala § RuleExecutor.execute)."""
+
+    def __init__(self, batches: Sequence[RuleBatch]):
+        self.batches = list(batches)
+
+    def execute(self, graph: G.Graph) -> G.Graph:
+        for batch in self.batches:
+            for _ in range(batch.strategy.max_iterations):
+                before = _graph_fingerprint(graph)
+                for rule in batch.rules:
+                    graph = rule.apply(graph)
+                if _graph_fingerprint(graph) == before:
+                    break
+        return graph
+
+
+def _graph_fingerprint(g: G.Graph):
+    return (
+        tuple(sorted((n.id, id(op)) for n, op in g.operators.items())),
+        tuple(sorted((n.id, tuple(d.id for d in ds)) for n, ds in g.dependencies.items())),
+    )
+
+
+# --------------------------------------------------------------------- CSE
+class EquivalentNodeMergeRule(Rule):
+    """Merge nodes whose operator + entire input prefix are structurally
+    equal (workflow/EquivalentNodeMergeRule.scala).  This is what makes
+    ``Pipeline.gather`` branches sharing a SIFT prefix compute it once."""
+
+    name = "EquivalentNodeMerge"
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        memo: dict = {}
+        groups: dict = {}
+        for n in graph.topological_nodes():
+            sig = graph.prefix_signature(n, memo)
+            if sig is not None and sig[0] != "unique":
+                groups.setdefault(sig, []).append(n)
+        for sig, nodes in groups.items():
+            if len(nodes) < 2:
+                continue
+            keep = min(nodes)
+            for other in nodes:
+                if other == keep:
+                    continue
+                graph = graph.replace_dependency(other, keep)
+                graph = graph.remove_node(other)
+        return graph
+
+
+# ----------------------------------------------------------- materialization
+class AutoMaterializeRule(Rule):
+    """Insert Cacher nodes after outputs consumed by >1 dependent.
+
+    The reference's AutoCacheRule profiles nodes on sampled partitions and
+    greedily places ``.cache()`` calls under a cluster-memory budget
+    (workflow/AutoCacheRule.scala).  Here the executor already memoizes
+    per-node results, so "cache or recompute" is decided structurally:
+    shared outputs get an explicit materialization barrier, which also
+    pins them as stage boundaries for the fusion rule below.  A cost-model
+    driven HBM-vs-recompute variant is the round-2 refinement.
+    """
+
+    name = "AutoMaterialize"
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        for n in list(graph.topological_nodes()):
+            op = graph.operators.get(n)
+            if not isinstance(op, (G.TransformerOperator,)):
+                continue
+            if isinstance(op.transformer, Cacher):
+                continue
+            deps_on_n = [d for d in graph.dependents(n) if not isinstance(d, G.SinkId)]
+            already = any(
+                isinstance(graph.operators.get(d), G.TransformerOperator)
+                and isinstance(graph.operators[d].transformer, Cacher)
+                for d in deps_on_n
+                if isinstance(d, G.NodeId)
+            )
+            if len(deps_on_n) > 1 and not already:
+                graph, cache_node = graph.add_node(
+                    G.TransformerOperator(Cacher()), (n,)
+                )
+                for d in deps_on_n:
+                    if isinstance(d, G.NodeId):
+                        graph = graph.set_dependencies(
+                            d,
+                            tuple(
+                                cache_node if x == n else x
+                                for x in graph.dependencies[d]
+                            ),
+                        )
+        return graph
+
+
+# ------------------------------------------------------------- node choice
+class NodeChoiceRule(Rule):
+    """Physical operator selection (workflow/NodeOptimizationRule).
+
+    For estimators that override ``choose_physical``, executes the
+    estimator's input subgraph on a small sample (the analogue of the
+    reference's optimizer-time sampling Spark jobs) and lets the estimator
+    pick its best physical implementation — e.g. a local exact solve for
+    small data vs the distributed block solver, or dense vs sparse LBFGS.
+    """
+
+    name = "NodeChoice"
+
+    def __init__(self, sample_size: int = 256):
+        self.sample_size = sample_size
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
+
+        for n in list(graph.topological_nodes()):
+            op = graph.operators.get(n)
+            if not isinstance(op, G.EstimatorOperator):
+                continue
+            est = op.estimator
+            if type(est).choose_physical is Estimator.choose_physical:
+                continue
+            sample = None
+            try:
+                ex = _SampleExecutor(graph, self.sample_size)
+                expr = ex.execute(graph.dependencies[n][0])
+                if isinstance(expr, DatasetExpr):
+                    sample = expr.dataset
+            except Exception as e:  # sampling is best-effort, like upstream
+                logger.debug("node-choice sampling failed for %s: %s", est.label, e)
+            chosen = est.choose_physical(sample)
+            if chosen is not est:
+                logger.info("node choice: %s -> %s", est.label, chosen.label)
+                graph = graph.set_operator(n, G.EstimatorOperator(chosen))
+        return graph
+
+
+class _SampleExecutor:
+    """Executes a subgraph with dataset literals truncated to k rows."""
+
+    def __init__(self, graph: G.Graph, k: int):
+        from keystone_tpu.workflow.executor import GraphExecutor
+
+        self._inner = GraphExecutor(_truncate_datasets(graph, k))
+
+    def execute(self, target):
+        return self._inner.execute(target)
+
+
+def _truncate_datasets(graph: G.Graph, k: int) -> G.Graph:
+    from keystone_tpu.workflow.dataset import Dataset, as_dataset
+
+    for n, op in list(graph.operators.items()):
+        if isinstance(op, G.DatasetOperator):
+            ds = as_dataset(op.dataset)
+            if not ds.is_host and ds.n > k:
+                sliced = Dataset(ds.array[:k], n=min(k, ds.n), shard=False)
+                graph = graph.set_operator(n, G.DatasetOperator(sliced))
+            elif ds.is_host and ds.n > k:
+                graph = graph.set_operator(
+                    n, G.DatasetOperator(Dataset(ds.items[:k]))
+                )
+    return graph
+
+
+# ------------------------------------------------------------- stage fusion
+class FusedTransformer(Transformer):
+    """A maximal linear chain of device transformers compiled as ONE jit
+    stage.  This is the TPU replacement for the reference's per-node
+    ``rdd.map`` chain: stage boundaries = jit boundaries (SURVEY.md §7)."""
+
+    def __init__(self, stages: Sequence[Transformer]):
+        self.stages = list(stages)
+        self._jitted = None
+
+    @property
+    def label(self):
+        return "Fused[" + " > ".join(s.label for s in self.stages) + "]"
+
+    def params(self):
+        ps = tuple(s.params() for s in self.stages)
+        return None if any(p is None for p in ps) else ps
+
+    def apply_one(self, x):
+        for s in self.stages:
+            x = s.apply_one(x)
+        return x
+
+    def apply_batch(self, xs, mask=None):
+        if self._jitted is None:
+            stages = list(self.stages)
+
+            def run(arr):
+                for s in stages:
+                    arr = s.apply_batch(arr)
+                return arr
+
+            self._jitted = jax.jit(run)
+        return self._jitted(xs)
+
+
+class StageFusionRule(Rule):
+    """Fuse consecutive single-consumer device TransformerOperators."""
+
+    name = "StageFusion"
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        changed = True
+        while changed:
+            changed = False
+            for n in graph.topological_nodes():
+                op = graph.operators.get(n)
+                if not _fusable(op):
+                    continue
+                deps_on_n = graph.dependents(n)
+                if len(deps_on_n) != 1 or isinstance(deps_on_n[0], G.SinkId):
+                    continue
+                m = deps_on_n[0]
+                mop = graph.operators.get(m)
+                if not _fusable(mop) or graph.dependencies[m] != (n,):
+                    continue
+                stages = _stages(op) + _stages(mop)
+                graph = graph.set_operator(m, G.TransformerOperator(FusedTransformer(stages)))
+                graph = graph.set_dependencies(m, graph.dependencies[n])
+                graph = graph.remove_node(n)
+                changed = True
+                break
+        return graph
+
+
+def _fusable(op) -> bool:
+    return (
+        isinstance(op, G.TransformerOperator)
+        and not op.transformer.is_host
+        and getattr(op.transformer, "fusable", True)
+        and not isinstance(op.transformer, Cacher)
+    )
+
+
+def _stages(op) -> list:
+    t = op.transformer
+    return list(t.stages) if isinstance(t, FusedTransformer) else [t]
+
+
+# ------------------------------------------------------------------ default
+def default_optimizer(sample_size: int = 256) -> Optimizer:
+    return Optimizer(
+        [
+            RuleBatch("cse", FixedPoint(5), [EquivalentNodeMergeRule()]),
+            RuleBatch("node-choice", Once(), [NodeChoiceRule(sample_size)]),
+            RuleBatch("materialize", Once(), [AutoMaterializeRule()]),
+            RuleBatch("fusion", Once(), [StageFusionRule()]),
+        ]
+    )

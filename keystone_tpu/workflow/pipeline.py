@@ -1,0 +1,298 @@
+"""Pipeline DSL: chain/gather composition, lazy results, fit.
+
+Reference: workflow/Pipeline.scala § Pipeline[A,B], PipelineDataset,
+PipelineDatum — pipelines are DAGs with one open source and one sink;
+``andThen`` chains, ``Pipeline.gather`` merges branches, applying a
+pipeline to data yields a *lazy* result wrapper, and ``fit()`` resolves
+every estimator into its fitted transformer (the reference's
+PipelineModel), triggering optimization + execution.
+
+Typical usage (cf. pipelines/images/mnist/MnistRandomFFT.scala):
+
+    featurizer = Pipeline.gather([
+        RandomSignNode.init(d, key) | PaddedFFT() | LinearRectifier(0.0)
+        for key in keys
+    ])
+    predictor = (featurizer
+                 .and_then(LinearMapEstimator(lam), train_x, train_labels)
+                 .and_then(MaxClassifier()))
+    test_pred = predictor(test_x).get()
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional, Sequence, Union
+
+from keystone_tpu.workflow import graph as G
+from keystone_tpu.workflow.dataset import Dataset, as_dataset
+from keystone_tpu.workflow.estimator import Estimator, LabelEstimator
+from keystone_tpu.workflow.executor import (
+    DatasetExpr,
+    DatumExpr,
+    GraphExecutor,
+    TransformerExpr,
+)
+from keystone_tpu.workflow.transformer import Chainable, Transformer
+
+
+class PipelineEnv:
+    """Process-global pipeline environment (workflow/PipelineEnv.scala):
+    the optimizer instance and the state directory for saved pipelines."""
+
+    optimizer = None  # lazily constructed default
+    state_dir: Optional[str] = None
+
+    @classmethod
+    def get_optimizer(cls):
+        if cls.optimizer is None:
+            from keystone_tpu.workflow.optimizer import default_optimizer
+
+            cls.optimizer = default_optimizer()
+        return cls.optimizer
+
+
+class Pipeline(Chainable):
+    """A DAG with one open source and one sink."""
+
+    def __init__(self, graph: G.Graph, source: G.SourceId, sink: G.SinkId):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+
+    # ------------------------------------------------------- constructors
+    @staticmethod
+    def of(x) -> "Pipeline":
+        if isinstance(x, Pipeline):
+            return x
+        if isinstance(x, Transformer):
+            return Pipeline.from_transformer(x)
+        raise TypeError(f"cannot lift {x!r} into a Pipeline")
+
+    @staticmethod
+    def from_transformer(t: Transformer) -> "Pipeline":
+        g = G.Graph()
+        g, src = g.add_source()
+        g, node = g.add_node(G.TransformerOperator(t), (src,))
+        g, sink = g.add_sink(node)
+        return Pipeline(g, src, sink)
+
+    @staticmethod
+    def from_estimator(est: Estimator, data, labels=None) -> "Pipeline":
+        """``est.withData(data[, labels])``: a pipeline whose transform is
+        the transformer obtained by fitting ``est`` on ``data``."""
+        g = G.Graph()
+        g, data_dep = _splice_input(g, data)
+        deps = [data_dep]
+        if labels is not None:
+            g, labels_dep = _splice_input(g, labels)
+            deps.append(labels_dep)
+        elif isinstance(est, LabelEstimator):
+            raise ValueError(f"{est.label} requires labels")
+        g, est_node = g.add_node(G.EstimatorOperator(est), tuple(deps))
+        g, src = g.add_source()
+        g, apply_node = g.add_node(G.DelegatingOperator(), (est_node, src))
+        g, sink = g.add_sink(apply_node)
+        return Pipeline(g, src, sink)
+
+    @staticmethod
+    def gather(branches: Sequence[Union["Pipeline", Transformer]]) -> "Pipeline":
+        """Merge N branches over a shared input; output = concatenated
+        features (workflow/Pipeline.scala § gather).  The CSE rule merges
+        any common branch prefixes so shared featurization runs once."""
+        branches = [Pipeline.of(b) for b in branches]
+        if not branches:
+            raise ValueError("gather of zero branches")
+        g = G.Graph()
+        g, src = g.add_source()
+        outs = []
+        for b in branches:
+            g, mapping = g.union(b.graph)
+            b_src = mapping[b.source]
+            g = g.replace_dependency(b_src, src)
+            g = g.remove_source(b_src)
+            out_dep = g.sink_dependencies[mapping[b.sink]]
+            g = g.remove_sink(mapping[b.sink])
+            outs.append(out_dep)
+        g, gather_node = g.add_node(G.GatherOperator(), tuple(outs))
+        g, sink = g.add_sink(gather_node)
+        return Pipeline(g, src, sink)
+
+    # ------------------------------------------------------- composition
+    def then_pipeline(self, other: "Pipeline") -> "Pipeline":
+        g, mapping = self.graph.union(other.graph)
+        g = g.connect(self.sink, mapping[other.source])
+        return Pipeline(g, self.source, mapping[other.sink])
+
+    def and_then(self, nxt, data=None, labels=None) -> "Pipeline":
+        """Chain a transformer/pipeline, or an estimator fit on this
+        pipeline's output over ``data`` (workflow/Pipeline.scala § andThen)."""
+        if isinstance(nxt, Estimator):
+            if data is None:
+                raise ValueError(f"and_then({nxt.label}) requires training data")
+            featurized = self(data)  # lazy: shares this pipeline's prefix
+            est_pipe = Pipeline.from_estimator(nxt, featurized, labels)
+            return self.then_pipeline(est_pipe)
+        return self.then_pipeline(Pipeline.of(nxt))
+
+    # -------------------------------------------------------- application
+    def __call__(self, data):
+        if isinstance(data, PipelineDataset):
+            g, mapping = data.graph.union(self.graph)
+            out_dep = g.sink_dependencies[data.sink]
+            g = g.remove_sink(data.sink)
+            new_src = mapping[self.source]
+            g = g.replace_dependency(new_src, out_dep)
+            g = g.remove_source(new_src)
+            return PipelineDataset(g, mapping[self.sink])
+        if isinstance(data, (Dataset,)) or _is_batchlike(data):
+            ds = as_dataset(data)
+            g, _ = self.graph.replace_source_with_node(
+                self.source, G.DatasetOperator(ds)
+            )
+            return PipelineDataset(g, self.sink)
+        g, _ = self.graph.replace_source_with_node(self.source, G.DatumOperator(data))
+        return PipelineDatum(g, self.sink)
+
+    def apply(self, data):
+        return self(data)
+
+    def apply_datum(self, x) -> "PipelineDatum":
+        """Apply to one datum (arrays are otherwise treated as batches)."""
+        g, _ = self.graph.replace_source_with_node(self.source, G.DatumOperator(x))
+        return PipelineDatum(g, self.sink)
+
+    # --------------------------------------------------------------- fit
+    def fit(self) -> "FittedPipeline":
+        """Optimize, execute every estimator fit, and return a pure
+        transformer pipeline (the reference's ``Pipeline.fit():
+        PipelineModel``).  Fits are memoized via the executor, so shared
+        prefixes run once."""
+        opt = PipelineEnv.get_optimizer()
+        g = opt.execute(self.graph)
+        ex = GraphExecutor(g)
+        fitted: dict = {}
+        for n in g.topological_nodes():
+            if isinstance(g.operators[n], G.EstimatorOperator):
+                expr = ex.execute(n)
+                assert isinstance(expr, TransformerExpr)
+                fitted[n] = expr.transformer
+        for n, t in fitted.items():
+            for dep in g.dependents(n):
+                if isinstance(dep, G.NodeId) and isinstance(
+                    g.operators[dep], G.DelegatingOperator
+                ):
+                    rest = tuple(d for d in g.dependencies[dep] if d != n)
+                    g = g.set_operator(dep, G.TransformerOperator(t))
+                    g = g.set_dependencies(dep, rest)
+            g = g.remove_node(n)
+        g = _prune_unreachable(g, self.sink, keep_sources=(self.source,))
+        return FittedPipeline(g, self.source, self.sink)
+
+    def __repr__(self):
+        return f"Pipeline({self.graph!r})"
+
+
+class FittedPipeline(Pipeline):
+    """An estimator-free pipeline; picklable for save/load
+    (the analogue of the reference's serialized PipelineModel +
+    workflow/SavedStateLoadRule.scala)."""
+
+    def fit(self) -> "FittedPipeline":
+        return self
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @staticmethod
+    def load(path: str) -> "FittedPipeline":
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+        if not isinstance(obj, FittedPipeline):
+            raise TypeError(f"{path} does not contain a FittedPipeline")
+        return obj
+
+
+class PipelineDataset:
+    """Lazy result of applying a pipeline to a dataset
+    (workflow/Pipeline.scala § PipelineDataset).  ``get()`` triggers
+    optimize + execute; the result is cached."""
+
+    def __init__(self, graph: G.Graph, sink: G.SinkId):
+        self.graph = graph
+        self.sink = sink
+        self._result: Optional[Dataset] = None
+
+    def get(self) -> Dataset:
+        if self._result is None:
+            opt = PipelineEnv.get_optimizer()
+            g = opt.execute(self.graph)
+            ex = GraphExecutor(g)
+            expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
+            if not isinstance(expr, DatasetExpr):
+                raise TypeError(f"sink produced {type(expr).__name__}, expected dataset")
+            self._result = expr.dataset
+        return self._result
+
+    def numpy(self):
+        return self.get().numpy()
+
+
+class PipelineDatum:
+    """Lazy single-datum result (workflow/Pipeline.scala § PipelineDatum)."""
+
+    def __init__(self, graph: G.Graph, sink: G.SinkId):
+        self.graph = graph
+        self.sink = sink
+        self._result = None
+        self._done = False
+
+    def get(self):
+        if not self._done:
+            g = PipelineEnv.get_optimizer().execute(self.graph)
+            ex = GraphExecutor(g)
+            expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
+            if not isinstance(expr, DatumExpr):
+                raise TypeError(f"sink produced {type(expr).__name__}, expected datum")
+            self._result = expr.value
+            self._done = True
+        return self._result
+
+
+# ----------------------------------------------------------------- helpers
+def _splice_input(g: G.Graph, data):
+    """Attach ``data`` (literal dataset or lazy PipelineDataset graph) to
+    ``g``; returns (graph, dependency id of the data's value)."""
+    if isinstance(data, PipelineDataset):
+        g2, mapping = g.union(data.graph)
+        dep = g2.sink_dependencies[mapping[data.sink]]
+        g2 = g2.remove_sink(mapping[data.sink])
+        return g2, dep
+    ds = as_dataset(data)
+    g2, node = g.add_node(G.DatasetOperator(ds), ())
+    return g2, node
+
+
+def _prune_unreachable(
+    g: G.Graph, sink: G.SinkId, keep_sources: Sequence[G.SourceId]
+) -> G.Graph:
+    keep = set(keep_sources)
+    keep.add(g.sink_dependencies[sink])
+    keep.update(g.ancestors(g.sink_dependencies[sink]))
+    for n in list(g.operators):
+        if n not in keep:
+            g = g.remove_node(n)
+    for s in list(g.sources):
+        if s not in keep:
+            g = g.remove_source(s)
+    for k in list(g.sink_dependencies):
+        if k != sink:
+            g = g.remove_sink(k)
+    return g
+
+
+def _is_batchlike(x) -> bool:
+    import numpy as np
+
+    return isinstance(x, (list, tuple)) or (hasattr(x, "ndim") and x.ndim >= 1)

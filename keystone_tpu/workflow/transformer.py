@@ -1,0 +1,165 @@
+"""Transformer — the framework's single extension point.
+
+Reference: workflow/Transformer.scala § Transformer[A,B] — an abstract
+unary op with ``apply(a: A): B`` plus ``apply(RDD[A]): RDD[B]`` (default
+``rdd.map``), ``andThen`` composition, and ``Transformer.apply(fn)`` for
+lambda nodes.
+
+TPU translation: ``apply_one`` is the per-datum op; the batch path
+``apply_batch`` defaults to ``vmap(apply_one)`` over a sharded device
+array — XLA compiles and shards it, replacing closure-shipped executor
+map tasks.  Most concrete ops override ``apply_batch`` directly with
+natively-batched code (conv, einsum), which is both simpler and faster
+than the reference's per-datum formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.workflow.dataset import Dataset, as_dataset
+
+
+class Chainable:
+    """Mixin providing ``and_then`` / ``__or__`` composition sugar."""
+
+    def and_then(self, nxt, data=None, labels=None):
+        from keystone_tpu.workflow.pipeline import Pipeline
+
+        return Pipeline.of(self).and_then(nxt, data=data, labels=labels)
+
+    def __or__(self, nxt):
+        return self.and_then(nxt)
+
+
+class Transformer(Chainable):
+    #: True for ops that run on host Python objects (e.g. tokenizers).
+    is_host: bool = False
+
+    @property
+    def label(self) -> str:
+        return type(self).__name__
+
+    # ---------------------------------------------------------- identity
+    def params(self):
+        """Hashable parameter tuple for CSE equality; None => never merged."""
+        return None
+
+    def signature(self):
+        p = self.params()
+        return None if p is None else (type(self).__name__, p)
+
+    # ------------------------------------------------------------- apply
+    def apply_one(self, x):
+        raise NotImplementedError(type(self).__name__)
+
+    def apply_batch(self, xs, mask=None):
+        """Batched apply; default is vmap of apply_one."""
+        return jax.vmap(self.apply_one)(xs)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if ds.is_host or self.is_host:
+            out = [self.apply_one(x) for x in ds.items]
+            if out and isinstance(out[0], (jnp.ndarray,)) or _stackable(out):
+                try:
+                    return ds.with_array(jnp.stack([jnp.asarray(o) for o in out]))
+                except (TypeError, ValueError):
+                    pass
+            return ds.with_items(out)
+        result = self.apply_batch(ds.array, mask=ds.mask)
+        if isinstance(result, tuple):  # (values, mask) for ragged producers
+            return ds.with_array(result[0], mask=result[1])
+        return ds.with_array(result)
+
+    def __call__(self, x):
+        from keystone_tpu.workflow.pipeline import Pipeline, PipelineDataset
+
+        if isinstance(x, (Pipeline, PipelineDataset)):
+            return Pipeline.of(self)(x)
+        if isinstance(x, Dataset):
+            return self.apply_dataset(x)
+        return self.apply_one(x)
+
+    def __repr__(self):
+        return self.label
+
+
+class LambdaTransformer(Transformer):
+    """``Transformer.apply(fn)`` analogue: wrap a function as a node."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        batch_fn: Optional[Callable] = None,
+        name: str = "Lambda",
+        host: bool = False,
+    ):
+        self._fn = fn
+        self._batch_fn = batch_fn
+        self._name = name
+        self.is_host = host
+
+    @property
+    def label(self):
+        return self._name
+
+    def apply_one(self, x):
+        return self._fn(x)
+
+    def apply_batch(self, xs, mask=None):
+        if self._batch_fn is not None:
+            return self._batch_fn(xs)
+        return jax.vmap(self._fn)(xs)
+
+
+def transformer(fn=None, *, batch=None, name=None, host=False):
+    """Decorator/factory for lambda nodes: ``transformer(lambda x: x * 2)``."""
+
+    def make(f):
+        return LambdaTransformer(
+            f, batch_fn=batch, name=name or getattr(f, "__name__", "Lambda"), host=host
+        )
+
+    if fn is not None:
+        return make(fn)
+    return make
+
+
+class Identity(Transformer):
+    def params(self):
+        return ()
+
+    def apply_one(self, x):
+        return x
+
+    def apply_batch(self, xs, mask=None):
+        return xs
+
+
+class Cacher(Transformer):
+    """Identity that forces materialization — the unit of the caching
+    optimizer (nodes/util/Cacher.scala).  On TPU this means "block until
+    the stage's arrays are resident in HBM" so downstream stages (and the
+    profiler) see a stage boundary rather than one fused program."""
+
+    def params(self):
+        return None  # each Cacher is its own node; never CSE-merged away
+
+    def apply_one(self, x):
+        return x
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        return ds.cache()
+
+
+def _stackable(out) -> bool:
+    import numpy as np
+
+    return (
+        len(out) > 0
+        and all(hasattr(o, "shape") for o in out)
+        and len({np.shape(o) for o in out}) == 1
+    )

@@ -1,0 +1,43 @@
+"""Test fixture: a virtual 8-device CPU mesh.
+
+The reference tests all distributed code paths on a LocalSparkContext
+("local[N]" threads in one JVM; SURVEY.md §4).  The analogue here is
+XLA's virtual CPU devices: 8 host devices exercise the same
+sharding/collective code paths as an 8-chip TPU slice without hardware.
+Must be set before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the axon TPU backend and forces
+# jax_platforms="axon,cpu" programmatically; point the config back at cpu
+# (must happen before any backend is touched).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def mesh():
+    """Process-global 4x2 (data x model) mesh over the 8 virtual devices."""
+    from keystone_tpu.parallel import default_mesh, set_mesh
+
+    m = default_mesh(model_parallelism=2)
+    set_mesh(m)
+    yield m
+    set_mesh(None)
+
+
+@pytest.fixture
+def rng_key():
+    return jax.random.PRNGKey(0)

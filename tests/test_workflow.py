@@ -1,0 +1,221 @@
+"""Workflow core tests.
+
+Mirrors the reference's workflow/PipelineSuite.scala, OptimizerSuite.scala,
+GraphSuite.scala pattern: toy graphs, side-effect counters in fake nodes to
+assert CSE merges and memoized execution counts (SURVEY.md §4).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow import (
+    Dataset,
+    Estimator,
+    FusedTransformer,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+    default_optimizer,
+    transformer,
+)
+
+
+class CountingDouble(Transformer):
+    """x * 2 with an invocation counter; CSE-mergeable."""
+
+    calls = 0
+
+    def params(self):
+        return ("double",)
+
+    def apply_one(self, x):
+        return x * 2.0
+
+    def apply_batch(self, xs, mask=None):
+        CountingDouble.calls += 1
+        return xs * 2.0
+
+
+class AddConst(Transformer):
+    def __init__(self, c):
+        self.c = float(c)
+
+    def params(self):
+        return (self.c,)
+
+    def apply_one(self, x):
+        return x + self.c
+
+    def apply_batch(self, xs, mask=None):
+        return xs + self.c
+
+
+class MeanShift(Estimator):
+    """Fits the mean; transformer subtracts it."""
+
+    fit_calls = 0
+
+    def params(self):
+        return ("meanshift",)
+
+    def fit_arrays(self, x):
+        MeanShift.fit_calls += 1
+        mu = jnp.mean(x, axis=0)
+        return AddConst(0.0) if mu.ndim == 0 else _Sub(mu)
+
+
+class _Sub(Transformer):
+    def __init__(self, mu):
+        self.mu = mu
+
+    def apply_batch(self, xs, mask=None):
+        return xs - self.mu
+
+
+class ScaleToLabelMean(LabelEstimator):
+    def fit_arrays(self, x, y=None):
+        s = jnp.mean(y) / jnp.maximum(jnp.mean(x), 1e-9)
+        return AddConst(0.0) if s.ndim != 0 else _Scale(s)
+
+
+class _Scale(Transformer):
+    def __init__(self, s):
+        self.s = s
+
+    def apply_batch(self, xs, mask=None):
+        return xs * self.s
+
+
+def test_transformer_eager_apply():
+    t = AddConst(1.0)
+    ds = Dataset(np.zeros((5, 3), np.float32))
+    out = t(ds)
+    assert np.allclose(out.numpy(), 1.0)
+    assert out.n == 5
+    assert float(t(jnp.array(2.0))) == 3.0
+
+
+def test_lambda_transformer():
+    t = transformer(lambda x: x * 3.0, name="Triple")
+    ds = Dataset(np.ones((4, 2), np.float32))
+    assert np.allclose(t(ds).numpy(), 3.0)
+    assert t.label == "Triple"
+
+
+def test_pipeline_chain_and_apply():
+    p = AddConst(1.0) | AddConst(2.0)
+    ds = Dataset(np.zeros((6, 2), np.float32))
+    out = p(ds).get()
+    assert np.allclose(out.numpy(), 3.0)
+
+
+def test_padding_preserved_through_pipeline():
+    # 5 rows on a 4-wide data axis: padded to 8, but numpy() returns 5.
+    ds = Dataset(np.arange(10, dtype=np.float32).reshape(5, 2))
+    out = (AddConst(1.0) | AddConst(1.0))(ds).get()
+    assert out.numpy().shape == (5, 2)
+
+
+def test_estimator_with_data_and_fit():
+    data = np.random.default_rng(0).normal(2.0, 1.0, (32, 4)).astype(np.float32)
+    pipe = AddConst(0.0) | MeanShift().with_data(Dataset(data))
+    out = pipe(Dataset(data)).get().numpy()
+    assert abs(out.mean()) < 1e-5
+
+
+def test_label_estimator():
+    x = np.ones((16, 3), np.float32)
+    y = np.full((16, 3), 5.0, np.float32)
+    pipe = Pipeline.of(AddConst(0.0)).and_then(
+        ScaleToLabelMean(), Dataset(x), Dataset(y)
+    )
+    out = pipe(Dataset(x)).get().numpy()
+    assert np.allclose(out, 5.0, atol=1e-5)
+
+
+def test_fit_resolves_estimators_and_is_reusable():
+    MeanShift.fit_calls = 0
+    data = np.random.default_rng(1).normal(3.0, 1.0, (32, 4)).astype(np.float32)
+    pipe = AddConst(1.0).and_then(MeanShift(), Dataset(data))
+    fitted = pipe.fit()
+    out1 = fitted(Dataset(data)).get().numpy()
+    out2 = fitted(Dataset(data + 1.0)).get().numpy()
+    assert MeanShift.fit_calls == 1
+    assert abs(out1.mean()) < 1e-4
+    assert abs(out2.mean() - 1.0) < 1e-4
+
+
+def test_gather_concatenates_features():
+    branches = [Pipeline.of(AddConst(float(i))) for i in range(3)]
+    p = Pipeline.gather(branches)
+    ds = Dataset(np.zeros((4, 2), np.float32))
+    out = p(ds).get().numpy()
+    assert out.shape == (4, 6)
+    assert np.allclose(out[:, 0:2], 0.0)
+    assert np.allclose(out[:, 4:6], 2.0)
+
+
+def test_cse_merges_identical_branches():
+    """Two gather branches share an identical CountingDouble prefix; after
+    CSE it must execute once (EquivalentNodeMergeRule semantics)."""
+    CountingDouble.calls = 0
+    b1 = CountingDouble() | AddConst(1.0)
+    b2 = CountingDouble() | AddConst(2.0)
+    p = Pipeline.gather([b1, b2])
+    ds = Dataset(np.ones((4, 2), np.float32))
+    out = p(ds).get().numpy()
+    assert out.shape == (4, 4)
+    assert np.allclose(out[:, :2], 3.0)
+    assert np.allclose(out[:, 2:], 4.0)
+    assert CountingDouble.calls == 1
+
+
+def test_fusion_rule_fuses_linear_chains():
+    from keystone_tpu.workflow import Graph, StageFusionRule, TransformerOperator
+
+    g = Graph()
+    g, src = g.add_source()
+    g, n1 = g.add_node(TransformerOperator(AddConst(1.0)), (src,))
+    g, n2 = g.add_node(TransformerOperator(AddConst(2.0)), (n1,))
+    g, n3 = g.add_node(TransformerOperator(AddConst(3.0)), (n2,))
+    g, sink = g.add_sink(n3)
+    fused = StageFusionRule().apply(g)
+    ops = [op for op in fused.operators.values()]
+    assert len(ops) == 1
+    assert isinstance(ops[0].transformer, FusedTransformer)
+    assert len(ops[0].transformer.stages) == 3
+
+
+def test_fused_transformer_matches_unfused():
+    chain = [AddConst(1.0), CountingDouble(), AddConst(-0.5)]
+    fused = FusedTransformer(chain)
+    x = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+    expect = (x + 1.0) * 2.0 - 0.5
+    assert np.allclose(np.asarray(fused.apply_batch(x)), np.asarray(expect))
+
+
+def test_host_transformer_path():
+    up = transformer(lambda s: s.upper(), name="Upper", host=True)
+    ds = Dataset(["ab", "cd"])
+    out = up(ds)
+    assert out.items == ["AB", "CD"]
+
+
+def test_save_load_fitted(tmp_path):
+    data = np.random.default_rng(2).normal(1.0, 1.0, (16, 4)).astype(np.float32)
+    fitted = AddConst(0.5).and_then(MeanShift(), Dataset(data)).fit()
+    path = str(tmp_path / "pipe.pkl")
+    fitted.save(path)
+    from keystone_tpu.workflow import FittedPipeline
+
+    loaded = FittedPipeline.load(path)
+    a = fitted(Dataset(data)).get().numpy()
+    b = loaded(Dataset(data)).get().numpy()
+    assert np.allclose(a, b)
+
+
+def test_pipeline_datum_apply():
+    p = AddConst(1.0) | AddConst(1.0)
+    out = p.apply_datum(jnp.array([1.0, 2.0])).get()
+    assert np.allclose(np.asarray(out), [3.0, 4.0])
