@@ -1,0 +1,48 @@
+"""Learning nodes / solvers (reference src/main/scala/nodes/learning/).
+
+Every estimator here follows the reference's distributed pattern translated
+to TPU (SURVEY.md §3.2): per-partition gemm + treeReduce becomes a sharded
+einsum whose contraction over the row-sharded axis XLA lowers to an
+all-reduce over ICI; the driver-side Cholesky solve becomes a replicated
+on-device solve; broadcast of weights is replicated sharding.
+"""
+
+from keystone_tpu.models.linear import (  # noqa: F401
+    LeastSquaresEstimator,
+    LinearMapEstimator,
+    LinearMapper,
+    LocalLeastSquaresEstimator,
+)
+from keystone_tpu.models.block_ls import (  # noqa: F401
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+)
+from keystone_tpu.models.block_weighted_ls import (  # noqa: F401
+    BlockWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.models.lbfgs import (  # noqa: F401
+    DenseLBFGSwithL2,
+    SparseLBFGSwithL2,
+    lbfgs_minimize,
+)
+from keystone_tpu.models.pca import (  # noqa: F401
+    DistributedPCAEstimator,
+    PCAEstimator,
+    PCATransformer,
+)
+from keystone_tpu.models.zca import ZCAWhitener, ZCAWhitenerEstimator  # noqa: F401
+from keystone_tpu.models.kmeans import KMeansModel, KMeansPlusPlusEstimator  # noqa: F401
+from keystone_tpu.models.gmm import (  # noqa: F401
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+)
+from keystone_tpu.models.naive_bayes import NaiveBayesEstimator, NaiveBayesModel  # noqa: F401
+from keystone_tpu.models.logistic import (  # noqa: F401
+    LogisticRegressionEstimator,
+    LogisticRegressionModel,
+)
+from keystone_tpu.models.kernel_ridge import (  # noqa: F401
+    GaussianKernelGenerator,
+    KernelBlockLinearMapper,
+    KernelRidgeRegressionEstimator,
+)

@@ -1,0 +1,213 @@
+"""Block coordinate descent ridge regression — the north-star solver.
+
+Reference: nodes/learning/BlockLeastSquares.scala §
+BlockLeastSquaresEstimator and BlockLinearMapper.scala: features are split
+into fixed-size blocks (VectorSplitter); each epoch sweeps the blocks
+Gauss–Seidel style — recompute the residual, form the block's normal
+equations via per-partition gemm + treeReduce, solve on the driver with
+Cholesky + λI, broadcast.  This is how d≈200k-dim Fisher-vector models
+fit in memory.
+
+TPU design: the entire multi-epoch sweep is ONE jitted
+``lax.scan``-over-epochs of a ``lax.fori_loop``-over-blocks program.
+
+  - X is laid out pre-blocked as (num_blocks, n, block_size), rows sharded
+    over the mesh 'data' axis.  Block Gramians contract over rows → XLA
+    all-reduce over ICI (the treeReduce).
+  - The running prediction P = Σ_b X_b W_b (n, k) stays row-sharded; the
+    class axis k is sharded over 'model', so the per-block multi-class
+    solve is itself tensor-parallel (the reference's driver solve,
+    eliminated).
+  - Weights (num_blocks, block_size, k) are replicated over 'data'
+    (broadcast analogue) and sharded over 'model' on k.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.models.common import constrain, solve_spd
+from keystone_tpu.parallel.collectives import sharded_gram, sharded_matmul
+from jax.sharding import PartitionSpec as P
+from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import LabelEstimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+def blockify(x: jnp.ndarray, block_size: int):
+    """(n, d) -> (num_blocks, n, block_size), zero-padding d if needed
+    (the VectorSplitter analogue, nodes/util/VectorSplitter.scala)."""
+    n, d = x.shape
+    nb = -(-d // block_size)
+    if nb * block_size != d:
+        x = jnp.pad(x, ((0, 0), (0, nb * block_size - d)))
+    return x.reshape(n, nb, block_size).transpose(1, 0, 2)
+
+
+class BlockLinearMapper(Transformer):
+    """Applies per-block weights and sums partial predictions
+    (nodes/learning/BlockLinearMapper.scala).  ``weights`` is
+    (num_blocks, block_size, k)."""
+
+    def __init__(
+        self,
+        weights: jnp.ndarray,
+        block_size: int,
+        intercept: Optional[jnp.ndarray] = None,
+        feature_mean: Optional[jnp.ndarray] = None,
+    ):
+        self.weights = weights
+        self.block_size = int(block_size)
+        self.intercept = intercept
+        self.feature_mean = feature_mean
+
+    @property
+    def flat_weights(self) -> jnp.ndarray:
+        nb, bs, k = self.weights.shape
+        return self.weights.reshape(nb * bs, k)
+
+    def apply_batch(self, xs, mask=None):
+        return _block_predict(
+            xs, self.weights, self.block_size, self.intercept, self.feature_mean
+        )
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+    def apply_and_evaluate(self, xs, eval_fn):
+        """Stream per-block partial prediction sums to an eval callback
+        (BlockLinearMapper.applyAndEvaluate) — used to watch convergence
+        per block without materializing all partials."""
+        xb = blockify(jnp.asarray(xs), self.block_size)
+        acc = jnp.zeros((xs.shape[0], self.weights.shape[-1]), jnp.float32)
+        results = []
+        for b in range(self.weights.shape[0]):
+            acc = acc + xb[b] @ self.weights[b]
+            out = acc
+            if self.feature_mean is not None or self.intercept is not None:
+                out = acc + _offset(self.weights, self.feature_mean, self.intercept)
+            results.append(eval_fn(out))
+        return results
+
+
+def _offset(weights, feature_mean, intercept):
+    off = 0.0
+    if feature_mean is not None:
+        nb, bs, k = weights.shape
+        off = off - feature_mean @ weights.reshape(nb * bs, k)
+    if intercept is not None:
+        off = off + intercept
+    return off
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def _block_predict(xs, weights, block_size, intercept, feature_mean):
+    xs = xs.astype(jnp.float32)
+    nb, bs, k = weights.shape
+    xb = blockify(xs, block_size)  # (nb, n, bs)
+    out = jnp.einsum("bni,bik->nk", xb, weights, preferred_element_type=jnp.float32)
+    out = out + _offset(weights, feature_mean, intercept)
+    return out
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    """Gauss–Seidel block coordinate descent ridge
+    (nodes/learning/BlockLeastSquares.scala § BlockLeastSquaresEstimator).
+
+    Math per (epoch, block):  W_b ← (X_bᵀX_b + nλI)⁻¹ X_bᵀ(Y − P + X_bW_b)
+    where P = Σ_b X_b W_b is the running prediction.
+    """
+
+    def __init__(
+        self,
+        block_size: int = 4096,
+        num_iter: int = 1,
+        lam: float = 0.0,
+        fit_intercept: bool = True,
+    ):
+        self.block_size = int(block_size)
+        self.num_iter = int(num_iter)
+        self.lam = float(lam)
+        self.fit_intercept = fit_intercept
+
+    def params(self):
+        return (self.block_size, self.num_iter, self.lam, self.fit_intercept)
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
+        if labels is None:
+            raise ValueError("BlockLeastSquaresEstimator requires labels")
+        return self._fit(data.array, labels.array, data.n)
+
+    def fit_arrays(self, x, y=None):
+        x = jnp.asarray(x)
+        return self._fit(x, jnp.asarray(y), x.shape[0])
+
+    def _fit(self, x, y, n) -> BlockLinearMapper:
+        x = x.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        nf = jnp.float32(n)
+        xm = jnp.sum(x, axis=0) / nf if self.fit_intercept else None
+        ym = jnp.sum(y, axis=0) / nf if self.fit_intercept else None
+        # Center on padded arrays: pad rows become (−x̄), which would
+        # corrupt Gramians — so mask them back to zero explicitly.
+        if self.fit_intercept:
+            row_ok = (jnp.arange(x.shape[0]) < n)[:, None].astype(jnp.float32)
+            xc = (x - xm) * row_ok
+            yc = (y - ym) * row_ok
+        else:
+            xc, yc = x, y
+        weights = _bcd_fit(
+            blockify(xc, self.block_size), yc, nf, self.lam, self.num_iter
+        )
+        if self.fit_intercept:
+            nb, bs, k = weights.shape
+            d = x.shape[1]
+            wflat = weights.reshape(nb * bs, k)[:d]
+            intercept = ym - xm @ wflat
+            pad = nb * bs - d
+            return BlockLinearMapper(
+                jnp.pad(wflat, ((0, pad), (0, 0))).reshape(nb, bs, k),
+                self.block_size,
+                intercept=intercept,
+            )
+        return BlockLinearMapper(weights, self.block_size)
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def _bcd_fit(xb, y, n, lam, num_iter):
+    """The hot loop (SURVEY.md §3.2) as one XLA program.
+
+    xb: (nb, n_rows, bs) row-sharded; y: (n_rows, k).
+    """
+    nb, n_rows, bs = xb.shape
+    k = y.shape[1]
+    xb = constrain(xb, None, DATA_AXIS, None)
+    y = constrain(y, DATA_AXIS, MODEL_AXIS)
+    w0 = jnp.zeros((nb, bs, k), jnp.float32)
+    p0 = jnp.zeros_like(y)
+
+    def block_step(b, carry):
+        w, p = carry
+        a = xb[b]  # (n_rows, bs)
+        wb = w[b]
+        # residual with this block's contribution restored
+        target = y - p + a @ wb
+        # per-partition gemm + treeReduce == sharded contraction + psum
+        ata = sharded_gram(a)
+        atr = sharded_matmul(a, target, out_spec=P(None, MODEL_AXIS))
+        wb_new = solve_spd(ata, atr, reg=lam * n)
+        p_new = constrain(p + a @ (wb_new - wb), DATA_AXIS, MODEL_AXIS)
+        return w.at[b].set(wb_new), p_new
+
+    def epoch(carry, _):
+        carry = lax.fori_loop(0, nb, block_step, carry)
+        return carry, None
+
+    (w, _), _ = lax.scan(epoch, (w0, p0), None, length=num_iter)
+    return w

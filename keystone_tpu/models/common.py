@@ -1,0 +1,36 @@
+"""Shared solver numerics."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.parallel import mesh as _mesh
+
+
+def solve_spd(A: jnp.ndarray, B: jnp.ndarray, reg: float = 0.0) -> jnp.ndarray:
+    """Solve (A + reg·I) X = B for symmetric positive-definite A via
+    Cholesky — the on-device replacement for every reference driver-side
+    ``cholesky(... + λI) \\ ...`` (e.g. nodes/learning/BlockLeastSquares.scala)."""
+    d = A.shape[0]
+    A = A + reg * jnp.eye(d, dtype=A.dtype)
+    c, lower = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve((c, lower), B)
+
+
+def constrain(x, *spec):
+    """Sharding-constrain ``x`` to PartitionSpec(*spec) on the current mesh."""
+    mesh = _mesh.current_mesh()
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def xtx_xty(x: jnp.ndarray, y: jnp.ndarray):
+    """Replicated (XᵀX, XᵀY) from row-sharded X, Y.
+
+    The reference's per-partition gemm + treeReduce pair (SURVEY.md §3.2);
+    zero padding rows contribute nothing, so padded Datasets are safe.
+    """
+    from keystone_tpu.parallel.collectives import sharded_gram, sharded_matmul
+
+    return sharded_gram(x), sharded_matmul(x, y)

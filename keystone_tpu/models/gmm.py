@@ -1,0 +1,149 @@
+"""Diagonal-covariance Gaussian mixture model (EM).
+
+Reference: nodes/learning/GaussianMixtureModel.scala §
+GaussianMixtureModelEstimator — the Fisher-vector vocabulary model.  The
+reference's production path is the native EncEval C++ EM
+(utils/external/EncEval.scala via JNI, SURVEY.md §2.8); this is its
+TPU-native replacement: EM as a jitted lax.scan whose E-step
+responsibilities come from one log-density gemm and whose M-step
+sufficient statistics contract over the row-sharded axis (the treeReduce).
+
+Initialization: k-means++ centers, global variance — deterministic given
+the seed, like the reference's seeded sampling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.models.common import constrain
+from keystone_tpu.models.kmeans import _kmeans_fit
+from keystone_tpu.parallel.mesh import DATA_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Transformer
+
+_LOG2PI = 1.8378770664093453
+
+
+def _log_gaussians(x, means, variances, log_weights):
+    """(n, K) log w_k + log N(x; μ_k, diag σ²_k) via gemm expansion."""
+    inv = 1.0 / variances  # (K, d)
+    # ‖(x−μ)/σ‖² = Σ x²/σ² − 2 Σ xμ/σ² + Σ μ²/σ²
+    quad = (
+        (x * x) @ inv.T
+        - 2.0 * x @ (means * inv).T
+        + jnp.sum(means * means * inv, axis=1)
+    )
+    log_norm = -0.5 * (jnp.sum(jnp.log(variances), axis=1) + x.shape[1] * _LOG2PI)
+    return log_weights + log_norm - 0.5 * quad
+
+
+class GaussianMixtureModel(Transformer):
+    """Posterior responsibilities transformer; carries (weights, means,
+    variances) for Fisher-vector encoding."""
+
+    def __init__(self, weights, means, variances):
+        self.weights = weights  # (K,)
+        self.means = means  # (K, d)
+        self.variances = variances  # (K, d)
+
+    @property
+    def k(self):
+        return self.means.shape[0]
+
+    def log_responsibilities(self, x):
+        lg = _log_gaussians(x, self.means, self.variances, jnp.log(self.weights))
+        return lg - jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
+
+    def apply_batch(self, xs, mask=None):
+        r = jnp.exp(self.log_responsibilities(xs))
+        return (r, mask) if mask is not None else r
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class GaussianMixtureModelEstimator(Estimator):
+    def __init__(
+        self,
+        k: int,
+        max_iterations: int = 50,
+        min_variance: float = 1e-6,
+        seed: int = 0,
+        kmeans_iters: int = 10,
+    ):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.min_variance = float(min_variance)
+        self.seed = int(seed)
+        self.kmeans_iters = int(kmeans_iters)
+
+    def params(self):
+        return (
+            self.k,
+            self.max_iterations,
+            self.min_variance,
+            self.seed,
+            self.kmeans_iters,
+        )
+
+    def fit_dataset(self, data: Dataset) -> GaussianMixtureModel:
+        x = data.array
+        if data.mask is not None:
+            x = x.reshape(-1, x.shape[-1])
+            valid = data.mask.reshape(-1) > 0
+            x = x * valid[:, None]
+            n = jnp.sum(valid.astype(jnp.float32))
+            w, m, v = _gmm_fit(
+                x, n, valid.astype(jnp.float32), self.k, self.max_iterations,
+                self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+            )
+        else:
+            n_rows = x.shape[0]
+            row_ok = (jnp.arange(n_rows) < data.n).astype(jnp.float32)
+            w, m, v = _gmm_fit(
+                x, jnp.float32(data.n), row_ok, self.k, self.max_iterations,
+                self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+            )
+        return GaussianMixtureModel(w, m, v)
+
+    def fit_arrays(self, x) -> GaussianMixtureModel:
+        x = jnp.asarray(x, jnp.float32)
+        row_ok = jnp.ones((x.shape[0],), jnp.float32)
+        w, m, v = _gmm_fit(
+            x, jnp.float32(x.shape[0]), row_ok, self.k, self.max_iterations,
+            self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+        )
+        return GaussianMixtureModel(w, m, v)
+
+
+@partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters"))
+def _gmm_fit(x, n, row_ok, k, iters, min_var, key, kmeans_iters):
+    x = constrain(x.astype(jnp.float32), DATA_AXIS)
+    means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key)
+    gmean = jnp.sum(x * row_ok[:, None], axis=0) / n
+    gvar = jnp.sum((x - gmean) ** 2 * row_ok[:, None], axis=0) / n
+    var0 = jnp.tile(jnp.maximum(gvar, min_var)[None, :], (k, 1))
+    w0 = jnp.full((k,), 1.0 / k, jnp.float32)
+
+    def em(carry, _):
+        w, mu, var = carry
+        lg = _log_gaussians(x, mu, var, jnp.log(w))
+        lr = lg - jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
+        r = jnp.exp(lr) * row_ok[:, None]  # (n, K)
+        nk = constrain(jnp.sum(r, axis=0))  # psum over 'data'
+        nk = jnp.maximum(nk, 1e-10)
+        mu_new = constrain(r.T @ x) / nk[:, None]
+        ex2 = constrain(r.T @ (x * x)) / nk[:, None]
+        var_new = jnp.maximum(ex2 - mu_new * mu_new, min_var)
+        w_new = nk / n
+        return (w_new, mu_new, var_new), None
+
+    (w, mu, var), _ = lax.scan(em, (w0, means0, var0), None, length=iters)
+    return w, mu, var

@@ -1,0 +1,130 @@
+"""K-means++ clustering.
+
+Reference: nodes/learning/KMeansPlusPlus.scala § KMeansPlusPlusEstimator /
+KMeansModel — k-means++ seeding, Lloyd iterations with BLAS-gemm distance
+computation per partition; the model transformer emits one-hot cluster
+assignments (used as a feature encoder, e.g. for random-patch vocabularies).
+
+TPU form: seeding and Lloyd's loop are jitted lax scans; the (n, k)
+distance matrix is one MXU gemm per iteration via the
+‖x−c‖² = ‖x‖² − 2x·c + ‖c‖² expansion; assignment means come from a
+one-hot einsum (segment-sum) contraction over the row-sharded axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.models.common import constrain
+from keystone_tpu.parallel.mesh import DATA_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+def _sq_dists(x, centers):
+    """(..., k) squared distances via the gemm expansion; x is (..., d)."""
+    xn = jnp.sum(x * x, axis=-1, keepdims=True)
+    cn = jnp.sum(centers * centers, axis=-1)
+    return xn - 2.0 * (x @ centers.T) + cn
+
+
+class KMeansModel(Transformer):
+    """Emits one-hot nearest-center assignment (KMeansPlusPlus.scala §
+    KMeansModel.apply)."""
+
+    def __init__(self, centers: jnp.ndarray):
+        self.centers = centers  # (k, d)
+
+    def apply_batch(self, xs, mask=None):
+        d = _sq_dists(xs, self.centers)
+        onehot = jax.nn.one_hot(jnp.argmin(d, axis=-1), self.centers.shape[0])
+        if mask is not None:
+            # ragged descriptor sets: zero padding rows' votes, keep the mask
+            return onehot * mask[..., None], mask
+        return onehot
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+    def assign(self, xs):
+        return jnp.argmin(_sq_dists(xs, self.centers), axis=1)
+
+
+class KMeansPlusPlusEstimator(Estimator):
+    def __init__(self, num_means: int, max_iterations: int = 20, seed: int = 0):
+        self.num_means = int(num_means)
+        self.max_iterations = int(max_iterations)
+        self.seed = int(seed)
+
+    def params(self):
+        return (self.num_means, self.max_iterations, self.seed)
+
+    def fit_dataset(self, data: Dataset) -> KMeansModel:
+        x = data.array
+        if data.mask is not None:
+            x = x.reshape(-1, x.shape[-1])
+            row_ok = (data.mask.reshape(-1) > 0).astype(jnp.float32)
+            x = x * row_ok[:, None]
+        else:
+            row_ok = (jnp.arange(x.shape[0]) < data.n).astype(jnp.float32)
+        return KMeansModel(
+            _kmeans_fit(
+                x, row_ok, self.num_means, self.max_iterations,
+                jax.random.PRNGKey(self.seed),
+            )
+        )
+
+    def fit_arrays(self, x) -> KMeansModel:
+        x = jnp.asarray(x, jnp.float32)
+        return KMeansModel(
+            _kmeans_fit(
+                x,
+                jnp.ones((x.shape[0],), jnp.float32),
+                self.num_means,
+                self.max_iterations,
+                jax.random.PRNGKey(self.seed),
+            )
+        )
+
+
+@partial(jax.jit, static_argnames=("k", "iters"))
+def _kmeans_fit(x, row_ok, k, iters, key):
+    """row_ok: (n_rows,) 1.0 for real rows, 0.0 for padding/invalid."""
+    x = constrain(x.astype(jnp.float32), DATA_AXIS)
+    n_rows = x.shape[0]
+
+    # --- k-means++ seeding: sample propto min squared distance ---
+    key, k0 = jax.random.split(key)
+    first = jax.random.categorical(k0, jnp.log(row_ok + 1e-30))
+    centers0 = jnp.zeros((k, x.shape[1]), jnp.float32).at[0].set(x[first])
+
+    def seed_step(i, carry):
+        centers, key = carry
+        # only the first i centers are set; mask the zero placeholders out
+        dists = _sq_dists(x, centers)
+        dists = jnp.where(jnp.arange(k)[None, :] < i, dists, jnp.inf)
+        d = jnp.maximum(jnp.min(dists, axis=1), 0.0) * row_ok
+        key, sk = jax.random.split(key)
+        idx = jax.random.categorical(sk, jnp.log(d + 1e-30))
+        return centers.at[i].set(x[idx]), key
+
+    centers, key = lax.fori_loop(1, k, seed_step, (centers0, key))
+
+    # --- Lloyd iterations ---
+    def lloyd(centers, _):
+        d = _sq_dists(x, centers)
+        assign = jax.nn.one_hot(jnp.argmin(d, axis=1), k) * row_ok[:, None]
+        counts = constrain(jnp.sum(assign, axis=0))  # psum over 'data'
+        sums = constrain(assign.T @ x)
+        new = sums / jnp.maximum(counts, 1.0)[:, None]
+        # keep old center for empty clusters
+        new = jnp.where((counts > 0)[:, None], new, centers)
+        return new, None
+
+    centers, _ = lax.scan(lloyd, centers, None, length=iters)
+    return centers

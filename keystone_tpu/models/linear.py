@@ -1,0 +1,138 @@
+"""Exact least-squares solvers.
+
+Reference: nodes/learning/LinearMapper.scala § LinearMapEstimator /
+LinearMapper and nodes/learning/LocalLeastSquaresEstimator.scala.
+
+The reference computes per-partition ``AᵀA`` / ``Aᵀb`` gemms, treeReduces
+them to the driver, Cholesky-solves there, and broadcasts the model.  Here
+the whole fit is ONE jitted program: the einsum contraction over the
+row-sharded batch axis becomes an XLA all-reduce over ICI, and the solve
+runs replicated on every device — no driver round-trip exists at all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.models.common import solve_spd, xtx_xty
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import LabelEstimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class LinearMapper(Transformer):
+    """Applies ``xW + b`` (nodes/learning/LinearMapper.scala § LinearMapper)."""
+
+    def __init__(self, weights: jnp.ndarray, intercept: Optional[jnp.ndarray] = None):
+        self.weights = weights
+        self.intercept = intercept
+
+    def apply_one(self, x):
+        out = x @ self.weights
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+    def apply_batch(self, xs, mask=None):
+        out = xs @ self.weights
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+
+class LinearMapEstimator(LabelEstimator):
+    """Exact ridge least squares via normal equations
+    (nodes/learning/LinearMapper.scala § LinearMapEstimator).
+
+    With ``fit_intercept`` the solve runs on (weighted-)centered data and
+    recovers the intercept as ``ȳ − x̄·W``, matching the reference's
+    mean-subtraction path.
+    """
+
+    def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
+        self.lam = float(lam)
+        self.fit_intercept = fit_intercept
+
+    def params(self):
+        return (self.lam, self.fit_intercept)
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
+        if labels is None:
+            raise ValueError("LinearMapEstimator requires labels")
+        w, b = _fit_normal_equations(
+            data.array,
+            labels.array,
+            jnp.float32(data.n),
+            self.lam,
+            self.fit_intercept,
+        )
+        return LinearMapper(w, b if self.fit_intercept else None)
+
+    def fit_arrays(self, x, y=None) -> LinearMapper:
+        x = jnp.asarray(x)
+        w, b = _fit_normal_equations(
+            x, jnp.asarray(y), jnp.float32(x.shape[0]), self.lam, self.fit_intercept
+        )
+        return LinearMapper(w, b if self.fit_intercept else None)
+
+
+#: Alias matching common usage in reference pipelines.
+LeastSquaresEstimator = LinearMapEstimator
+
+
+class LocalLeastSquaresEstimator(LabelEstimator):
+    """Single-device exact solve via QR/SVD lstsq — the physical
+    alternative the optimizer picks for small data
+    (nodes/learning/LocalLeastSquaresEstimator.scala).  No collectives:
+    everything is gathered to one device, like the reference's
+    ``collect()`` + LAPACK path."""
+
+    def __init__(self, lam: float = 0.0):
+        self.lam = float(lam)
+
+    def params(self):
+        return (self.lam,)
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
+        x = jnp.asarray(data.numpy())
+        y = jnp.asarray(labels.numpy())
+        return self.fit_arrays(x, y)
+
+    def fit_arrays(self, x, y=None) -> LinearMapper:
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        xm = jnp.mean(x, axis=0)
+        ym = jnp.mean(y, axis=0)
+        xc, yc = x - xm, y - ym
+        if self.lam > 0.0:
+            w = solve_spd(xc.T @ xc, xc.T @ yc, reg=self.lam * x.shape[0])
+        else:
+            w = jnp.linalg.lstsq(xc, yc)[0]
+        return LinearMapper(w, ym - xm @ w)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _fit_normal_equations(x, y, n, lam, fit_intercept):
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    if fit_intercept:
+        # Means over the true row count: padding rows are zero, so plain
+        # sums divided by n are exact.
+        xm = jnp.sum(x, axis=0) / n
+        ym = jnp.sum(y, axis=0) / n
+        xtx, xty = xtx_xty(x, y)
+        # Centered Gramian over the TRUE rows from raw padded sums:
+        # Σᵢ≤n (xᵢ−x̄)(xᵢ−x̄)ᵀ = XᵀX − n·x̄x̄ᵀ, exact because pad rows are 0
+        # and contribute nothing to XᵀX.
+        xtx_c = xtx - n * jnp.outer(xm, xm)
+        xty_c = xty - n * jnp.outer(xm, ym)
+        w = solve_spd(xtx_c, xty_c, reg=lam * n)
+        b = ym - xm @ w
+        return w, b
+    xtx, xty = xtx_xty(x, y)
+    w = solve_spd(xtx, xty, reg=lam * n)
+    return w, jnp.zeros((y.shape[1],), jnp.float32)

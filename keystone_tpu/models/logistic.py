@@ -1,0 +1,100 @@
+"""Logistic regression via L-BFGS.
+
+Reference: nodes/learning/LogisticRegressionEstimator.scala — wraps Spark
+MLlib's LogisticRegressionWithLBFGS (Amazon-reviews pipeline).  Here the
+softmax cross-entropy objective plugs directly into the same jitted
+L-BFGS machinery as the least-squares solvers; gradients contract over the
+row-sharded batch (all-reduce over ICI).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.models.common import constrain
+from keystone_tpu.models.lbfgs import lbfgs_minimize
+from keystone_tpu.parallel.mesh import DATA_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import LabelEstimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class LogisticRegressionModel(Transformer):
+    def __init__(self, weights: jnp.ndarray):
+        self.weights = weights  # (d, K)
+
+    def apply_batch(self, xs, mask=None):
+        return xs @ self.weights  # logits; MaxClassifier takes argmax
+
+    def apply_one(self, x):
+        return x @ self.weights
+
+    def predict_proba(self, xs):
+        return jax.nn.softmax(xs @ self.weights, axis=-1)
+
+
+class LogisticRegressionEstimator(LabelEstimator):
+    """labels: int class ids (n,) or indicator matrix (n, K)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        lam: float = 0.0,
+        num_iters: int = 100,
+        history: int = 10,
+    ):
+        self.num_classes = int(num_classes)
+        self.lam = float(lam)
+        self.num_iters = int(num_iters)
+        self.history = int(history)
+
+    def params(self):
+        return (self.num_classes, self.lam, self.num_iters, self.history)
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
+        if labels is None:
+            raise ValueError("LogisticRegressionEstimator requires labels")
+        return self._fit(data.array, labels.array, data.n)
+
+    def fit_arrays(self, x, y=None):
+        x = jnp.asarray(x, jnp.float32)
+        return self._fit(x, jnp.asarray(y), x.shape[0])
+
+    def _fit(self, x, y, n):
+        y = jnp.asarray(y)
+        if y.ndim == 1:
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_classes)
+        else:
+            onehot = (y > 0).astype(jnp.float32)
+        w = _logreg_fit(
+            jnp.asarray(x, jnp.float32),
+            onehot,
+            jnp.float32(n),
+            self.lam,
+            self.num_iters,
+            self.history,
+        )
+        return LogisticRegressionModel(w)
+
+
+@partial(jax.jit, static_argnames=("num_iters", "history"))
+def _logreg_fit(x, onehot, n, lam, num_iters, history):
+    x = constrain(x, DATA_AXIS)
+    row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)
+    onehot = onehot * row_ok[:, None]
+
+    def value_and_grad(w):
+        logits = x @ w
+        lse = jax.scipy.special.logsumexp(logits, axis=1)
+        ll = jnp.sum(logits * onehot, axis=1) - lse * row_ok
+        f = -jnp.sum(ll) / n + 0.5 * lam * jnp.vdot(w, w)
+        p = jax.nn.softmax(logits, axis=1) * row_ok[:, None]
+        g = constrain(x.T @ (p - onehot)) / n + lam * w
+        return f, g
+
+    w0 = jnp.zeros((x.shape[1], onehot.shape[1]), jnp.float32)
+    return lbfgs_minimize(value_and_grad, w0, max_iter=num_iters, history=history)

@@ -1,0 +1,80 @@
+"""Multinomial naive Bayes.
+
+Reference: nodes/learning/NaiveBayes.scala § NaiveBayesEstimator — a port
+of MLlib's multinomial NB used as the Newsgroups pipeline's alternative
+head.  Log priors + smoothed log conditionals; the model transformer
+outputs per-class log-posterior scores (argmax-compatible with
+MaxClassifier).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.models.common import constrain
+from keystone_tpu.parallel.mesh import DATA_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import LabelEstimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class NaiveBayesModel(Transformer):
+    def __init__(self, log_prior: jnp.ndarray, log_cond: jnp.ndarray):
+        self.log_prior = log_prior  # (K,)
+        self.log_cond = log_cond  # (K, d)
+
+    def apply_batch(self, xs, mask=None):
+        return xs @ self.log_cond.T + self.log_prior
+
+    def apply_one(self, x):
+        return x @ self.log_cond.T + self.log_prior
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """labels: int class ids (n,) or one-hot/±1 indicator matrix (n, K)."""
+
+    def __init__(self, num_classes: int, lam: float = 1.0):
+        self.num_classes = int(num_classes)
+        self.lam = float(lam)  # additive smoothing
+
+    def params(self):
+        return (self.num_classes, self.lam)
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
+        if labels is None:
+            raise ValueError("NaiveBayesEstimator requires labels")
+        return self._fit(data.array, labels.array, data.n)
+
+    def fit_arrays(self, x, y=None):
+        x = jnp.asarray(x, jnp.float32)
+        return self._fit(x, jnp.asarray(y), x.shape[0])
+
+    def _fit(self, x, y, n):
+        lp, lc = _nb_fit(x, _to_onehot(y, self.num_classes), jnp.float32(n), self.lam)
+        return NaiveBayesModel(lp, lc)
+
+
+def _to_onehot(y, k):
+    y = jnp.asarray(y)
+    if y.ndim == 1:
+        return jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
+    return (y > 0).astype(jnp.float32)
+
+
+@jax.jit
+def _nb_fit(x, onehot, n, lam):
+    x = constrain(x.astype(jnp.float32), DATA_AXIS)
+    row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)
+    onehot = onehot * row_ok[:, None]
+    class_counts = constrain(jnp.sum(onehot, axis=0))  # (K,)
+    feat_counts = constrain(onehot.T @ x)  # (K, d) — treeAggregate analogue
+    log_prior = jnp.log(jnp.maximum(class_counts, 1e-10)) - jnp.log(n)
+    smoothed = feat_counts + lam
+    log_cond = jnp.log(smoothed) - jnp.log(
+        jnp.sum(smoothed, axis=1, keepdims=True)
+    )
+    return log_prior, log_cond
