@@ -1,0 +1,156 @@
+"""Headline benchmark — ImageNet FV pipeline throughput (images/sec/chip).
+
+Measures the north-star path (BASELINE.md): dense SIFT → PCA → GMM Fisher
+vector → power/L2 normalization → block-linear scoring, end to end on
+device, steady-state, on one TPU chip.  ``vs_baseline`` is the speedup
+against the same JAX program on one host CPU (the closest stand-in for
+the reference's BLAS-on-CPU executors; the reference repo publishes no
+numbers — BASELINE.json "published": {}).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Usage: python bench.py            # TPU (or default backend) + cached CPU baseline
+       python bench.py --cpu     # run the CPU-baseline leg only (prints ips)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+BATCH = 64
+IMAGE_HW = 64
+GMM_K = 64
+PCA_DIMS = 64
+NUM_CLASSES = 1000
+WARMUP = 2
+ITERS = 8
+_BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
+
+
+def build_forward():
+    import jax.numpy as jnp
+
+    from keystone_tpu.models.block_ls import BlockLinearMapper
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.ops import (
+        GrayScaler,
+        NormalizeRows,
+        SIFTExtractor,
+        SignedHellingerMapper,
+    )
+    from keystone_tpu.ops.fisher import FisherVector
+
+    rng = np.random.default_rng(0)
+    sift = SIFTExtractor(step=6, bin_sizes=(4,))
+    pca = PCATransformer(
+        jnp.asarray(np.linalg.qr(rng.normal(size=(128, PCA_DIMS)))[0], jnp.float32),
+        mean=jnp.zeros((128,), jnp.float32),
+    )
+    gmm = GaussianMixtureModel(
+        jnp.full((GMM_K,), 1.0 / GMM_K, jnp.float32),
+        jnp.asarray(rng.normal(size=(GMM_K, PCA_DIMS)), jnp.float32),
+        jnp.ones((GMM_K, PCA_DIMS), jnp.float32),
+    )
+    fv_dim = 2 * GMM_K * PCA_DIMS
+    block = 4096
+    nb = -(-fv_dim // block)
+    blm = BlockLinearMapper(
+        jnp.asarray(
+            0.01 * rng.normal(size=(nb, block, NUM_CLASSES)), jnp.float32
+        ),
+        block,
+    )
+    gray, hell, norm = GrayScaler(), SignedHellingerMapper(), NormalizeRows()
+    fv = FisherVector(gmm)
+
+    def forward(images):
+        g = gray.apply_batch(images)
+        desc, mask = sift.apply_batch(g)
+        desc, mask = pca.apply_batch(desc, mask=mask)
+        feats = fv.apply_batch(desc, mask=mask)
+        feats = norm.apply_batch(hell.apply_batch(feats))
+        return blm.apply_batch(feats)
+
+    return forward
+
+
+def measure_ips(batch: int, iters: int, warmup: int) -> float:
+    import jax
+
+    forward = jax.jit(build_forward())
+    images = np.random.default_rng(1).uniform(
+        0, 1, (batch, IMAGE_HW, IMAGE_HW, 3)
+    ).astype(np.float32)
+    import jax.numpy as jnp
+
+    images = jnp.asarray(images)
+    for _ in range(warmup):
+        forward(images).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = forward(images)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def cpu_baseline_ips() -> float:
+    if os.path.exists(_BASELINE_CACHE):
+        try:
+            with open(_BASELINE_CACHE) as f:
+                return float(json.load(f)["ips"])
+        except Exception:
+            pass
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cpu"],
+        capture_output=True,
+        text=True,
+        timeout=3600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        ips = float(json.loads(line)["cpu_ips"])
+    except Exception:
+        sys.stderr.write(f"cpu baseline failed: {proc.stderr[-500:]}\n")
+        return 0.0
+    with open(_BASELINE_CACHE, "w") as f:
+        json.dump({"ips": ips}, f)
+    return ips
+
+
+def main():
+    if "--cpu" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        ips = measure_ips(batch=16, iters=2, warmup=1)
+        print(json.dumps({"cpu_ips": ips}))
+        return
+
+    import jax
+
+    ips = measure_ips(BATCH, ITERS, WARMUP)
+    cpu_ips = cpu_baseline_ips()
+    vs = ips / cpu_ips if cpu_ips > 0 else None
+    print(
+        json.dumps(
+            {
+                "metric": "imagenet_fv_pipeline_throughput",
+                "value": round(ips, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(vs, 2) if vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

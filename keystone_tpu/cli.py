@@ -1,0 +1,42 @@
+"""CLI dispatcher — the bin/run-pipeline.sh analogue.
+
+    python -m keystone_tpu.cli <PipelineName> [pipeline flags...]
+    python -m keystone_tpu.cli --list
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+_PIPELINE_MODULES = {
+    "MnistRandomFFT": "keystone_tpu.pipelines.mnist_random_fft",
+    "LinearPixels": "keystone_tpu.pipelines.linear_pixels",
+    "RandomPatchCifar": "keystone_tpu.pipelines.random_patch_cifar",
+    "NewsgroupsPipeline": "keystone_tpu.pipelines.newsgroups",
+    "TimitPipeline": "keystone_tpu.pipelines.timit",
+    "ImageNetSiftLcsFV": "keystone_tpu.pipelines.imagenet_sift_lcs_fv",
+    "VOCSIFTFisher": "keystone_tpu.pipelines.voc_sift_fisher",
+    "AmazonReviewsPipeline": "keystone_tpu.pipelines.amazon_reviews",
+}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
+        print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
+        print("pipelines:")
+        for name in _PIPELINE_MODULES:
+            print(f"  {name}")
+        return 0
+    name, rest = argv[0], argv[1:]
+    if name not in _PIPELINE_MODULES:
+        print(f"unknown pipeline {name!r}; use --list", file=sys.stderr)
+        return 2
+    mod = importlib.import_module(_PIPELINE_MODULES[name])
+    mod.main(rest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+from keystone_tpu.evaluation.evaluators import (  # noqa: F401
+    AugmentedExamplesEvaluator,
+    BinaryClassificationMetrics,
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)

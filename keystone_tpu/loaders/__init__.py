@@ -1,0 +1,19 @@
+"""Dataset loaders (reference src/main/scala/loaders/).
+
+Every loader returns :class:`LabeledData` (label + datum Datasets, the
+loaders/LabeledData.scala analogue).  Because this environment ships no
+datasets, each loader also has a ``synthetic(...)`` constructor producing
+statistically-plausible data with the real format's shapes — pipelines
+and benchmarks run against these when the real files are absent.
+"""
+
+from keystone_tpu.loaders.labeled import LabeledData  # noqa: F401
+from keystone_tpu.loaders.csv_loader import CsvDataLoader  # noqa: F401
+from keystone_tpu.loaders.mnist import MnistLoader  # noqa: F401
+from keystone_tpu.loaders.cifar import CifarLoader  # noqa: F401
+from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader  # noqa: F401
+from keystone_tpu.loaders.timit import TimitFeaturesDataLoader  # noqa: F401
+from keystone_tpu.loaders.imagenet import ImageNetLoader  # noqa: F401
+from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader  # noqa: F401
+from keystone_tpu.loaders.voc import VOCLoader  # noqa: F401
+from keystone_tpu.loaders.stream import ShardedBatchStream  # noqa: F401

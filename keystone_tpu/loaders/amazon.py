@@ -1,0 +1,46 @@
+"""Amazon reviews loader (reference loaders/AmazonReviewsDataLoader.scala):
+JSON-lines reviews; binary label = rating ≥ 4 (the reference thresholds
+star ratings for its binary classification pipeline)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+
+class AmazonReviewsDataLoader:
+    @staticmethod
+    def load(path: str, threshold: float = 3.5) -> LabeledData:
+        texts, labels = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                texts.append(rec.get("reviewText", rec.get("text", "")))
+                rating = float(rec.get("overall", rec.get("rating", 0.0)))
+                labels.append(1 if rating > threshold else 0)
+        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))
+
+    @staticmethod
+    def synthetic(n: int = 600, seed: int = 0) -> LabeledData:
+        rng = np.random.default_rng(seed)
+        pos = ["great", "excellent", "love", "perfect", "amazing", "best"]
+        neg = ["terrible", "broken", "waste", "awful", "disappointed", "worst"]
+        neutral = [f"filler{i}" for i in range(40)]
+        texts, labels = [], []
+        for _ in range(n):
+            lab = int(rng.integers(0, 2))
+            vocab = pos if lab else neg
+            words = list(rng.choice(vocab, size=int(rng.integers(3, 8)))) + list(
+                rng.choice(neutral, size=int(rng.integers(10, 25)))
+            )
+            rng.shuffle(words)
+            texts.append(" ".join(words))
+            labels.append(lab)
+        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))

@@ -1,0 +1,52 @@
+"""CIFAR-10 binary loader (reference loaders/CifarLoader.scala).
+
+Format: records of 3073 bytes — 1 label byte + 3×32×32 pixel bytes in
+channel-major (R plane, G plane, B plane) order; emitted as NHWC floats.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+NUM_CLASSES = 10
+H = W = 32
+C = 3
+RECORD = 1 + H * W * C
+
+
+class CifarLoader:
+    @staticmethod
+    def load(path: str) -> LabeledData:
+        raw = np.fromfile(path, dtype=np.uint8)
+        if raw.size % RECORD != 0:
+            raise ValueError(f"{path}: size {raw.size} not a multiple of {RECORD}")
+        recs = raw.reshape(-1, RECORD)
+        labels = recs[:, 0].astype(np.int32)
+        pixels = recs[:, 1:].reshape(-1, C, H, W).transpose(0, 2, 3, 1)
+        return LabeledData(
+            Dataset(pixels.astype(np.float32) / 255.0), Dataset(labels)
+        )
+
+    @staticmethod
+    def synthetic(n: int = 1024, seed: int = 0) -> LabeledData:
+        """Class-colored noise images in [0,1] NHWC."""
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, NUM_CLASSES, size=n)
+        # fixed prototype generator: train/test share the class structure
+        base = (
+            np.random.default_rng(1234)
+            .uniform(0.2, 0.8, size=(NUM_CLASSES, 1, 1, C))
+            .astype(np.float32)
+        )
+        x = base[labels] + rng.normal(0, 0.15, size=(n, H, W, C)).astype(np.float32)
+        # add class-dependent spatial structure (a bright patch per class)
+        for k in range(NUM_CLASSES):
+            idx = labels == k
+            y0, x0 = 3 * (k % 3) + 4, 3 * (k // 3) + 4
+            x[idx, y0 : y0 + 6, x0 : x0 + 6, :] += 0.5
+        return LabeledData(Dataset(np.clip(x, 0, 1)), Dataset(labels.astype(np.int32)))

@@ -1,0 +1,29 @@
+"""CSV row loader (reference loaders/CsvDataLoader.scala)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+
+class CsvDataLoader:
+    """CSV rows → feature vectors; optionally the first column is the label
+    (the MNIST pipeline's input format: label, 784 pixels)."""
+
+    @staticmethod
+    def load(path: str, label_col: int = 0, delimiter: str = ",") -> LabeledData:
+        mat = np.loadtxt(path, delimiter=delimiter, dtype=np.float32)
+        if mat.ndim == 1:
+            mat = mat[None, :]
+        labels = mat[:, label_col].astype(np.int32)
+        feats = np.delete(mat, label_col, axis=1)
+        return LabeledData(Dataset(feats), Dataset(labels))
+
+    @staticmethod
+    def load_unlabeled(path: str, delimiter: str = ",") -> Dataset:
+        mat = np.loadtxt(path, delimiter=delimiter, dtype=np.float32)
+        if mat.ndim == 1:
+            mat = mat[None, :]
+        return Dataset(mat)

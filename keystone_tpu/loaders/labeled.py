@@ -1,0 +1,47 @@
+"""LabeledData — (labels, data) pair (reference loaders/LabeledData.scala)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.workflow.dataset import Dataset, as_dataset
+
+
+@dataclasses.dataclass
+class LabeledData:
+    data: Dataset
+    labels: Dataset
+
+    @classmethod
+    def of(cls, data, labels) -> "LabeledData":
+        return cls(as_dataset(data), as_dataset(labels))
+
+    @property
+    def n(self) -> int:
+        return self.data.n
+
+    def split(self, fraction: float, seed: int = 0):
+        """Deterministic train/test split (host-side shuffle)."""
+        if self.data.is_host:
+            idx = np.random.default_rng(seed).permutation(self.n)
+            cut = int(self.n * fraction)
+            items = self.data.items
+            labs = self.labels.numpy()
+            a = LabeledData(
+                Dataset([items[i] for i in idx[:cut]]), Dataset(labs[idx[:cut]])
+            )
+            b = LabeledData(
+                Dataset([items[i] for i in idx[cut:]]), Dataset(labs[idx[cut:]])
+            )
+            return a, b
+        idx = np.random.default_rng(seed).permutation(self.n)
+        cut = int(self.n * fraction)
+        x = self.data.numpy()
+        y = self.labels.numpy()
+        return (
+            LabeledData(Dataset(x[idx[:cut]]), Dataset(y[idx[:cut]])),
+            LabeledData(Dataset(x[idx[cut:]]), Dataset(y[idx[cut:]])),
+        )

@@ -1,0 +1,41 @@
+"""MNIST loader (CSV format, as the reference's MnistRandomFFT consumes it
+via loaders/CsvDataLoader.scala: rows of `label, 784 pixel values`)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import CsvDataLoader
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+NUM_CLASSES = 10
+DIM = 784
+
+
+class MnistLoader:
+    @staticmethod
+    def load(path: str) -> LabeledData:
+        return CsvDataLoader.load(path, label_col=0)
+
+    @staticmethod
+    def synthetic(n: int = 2048, seed: int = 0) -> LabeledData:
+        """Class-dependent blobs in 784-d pixel space, scaled like MNIST
+        (pixels in [0, 255])."""
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, NUM_CLASSES, size=n)
+        # class prototypes come from a FIXED generator so different seeds
+        # draw train/test samples from the same distribution
+        prototypes = (
+            np.random.default_rng(1234)
+            .uniform(0, 255, size=(NUM_CLASSES, DIM))
+            .astype(np.float32)
+        )
+        # low-rank structure + noise so linear models are non-trivial
+        x = prototypes[labels] * 0.3 + rng.normal(0, 25.0, size=(n, DIM)).astype(
+            np.float32
+        )
+        x = np.clip(x, 0, 255)
+        return LabeledData(Dataset(x), Dataset(labels.astype(np.int32)))

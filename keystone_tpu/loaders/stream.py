@@ -1,0 +1,79 @@
+"""Out-of-core streaming input pipeline.
+
+The reference streams data through Spark partitions (RDD iterators,
+executor-side decode — SURVEY.md §2.5, §3.4); the TPU equivalent feeds the
+chip from host shards with decode/transform on host threads overlapping
+device compute (the role grain plays in TPU stacks; implemented here
+directly since grain isn't in this image — double-buffered producer
+threads + ``jax.device_put`` onto the mesh's 'data' sharding).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from keystone_tpu.parallel import mesh as _mesh
+
+
+class ShardedBatchStream:
+    """Iterate device-resident batches from a host record source.
+
+    source: an iterable of numpy batches (or a callable returning such an
+    iterator, so the stream is re-iterable).  Each batch is host-processed
+    by ``transform`` on a worker thread, then device_put with the batch
+    axis sharded over 'data'.
+    """
+
+    def __init__(
+        self,
+        source,
+        transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        prefetch: int = 2,
+    ):
+        self._source = source
+        self._transform = transform
+        self._prefetch = max(1, int(prefetch))
+
+    def _iterator(self) -> Iterator[np.ndarray]:
+        src = self._source() if callable(self._source) else iter(self._source)
+        return iter(src)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
+        sentinel = object()
+        err: list = []
+
+        def produce():
+            try:
+                for batch in self._iterator():
+                    if self._transform is not None:
+                        batch = self._transform(batch)
+                    q.put(batch)
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield _mesh.shard_batch(item)
+
+
+def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndarray]]:
+    """Re-iterable batch source over an in-memory array."""
+
+    def gen():
+        for i in range(0, len(array), batch_size):
+            yield array[i : i + batch_size]
+
+    return gen

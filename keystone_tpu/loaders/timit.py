@@ -1,0 +1,47 @@
+"""TIMIT frame loader (reference loaders/TimitFeaturesDataLoader.scala):
+pre-extracted MFCC frames (440-d: 40-d filterbank × 11-frame context
+window in the standard prep) with per-frame labels over 147 phone states.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+NUM_CLASSES = 147
+DIM = 440
+
+
+class TimitFeaturesDataLoader:
+    @staticmethod
+    def load(features_path: str, labels_path: str) -> LabeledData:
+        """features: CSV/NPY (n, 440); labels: one int per line/entry."""
+        feats = (
+            np.load(features_path)
+            if features_path.endswith(".npy")
+            else np.loadtxt(features_path, delimiter=",", dtype=np.float32)
+        )
+        labels = (
+            np.load(labels_path)
+            if labels_path.endswith(".npy")
+            else np.loadtxt(labels_path, dtype=np.int64)
+        )
+        return LabeledData(
+            Dataset(feats.astype(np.float32)),
+            Dataset(labels.astype(np.int32)),
+        )
+
+    @staticmethod
+    def synthetic(n: int = 4096, num_classes: int = NUM_CLASSES, seed: int = 0) -> LabeledData:
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, num_classes, size=n)
+        # fixed prototype generator: train/test share the class structure
+        prototypes = (
+            np.random.default_rng(1234)
+            .normal(size=(num_classes, DIM))
+            .astype(np.float32)
+        )
+        x = prototypes[labels] + 0.8 * rng.normal(size=(n, DIM)).astype(np.float32)
+        return LabeledData(Dataset(x), Dataset(labels.astype(np.int32)))

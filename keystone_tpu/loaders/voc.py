@@ -1,0 +1,74 @@
+"""PASCAL VOC 2007 loader (reference loaders/VOCLoader.scala): JPEG images
++ multilabel annotations (20 classes; an image carries every class whose
+XML annotation names it)."""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import xml.etree.ElementTree as ET
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.workflow.dataset import Dataset
+
+VOC_CLASSES = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+NUM_CLASSES = len(VOC_CLASSES)
+
+
+class VOCLoader:
+    @staticmethod
+    def load(
+        images_dir: str,
+        annotations_dir: str,
+        size: Tuple[int, int] = (256, 256),
+        limit: Optional[int] = None,
+    ) -> LabeledData:
+        from keystone_tpu.loaders.imagenet import _decode_jpeg
+
+        cls_index = {c: i for i, c in enumerate(VOC_CLASSES)}
+        images, labels = [], []
+        for fname in sorted(os.listdir(annotations_dir)):
+            if not fname.endswith(".xml"):
+                continue
+            stem = os.path.splitext(fname)[0]
+            jpg = os.path.join(images_dir, stem + ".jpg")
+            if not os.path.exists(jpg):
+                continue
+            tree = ET.parse(os.path.join(annotations_dir, fname))
+            multilabel = np.zeros((NUM_CLASSES,), np.float32)
+            for obj in tree.findall(".//object/name"):
+                idx = cls_index.get(obj.text)
+                if idx is not None:
+                    multilabel[idx] = 1.0
+            with open(jpg, "rb") as f:
+                images.append(_decode_jpeg(f.read(), size))
+            labels.append(multilabel)
+            if limit is not None and len(images) >= limit:
+                break
+        x = np.stack(images) if images else np.zeros((0, *size, 3), np.float32)
+        y = np.stack(labels) if labels else np.zeros((0, NUM_CLASSES), np.float32)
+        return LabeledData(Dataset(x), Dataset(y))
+
+    @staticmethod
+    def synthetic(
+        n: int = 48, size: Tuple[int, int] = (64, 64), seed: int = 0
+    ) -> LabeledData:
+        from keystone_tpu.loaders.imagenet import ImageNetLoader
+
+        base = ImageNetLoader.synthetic(n=n, num_classes=NUM_CLASSES, size=size, seed=seed)
+        single = base.labels.numpy()
+        multi = np.zeros((n, NUM_CLASSES), np.float32)
+        multi[np.arange(n), single] = 1.0
+        # occasionally add a second label, as VOC images are multilabel
+        rng = np.random.default_rng(seed + 1)
+        extra = rng.integers(0, NUM_CLASSES, size=n)
+        mask = rng.random(n) < 0.3
+        multi[np.arange(n)[mask], extra[mask]] = 1.0
+        return LabeledData(base.data, Dataset(multi))

@@ -100,6 +100,9 @@ def _offset(weights, feature_mean, intercept):
     off = 0.0
     if feature_mean is not None:
         nb, bs, k = weights.shape
+        pad = nb * bs - feature_mean.shape[0]
+        if pad > 0:  # mean given at true d; weights are block-padded
+            feature_mean = jnp.pad(feature_mean, (0, pad))
         off = off - feature_mean @ weights.reshape(nb * bs, k)
     if intercept is not None:
         off = off + intercept

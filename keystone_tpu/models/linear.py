@@ -98,6 +98,8 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         return (self.lam,)
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
+        if labels is None:
+            raise ValueError("LocalLeastSquaresEstimator requires labels")
         x = jnp.asarray(data.numpy())
         y = jnp.asarray(labels.numpy())
         return self.fit_arrays(x, y)
@@ -124,12 +126,13 @@ def _fit_normal_equations(x, y, n, lam, fit_intercept):
         # sums divided by n are exact.
         xm = jnp.sum(x, axis=0) / n
         ym = jnp.sum(y, axis=0) / n
-        xtx, xty = xtx_xty(x, y)
-        # Centered Gramian over the TRUE rows from raw padded sums:
-        # Σᵢ≤n (xᵢ−x̄)(xᵢ−x̄)ᵀ = XᵀX − n·x̄x̄ᵀ, exact because pad rows are 0
-        # and contribute nothing to XᵀX.
-        xtx_c = xtx - n * jnp.outer(xm, xm)
-        xty_c = xty - n * jnp.outer(xm, ym)
+        # Center EXPLICITLY before the Gramian (pad rows masked back to 0).
+        # The algebraic shortcut XᵀX − n·x̄x̄ᵀ cancels catastrophically in
+        # f32 when feature magnitudes are large (e.g. 0–255 pixels).
+        row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)[:, None]
+        xc = (x - xm) * row_ok
+        yc = (y - ym) * row_ok
+        xtx_c, xty_c = xtx_xty(xc, yc)
         w = solve_spd(xtx_c, xty_c, reg=lam * n)
         b = ym - xm @ w
         return w, b

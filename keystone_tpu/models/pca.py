@@ -101,8 +101,12 @@ def _pca_fit(x, n, dims, center):
 def _pca_cov_fit(x, n, dims, center):
     x = constrain(x, DATA_AXIS)
     mean = jnp.sum(x, axis=0) / n
-    gram = constrain(x.T @ x)  # treeReduce analogue
-    cov = gram / n - (jnp.outer(mean, mean) if center else 0.0)
+    # center explicitly (pad rows re-masked to zero): the gram/n − x̄x̄ᵀ
+    # shortcut cancels catastrophically in f32 at large feature magnitudes
+    if center:
+        row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)[:, None]
+        x = (x - mean) * row_ok
+    cov = constrain(x.T @ x) / n  # treeReduce analogue
     evals, evecs = jnp.linalg.eigh(cov)
     comp = evecs[:, ::-1][:, :dims]  # descending eigenvalue order
     return comp, mean

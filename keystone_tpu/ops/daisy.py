@@ -1,0 +1,127 @@
+"""DAISY dense descriptors.
+
+Reference: nodes/images/DaisyExtractor.scala — pure-Scala DAISY
+(oriented-gradient maps, Gaussian pooling at increasing scales, sampled at
+a center + concentric rings; Tola et al. 2010).
+
+TPU form: orientation maps are rectified directional gradients; each
+ring's Gaussian pooling is one separable depthwise conv; ring samples are
+static gathers.  Descriptor dim = (1 + rings·ring_points)·orientations
+(default (1+3·8)·8 = 200).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class DaisyExtractor(Transformer):
+    """Input: grayscale (n, H, W).  Output: ((n, K, D), mask)."""
+
+    fusable = False
+
+    def __init__(
+        self,
+        step: int = 4,
+        radius: int = 15,
+        rings: int = 3,
+        ring_points: int = 8,
+        orientations: int = 8,
+    ):
+        self.step = int(step)
+        self.radius = int(radius)
+        self.rings = int(rings)
+        self.ring_points = int(ring_points)
+        self.orientations = int(orientations)
+
+    def params(self):
+        return (self.step, self.radius, self.rings, self.ring_points, self.orientations)
+
+    @property
+    def descriptor_dim(self) -> int:
+        return (1 + self.rings * self.ring_points) * self.orientations
+
+    def apply_batch(self, xs, mask=None):
+        xs = jnp.asarray(xs, jnp.float32)
+        if xs.ndim == 4 and xs.shape[-1] == 1:
+            xs = xs[..., 0]
+        out = _daisy(
+            xs, self.step, self.radius, self.rings, self.ring_points, self.orientations
+        )
+        return out, jnp.ones(out.shape[:2], jnp.float32)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0][0]
+
+
+def _gauss_kernel(sigma: float) -> np.ndarray:
+    r = max(1, int(3.0 * sigma))
+    x = np.arange(-r, r + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return k / k.sum()
+
+
+def _sep_gauss(omap, sigma):
+    """Separable Gaussian depthwise blur of (n, h, w, o) maps."""
+    o = omap.shape[-1]
+    k1 = jnp.asarray(_gauss_kernel(sigma))
+    kh = k1.reshape(-1, 1, 1, 1) * jnp.eye(o)[None, None]
+    kw = k1.reshape(1, -1, 1, 1) * jnp.eye(o)[None, None]
+    out = lax.conv_general_dilated(
+        omap, kh, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    return lax.conv_general_dilated(
+        out, kw, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+@partial(jax.jit, static_argnames=("step", "radius", "rings", "ring_points", "orients"))
+def _daisy(imgs, step, radius, rings, ring_points, orients):
+    n, h, w = imgs.shape
+    dy = jnp.pad(imgs[:, 2:, :] - imgs[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
+    dx = jnp.pad(imgs[:, :, 2:] - imgs[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
+    # oriented gradient maps: max(0, cos(θ−o_k))·|g| == max(0, g·u_k)
+    angles = np.arange(orients) * 2.0 * np.pi / orients
+    ux = jnp.asarray(np.cos(angles), jnp.float32)
+    uy = jnp.asarray(np.sin(angles), jnp.float32)
+    omap = jnp.maximum(dx[..., None] * ux + dy[..., None] * uy, 0.0)
+
+    # Gaussian pooling per ring (σ grows with radius, as in DAISY)
+    ring_radii = [radius * (i + 1) / rings for i in range(rings)]
+    sigmas = [max(0.5, rr / 2.0) for rr in [radius / rings] + ring_radii[:-1]]
+    center_sigma = max(0.5, radius / (2.0 * rings))
+    blurred = [_sep_gauss(omap, center_sigma)]
+    for s in sigmas[1:] + [max(0.5, ring_radii[-1] / 2.0)]:
+        blurred.append(_sep_gauss(omap, s))
+
+    margin = int(radius + 3 * max(0.5, ring_radii[-1] / 2.0)) + 1
+    ys = np.arange(margin, h - margin, step, dtype=np.int32)
+    xs_ = np.arange(margin, w - margin, step, dtype=np.int32)
+    if len(ys) == 0 or len(xs_) == 0:
+        return jnp.zeros((n, 0, (1 + rings * ring_points) * orients), jnp.float32)
+
+    pieces = [blurred[0][:, jnp.asarray(ys), :, :][:, :, jnp.asarray(xs_), :]]
+    for ri, rr in enumerate(ring_radii):
+        bmap = blurred[min(ri + 1, len(blurred) - 1)]
+        for p in range(ring_points):
+            a = 2.0 * np.pi * p / ring_points
+            oy = int(round(rr * np.sin(a)))
+            ox = int(round(rr * np.cos(a)))
+            pieces.append(
+                bmap[:, jnp.asarray(ys + oy), :, :][:, :, jnp.asarray(xs_ + ox), :]
+            )
+    stacked = jnp.stack(pieces, axis=3)  # (n, Ky, Kx, P, o)
+    ky, kx = len(ys), len(xs_)
+    desc = stacked.reshape(n, ky * kx, -1)
+    # per-histogram L2 normalization (DAISY normalizes each histogram)
+    dd = desc.reshape(n, ky * kx, -1, orients)
+    dd = dd / jnp.maximum(jnp.linalg.norm(dd, axis=-1, keepdims=True), 1e-8)
+    return dd.reshape(n, ky * kx, -1)

@@ -1,0 +1,118 @@
+"""Fisher-vector encoding.
+
+Reference: nodes/images/external/FisherVector.scala +
+GMMFisherVectorEstimator → JNI utils/external/EncEval.scala (C++ GMM EM +
+FV encode; SURVEY.md §2.8 "must get first-class TPU-era equivalents").
+
+FV of a descriptor set {x_t} against a diagonal GMM (w, μ, σ²)
+(Perronnin–Sánchez improved Fisher vector):
+
+    γ_tk   = posterior responsibility of component k for x_t
+    Φ¹_k   = 1/(T·√w_k)    · Σ_t γ_tk (x_t − μ_k)/σ_k
+    Φ²_k   = 1/(T·√(2w_k)) · Σ_t γ_tk ((x_t − μ_k)²/σ²_k − 1)
+
+concatenated to a 2·K·D vector per image.  Power/L2 normalization are the
+separate SignedHellingerMapper / NormalizeRows nodes, as in the reference
+pipeline.  The encode is a batched einsum over (n, max_k, d) ragged
+descriptor sets with masks — MXU-shaped, replacing the per-image C++ loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.models.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class FisherVector(Transformer):
+    """Input: ragged ((n, max_k, d), mask) descriptor sets.
+    Output: dense (n, 2·K·D) Fisher vectors."""
+
+    fusable = False
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+
+    def params(self):
+        return (id(self.gmm),)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 2:
+            xs = xs[None]
+            squeeze = True
+        else:
+            squeeze = False
+        if mask is None:
+            mask = jnp.ones(xs.shape[:2], jnp.float32)
+        out = _fisher_encode(
+            xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
+        )
+        return out[0] if squeeze else out
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None].reshape(1, *jnp.asarray(x).shape))[0]
+
+
+class GMMFisherVectorEstimator(Estimator):
+    """Fits the GMM vocabulary on (sampled) descriptors and returns the
+    FisherVector transformer (nodes/images/external/GMMFisherVectorEstimator)."""
+
+    def __init__(self, k: int, max_iterations: int = 25, seed: int = 0):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.seed = int(seed)
+
+    def params(self):
+        return (self.k, self.max_iterations, self.seed)
+
+    def fit_dataset(self, data: Dataset) -> FisherVector:
+        gmm = GaussianMixtureModelEstimator(
+            self.k, max_iterations=self.max_iterations, seed=self.seed
+        ).fit_dataset(data)
+        return FisherVector(gmm)
+
+    def fit_arrays(self, x) -> FisherVector:
+        gmm = GaussianMixtureModelEstimator(
+            self.k, max_iterations=self.max_iterations, seed=self.seed
+        ).fit_arrays(x)
+        return FisherVector(gmm)
+
+
+@jax.jit
+def _fisher_encode(xs, mask, w, mu, var):
+    """xs: (n, T, d); mask: (n, T); w: (K,); mu, var: (K, d)."""
+    sigma = jnp.sqrt(var)  # (K, d)
+    # responsibilities, batched over images
+    from keystone_tpu.models.gmm import _log_gaussians
+
+    n, t, d = xs.shape
+    flat = xs.reshape(n * t, d)
+    lg = _log_gaussians(flat, mu, var, jnp.log(w))  # (n*t, K)
+    lr = lg - jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
+    gamma = (jnp.exp(lr).reshape(n, t, -1)) * mask[..., None]  # (n, T, K)
+
+    counts = jnp.maximum(jnp.sum(mask, axis=1), 1.0)  # (n,) = T per image
+
+    # standardized descriptors per component: (x − μ_k)/σ_k
+    # Σ_t γ_tk x_t  and  Σ_t γ_tk x_t²  via einsum (MXU), then recombine
+    s0 = jnp.einsum("ntk->nk", gamma)  # (n, K)
+    s1 = jnp.einsum("ntk,ntd->nkd", gamma, xs)
+    s2 = jnp.einsum("ntk,ntd->nkd", gamma, xs * xs)
+
+    # Φ¹ = (s1 − s0·μ)/σ;  Φ² = (s2 − 2μ·s1 + s0·μ²)/σ² − s0
+    phi1 = (s1 - s0[..., None] * mu) / sigma
+    phi2 = (s2 - 2.0 * mu * s1 + s0[..., None] * (mu * mu)) / var - s0[..., None]
+
+    tnorm = counts[:, None, None]
+    phi1 = phi1 / (tnorm * jnp.sqrt(w)[None, :, None])
+    phi2 = phi2 / (tnorm * jnp.sqrt(2.0 * w)[None, :, None])
+    k, dd = mu.shape
+    return jnp.concatenate(
+        [phi1.reshape(n, k * dd), phi2.reshape(n, k * dd)], axis=1
+    )

@@ -1,0 +1,290 @@
+"""Image feature ops (reference src/main/scala/nodes/images/).
+
+Images are batched NHWC float arrays.  The reference's per-image
+im2col + BLAS gemm loops (executor map tasks) become whole-batch XLA
+convolutions that tile directly onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class Convolver(Transformer):
+    """Convolution of K learned/random filters over images
+    (nodes/images/Convolver.scala — the CIFAR feature extractor).
+
+    ``filters``: (num_filters, fh, fw, c).  The reference's optional patch
+    whitening is folded into the filters/offset via
+    :meth:`from_whitened_patches`: convolving ZCA-whitened patches with
+    raw filters equals convolving raw patches with ``W_zca·filters`` plus
+    a constant offset — one gemm instead of two.
+    """
+
+    def __init__(self, filters: jnp.ndarray, stride: int = 1, offset=None):
+        self.filters = jnp.asarray(filters, jnp.float32)
+        self.stride = int(stride)
+        self.offset = offset  # (num_filters,) additive term
+
+    @classmethod
+    def from_whitened_patches(
+        cls, patches: jnp.ndarray, whitener, patch_shape, stride: int = 1
+    ) -> "Convolver":
+        """Build from flat random patches + a fitted ZCAWhitener
+        (RandomPatchCifar pattern): filters = (W_zca · Pᵀ) reshaped,
+        offset = −mean·W_zca·Pᵀ."""
+        fh, fw, c = patch_shape
+        p = jnp.asarray(patches, jnp.float32)  # (K, fh*fw*c), whitened space
+        w_eff = whitener.whitener @ p.T  # (d, K)
+        offset = -(whitener.mean @ w_eff)  # (K,)
+        filters = w_eff.T.reshape(-1, fh, fw, c)
+        return cls(filters, stride=stride, offset=offset)
+
+    def params(self):
+        return (self.filters.shape, id(self.filters), self.stride)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 3:
+            xs = xs[..., None]
+        rhs = jnp.transpose(self.filters, (1, 2, 3, 0))  # HWIO
+        out = lax.conv_general_dilated(
+            xs.astype(jnp.float32),
+            rhs,
+            window_strides=(self.stride, self.stride),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.offset is not None:
+            out = out + self.offset
+        return out
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class Pooler(Transformer):
+    """Spatial pooling over a grid with a pluggable pixel function
+    (nodes/images/Pooler.scala): out[g] = Σ_{p∈cell g} pixel_fn(x[p])."""
+
+    def __init__(
+        self,
+        stride: int,
+        pool_size: int,
+        pixel_fn: Optional[Callable] = None,
+        pool_mode: str = "sum",
+    ):
+        self.stride = int(stride)
+        self.pool_size = int(pool_size)
+        self.pixel_fn = pixel_fn
+        self.pool_mode = pool_mode
+
+    def params(self):
+        return (self.stride, self.pool_size, self.pool_mode, self.pixel_fn is None)
+
+    def apply_batch(self, xs, mask=None):
+        x = xs.astype(jnp.float32)
+        if self.pixel_fn is not None:
+            x = self.pixel_fn(x)
+        dims = (1, self.pool_size, self.pool_size, 1)
+        strides = (1, self.stride, self.stride, 1)
+        if self.pool_mode == "sum":
+            return lax.reduce_window(x, 0.0, lax.add, dims, strides, "VALID")
+        if self.pool_mode == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, "VALID")
+        raise ValueError(f"unknown pool mode {self.pool_mode}")
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class SymmetricRectifier(Transformer):
+    """Channel-doubling rectifier [max(0, x−α), max(0, −x−α)]
+    (nodes/images/SymmetricRectifier.scala)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = float(max_val)
+        self.alpha = float(alpha)
+
+    def params(self):
+        return (self.max_val, self.alpha)
+
+    def apply_batch(self, xs, mask=None):
+        pos = jnp.maximum(xs - self.alpha, self.max_val)
+        neg = jnp.maximum(-xs - self.alpha, self.max_val)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class GrayScaler(Transformer):
+    """NHWC → NHW luminance via channel mean (nodes/images/GrayScaler.scala)."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 3 or xs.shape[-1] == 1:
+            return xs.reshape(xs.shape[:3])
+        return jnp.mean(xs, axis=-1)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class ImageVectorizer(Transformer):
+    """Image → flat vector (nodes/images/ImageVectorizer.scala)."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        return xs.reshape(xs.shape[0], -1)
+
+    def apply_one(self, x):
+        return x.reshape(-1)
+
+
+class PixelScaler(Transformer):
+    """uint8 pixels → [0,1] floats (nodes/images/PixelScaler.scala)."""
+
+    def __init__(self, scale: float = 255.0):
+        self.scale = float(scale)
+
+    def params(self):
+        return (self.scale,)
+
+    def apply_batch(self, xs, mask=None):
+        return xs.astype(jnp.float32) / self.scale
+
+    def apply_one(self, x):
+        return jnp.asarray(x, jnp.float32) / self.scale
+
+
+class Windower(Transformer):
+    """Sliding-window patch extraction (nodes/images/Windower.scala):
+    (n, H, W, C) → (n, num_windows, wh·ww·C) flat patches."""
+
+    def __init__(self, step: int, window_size: int):
+        self.step = int(step)
+        self.window_size = int(window_size)
+
+    def params(self):
+        return (self.step, self.window_size)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 3:
+            xs = xs[..., None]
+        n, h, w, c = xs.shape
+        ws = self.window_size
+        patches = lax.conv_general_dilated_patches(
+            xs.astype(jnp.float32),
+            filter_shape=(ws, ws),
+            window_strides=(self.step, self.step),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # (n, H', W', C*ws*ws) with feature index (c, dy, dx)
+        hp, wp = patches.shape[1], patches.shape[2]
+        # reorder feature dim (c, dy, dx) -> (dy, dx, c) to match
+        # row-major patch flattening
+        patches = patches.reshape(n, hp * wp, c, ws, ws)
+        patches = jnp.transpose(patches, (0, 1, 3, 4, 2))
+        return patches.reshape(n, hp * wp, ws * ws * c)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class RandomPatcher(Transformer):
+    """Random patch extraction (nodes/images/RandomPatcher.scala):
+    (n, H, W, C) → (n·num_patches, ph·pw·C) — train-time feature learning."""
+
+    fusable = False
+
+    def __init__(self, num_patches: int, patch_h: int, patch_w: int, seed: int = 0):
+        self.num_patches = int(num_patches)
+        self.patch_h = int(patch_h)
+        self.patch_w = int(patch_w)
+        self.seed = int(seed)
+
+    def params(self):
+        return (self.num_patches, self.patch_h, self.patch_w, self.seed)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 3:
+            xs = xs[..., None]
+        return _random_patches(
+            xs.astype(jnp.float32),
+            self.num_patches,
+            self.patch_h,
+            self.patch_w,
+            jax.random.PRNGKey(self.seed),
+        )
+
+    def apply_dataset(self, ds):
+        out = self.apply_batch(ds.array[: ds.n])
+        from keystone_tpu.workflow.dataset import Dataset
+
+        return Dataset(out)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+@partial(jax.jit, static_argnames=("k", "ph", "pw"))
+def _random_patches(xs, k, ph, pw, key):
+    n, h, w, c = xs.shape
+    ky, kx = jax.random.split(key)
+    ys = jax.random.randint(ky, (n, k), 0, h - ph + 1)
+    xoff = jax.random.randint(kx, (n, k), 0, w - pw + 1)
+
+    def one(img, yy, xx):
+        def slice_one(y0, x0):
+            return lax.dynamic_slice(img, (y0, x0, 0), (ph, pw, c))
+
+        return jax.vmap(slice_one)(yy, xx)
+
+    patches = jax.vmap(one)(xs, ys, xoff)  # (n, k, ph, pw, c)
+    return patches.reshape(n * k, ph * pw * c)
+
+
+class CenterCornerPatcher(Transformer):
+    """Center + 4 corner crops, optionally horizontally flipped
+    (nodes/images/CenterCornerPatcher.scala) — the 10-view test-time
+    augmentation for ImageNet.  Output: (n, num_views, ph, pw, C)."""
+
+    def __init__(self, patch_h: int, patch_w: int, horizontal_flips: bool = False):
+        self.patch_h = int(patch_h)
+        self.patch_w = int(patch_w)
+        self.horizontal_flips = horizontal_flips
+
+    def params(self):
+        return (self.patch_h, self.patch_w, self.horizontal_flips)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 3:
+            xs = xs[..., None]
+        n, h, w, c = xs.shape
+        ph, pw = self.patch_h, self.patch_w
+        starts = [
+            (0, 0),
+            (0, w - pw),
+            (h - ph, 0),
+            (h - ph, w - pw),
+            ((h - ph) // 2, (w - pw) // 2),
+        ]
+        views = [xs[:, y : y + ph, x : x + pw, :] for (y, x) in starts]
+        if self.horizontal_flips:
+            views = views + [v[:, :, ::-1, :] for v in views]
+        return jnp.stack(views, axis=1)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]

@@ -1,0 +1,232 @@
+"""Text / NLP nodes.
+
+Reference: src/main/scala/nodes/nlp/ (Tokenizer, LowerCase, Trim,
+NGramsFeaturizer, NGramsCounts, StupidBackoff, NGramIndexer) and
+nodes/misc/ (TermFrequency, CommonSparseFeatures).
+
+Strings are host objects; these nodes run on the host side of the input
+pipeline and hand dense arrays to the device at the CommonSparseFeatures /
+HashingTF boundary (TPUs want dense MXU tiles — Densify is built in here
+rather than a separate physical cast).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter, defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class Trimmer(Transformer):
+    """Strip leading/trailing whitespace (nodes/nlp/Trim)."""
+
+    is_host = True
+
+    def params(self):
+        return ()
+
+    def apply_one(self, s: str) -> str:
+        return s.strip()
+
+
+class LowerCase(Transformer):
+    is_host = True
+
+    def params(self):
+        return ()
+
+    def apply_one(self, s: str) -> str:
+        return s.lower()
+
+
+class Tokenizer(Transformer):
+    """Regex tokenization (nodes/nlp/Tokenizer.scala; default splits on
+    non-word chars like the reference's "[\\s]+"-style patterns)."""
+
+    is_host = True
+
+    def __init__(self, pattern: str = r"[^a-zA-Z0-9']+"):
+        self.pattern = pattern
+        self._re = re.compile(pattern)
+
+    def params(self):
+        return (self.pattern,)
+
+    def apply_one(self, s: str) -> List[str]:
+        return [t for t in self._re.split(s) if t]
+
+
+class NGramsFeaturizer(Transformer):
+    """tokens → all n-grams for n in ``orders``
+    (nodes/nlp/NGramsFeaturizer.scala)."""
+
+    is_host = True
+
+    def __init__(self, orders: Sequence[int] = (1, 2)):
+        self.orders = tuple(int(n) for n in orders)
+
+    def params(self):
+        return (self.orders,)
+
+    def apply_one(self, tokens: List[str]) -> List[Tuple[str, ...]]:
+        out: List[Tuple[str, ...]] = []
+        for n in self.orders:
+            out.extend(
+                tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)
+            )
+        return out
+
+
+class TermFrequency(Transformer):
+    """n-gram list → {ngram: weighted count}
+    (nodes/misc/TermFrequency.scala; ``fn`` e.g. log1p for log-tf)."""
+
+    is_host = True
+
+    def __init__(self, fn: Optional[Callable[[float], float]] = None):
+        self.fn = fn
+
+    def params(self):
+        return None if self.fn is not None else ("identity",)
+
+    def apply_one(self, ngrams: List) -> Dict:
+        counts = Counter(ngrams)
+        if self.fn is None:
+            return dict(counts)
+        return {k: self.fn(float(v)) for k, v in counts.items()}
+
+
+class CommonSparseFeaturesModel(Transformer):
+    """doc term-dict → dense row over the learned vocabulary."""
+
+    is_host = True
+    fusable = False
+
+    def __init__(self, vocab: Dict, num_features: int):
+        self.vocab = vocab
+        self.num_features = int(num_features)
+
+    def apply_one(self, term_dict: Dict) -> np.ndarray:
+        row = np.zeros((self.num_features,), np.float32)
+        for term, val in term_dict.items():
+            idx = self.vocab.get(term)
+            if idx is not None:
+                row[idx] = val
+        return row
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        rows = np.stack([self.apply_one(d) for d in ds.items])
+        return Dataset(rows)
+
+
+class CommonSparseFeatures(Estimator):
+    """Vocabulary = top-k terms by document frequency
+    (nodes/misc/CommonSparseFeatures.scala).  The fitted transformer emits
+    dense rows (the TPU-side representation; see module docstring)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = int(num_features)
+
+    def params(self):
+        return (self.num_features,)
+
+    def fit_dataset(self, data: Dataset) -> CommonSparseFeaturesModel:
+        return self.fit_arrays(data.items)
+
+    def fit_arrays(self, docs: Iterable[Dict]) -> CommonSparseFeaturesModel:
+        df: Counter = Counter()
+        for d in docs:
+            df.update(set(d.keys()))
+        top = [t for t, _ in df.most_common(self.num_features)]
+        vocab = {t: i for i, t in enumerate(top)}
+        return CommonSparseFeaturesModel(vocab, self.num_features)
+
+
+class HashingTF(Transformer):
+    """Feature hashing to a fixed dimension — the scale-friendly
+    alternative to CommonSparseFeatures (no fitted vocabulary; same role
+    as Spark's HashingTF, which the reference text pipelines predate)."""
+
+    is_host = True
+    fusable = False
+
+    def __init__(self, num_features: int = 2**16):
+        self.num_features = int(num_features)
+
+    def params(self):
+        return (self.num_features,)
+
+    def apply_one(self, term_dict: Dict) -> np.ndarray:
+        row = np.zeros((self.num_features,), np.float32)
+        for term, val in term_dict.items():
+            row[hash(term) % self.num_features] += val
+        return row
+
+
+class NGramsCounts(Transformer):
+    """Corpus-level n-gram count aggregation
+    (nodes/nlp/NGramsCounts.scala): dataset of n-gram lists → one Counter.
+    A host-side reduction (the reference's reduceByKey)."""
+
+    is_host = True
+    fusable = False
+
+    def params(self):
+        return ()
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        total: Counter = Counter()
+        for ngrams in ds.items:
+            total.update(ngrams)
+        return ds.with_items([total])
+
+    def apply_one(self, ngrams):
+        return Counter(ngrams)
+
+
+class StupidBackoffLM(Transformer):
+    """Stupid-backoff n-gram scorer (nodes/nlp/StupidBackoff.scala):
+
+        S(w_i | w_{i−n+1..i−1}) = count(ngram)/count(context) if seen,
+        else α · S(w_i | shorter context), bottoming out at unigram
+        frequency; α = 0.4 (Brants et al. 2007).
+    """
+
+    is_host = True
+    fusable = False
+
+    def __init__(self, counts: Dict[Tuple[str, ...], int], alpha: float = 0.4):
+        self.counts = dict(counts)
+        self.alpha = float(alpha)
+        self.total_unigrams = sum(
+            v for k, v in self.counts.items() if len(k) == 1
+        )
+        # context counts: sum over last word
+        self._context: Dict[Tuple[str, ...], int] = defaultdict(int)
+        for k, v in self.counts.items():
+            if len(k) >= 2:
+                self._context[k[:-1]] += v
+
+    def params(self):
+        return None
+
+    def score(self, ngram: Tuple[str, ...]) -> float:
+        ngram = tuple(ngram)
+        if len(ngram) == 1:
+            if self.total_unigrams == 0:
+                return 0.0
+            return self.counts.get(ngram, 0) / self.total_unigrams
+        c = self.counts.get(ngram, 0)
+        ctx = self._context.get(ngram[:-1], 0)
+        if c > 0 and ctx > 0:
+            return c / ctx
+        return self.alpha * self.score(ngram[1:])
+
+    def apply_one(self, ngram):
+        return self.score(tuple(ngram))

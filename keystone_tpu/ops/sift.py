@@ -1,0 +1,142 @@
+"""Dense SIFT.
+
+Reference: nodes/images/external/SIFTExtractor.scala → JNI
+utils/external/VLFeat.scala (``vl_dsift_*`` C library; params: step,
+scales, bin size; returns 128 × #keypoints per image).  SURVEY.md §2.8
+calls for a first-class TPU-era equivalent; this is dense SIFT as
+vectorized JAX: gradient → 8-orientation soft binning → triangular
+spatial windowing as a depthwise conv → 4×4 bin grid gather → the
+standard SIFT normalize (L2, clamp 0.2, re-L2).  The whole extractor is
+one jitted program over the batch; per-image descriptor counts are fixed
+by the image size, so outputs are dense (n, K, 128) with an all-ones
+mask joining the ragged pipeline downstream.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.transformer import Transformer
+
+_NUM_ORIENTATIONS = 8
+_GRID = 4  # 4x4 spatial bins -> 128-d descriptors
+
+
+class SIFTExtractor(Transformer):
+    """Dense SIFT descriptors on a keypoint grid.
+
+    Input: grayscale images (n, H, W).  Output: ragged-style
+    ((n, K, 128), mask) descriptor sets, K = Σ_scales Ky·Kx.
+    """
+
+    fusable = False
+
+    def __init__(self, step: int = 4, bin_sizes: Sequence[int] = (4,)):
+        self.step = int(step)
+        self.bin_sizes = tuple(int(b) for b in bin_sizes)
+
+    def params(self):
+        return (self.step, self.bin_sizes)
+
+    def apply_batch(self, xs, mask=None):
+        xs = jnp.asarray(xs, jnp.float32)
+        if xs.ndim == 4 and xs.shape[-1] == 1:
+            xs = xs[..., 0]
+        descs = []
+        for b in self.bin_sizes:
+            descs.append(_dsift(xs, self.step, b))
+        out = jnp.concatenate(descs, axis=1)
+        return out, jnp.ones(out.shape[:2], jnp.float32)
+
+    def apply_one(self, x):
+        d, m = self.apply_batch(x[None])
+        return d[0]
+
+
+def _triangular_kernel(bin_size: int) -> np.ndarray:
+    """VLFeat's bilinear spatial window: support 2·bin_size−1."""
+    r = np.arange(1 - bin_size, bin_size, dtype=np.float32)
+    return np.maximum(0.0, 1.0 - np.abs(r) / bin_size)
+
+
+def _keypoint_grid(extent: int, step: int, bin_size: int) -> np.ndarray:
+    """Descriptor-center coordinates along one axis.
+
+    A descriptor centered at c covers c ± (2·bin_size − 0.5) pixels
+    (4 bins of bin_size with the triangular window); keep centers whose
+    support fits in the image.
+    """
+    margin = 2 * bin_size
+    lo, hi = margin, extent - margin
+    if hi <= lo:
+        return np.zeros((0,), np.int32)
+    return np.arange(lo, hi, step, dtype=np.int32)
+
+
+@partial(jax.jit, static_argnames=("step", "bin_size"))
+def _dsift(imgs, step, bin_size):
+    n, h, w = imgs.shape
+
+    # --- gradients (central differences, like vl_dsift's gradient) ---
+    dy = jnp.pad(imgs[:, 2:, :] - imgs[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
+    dx = jnp.pad(imgs[:, :, 2:] - imgs[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
+    mag = jnp.sqrt(dx * dx + dy * dy)
+    ang = jnp.arctan2(dy, dx)  # [-pi, pi]
+
+    # --- soft orientation binning (linear interp between adjacent bins) ---
+    o = _NUM_ORIENTATIONS
+    theta = (ang % (2 * jnp.pi)) * (o / (2 * jnp.pi))  # [0, 8)
+    lo_bin = jnp.floor(theta)
+    frac = theta - lo_bin
+    lo_bin = lo_bin.astype(jnp.int32) % o
+    hi_bin = (lo_bin + 1) % o
+    bins = jnp.arange(o)[None, None, None, :]
+    omap = mag[..., None] * (
+        (bins == lo_bin[..., None]) * (1.0 - frac[..., None])
+        + (bins == hi_bin[..., None]) * frac[..., None]
+    )  # (n, h, w, 8)
+
+    # --- spatial triangular windowing: separable depthwise conv ---
+    k1 = jnp.asarray(_triangular_kernel(bin_size))
+    kh = k1.reshape(-1, 1, 1, 1) * jnp.eye(o)[None, None]  # (kh, 1, 8, 8)
+    kw = k1.reshape(1, -1, 1, 1) * jnp.eye(o)[None, None]
+    smoothed = lax.conv_general_dilated(
+        omap, kh, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    smoothed = lax.conv_general_dilated(
+        smoothed, kw, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+    # --- gather 4x4 bin responses around each keypoint ---
+    ys = jnp.asarray(_keypoint_grid(h, step, bin_size))
+    xs_ = jnp.asarray(_keypoint_grid(w, step, bin_size))
+    # bin-center offsets relative to the keypoint: (-1.5,-0.5,.5,1.5)*bin
+    offs = ((jnp.arange(_GRID) - (_GRID - 1) / 2.0) * bin_size).astype(jnp.int32)
+    yy = (ys[:, None] + offs[None, :]).reshape(-1)  # (Ky*4,)
+    xx = (xs_[:, None] + offs[None, :]).reshape(-1)  # (Kx*4,)
+    g = smoothed[:, yy, :, :][:, :, xx, :]  # (n, Ky*4, Kx*4, 8)
+    ky, kx = ys.shape[0], xs_.shape[0]
+    g = g.reshape(n, ky, _GRID, kx, _GRID, o)
+    desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(n, ky * kx, _GRID * _GRID * o)
+
+    # --- SIFT normalization: L2 -> clamp 0.2 -> L2 ---
+    def l2(v):
+        return v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-8)
+
+    desc = l2(desc)
+    desc = jnp.minimum(desc, 0.2)
+    return l2(desc)
+
+
+def sift_output_count(h: int, w: int, step: int, bin_sizes: Sequence[int]) -> int:
+    return sum(
+        len(_keypoint_grid(h, step, b)) * len(_keypoint_grid(w, step, b))
+        for b in bin_sizes
+    )

@@ -1,0 +1,289 @@
+"""Statistical feature ops (reference src/main/scala/nodes/stats/).
+
+All device ops are natively batched (apply_batch on the sharded (n, d)
+array) and fusable, so chains like RandomSign → PaddedFFT → Rectifier
+compile into one XLA stage.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.models.common import constrain
+from keystone_tpu.parallel.mesh import DATA_AXIS
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.estimator import Estimator
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features: cos(x·Wᵀ + b)
+    (nodes/stats/CosineRandomFeatures.scala — TIMIT's featurizer).
+
+    W rows ~ Gaussian(0, γ) for the RBF kernel or Cauchy(0, γ) for the
+    Laplacian kernel; b ~ Uniform[0, 2π].
+    """
+
+    def __init__(self, w: jnp.ndarray, b: jnp.ndarray):
+        self.w = w  # (num_out, num_in)
+        self.b = b  # (num_out,)
+
+    @classmethod
+    def init(
+        cls,
+        num_input_features: int,
+        num_output_features: int,
+        gamma: float = 1.0,
+        seed: int = 0,
+        distribution: str = "gaussian",
+    ) -> "CosineRandomFeatures":
+        kw, kb = jax.random.split(jax.random.PRNGKey(seed))
+        shape = (num_output_features, num_input_features)
+        if distribution == "gaussian":
+            w = gamma * jax.random.normal(kw, shape, jnp.float32)
+        elif distribution == "cauchy":
+            w = gamma * jax.random.cauchy(kw, shape, jnp.float32)
+        else:
+            raise ValueError(f"unknown distribution {distribution!r}")
+        b = jax.random.uniform(kb, (num_output_features,), jnp.float32, 0.0, 2 * np.pi)
+        return cls(w, b)
+
+    def params(self):
+        return (self.w.shape, id(self.w))
+
+    def apply_batch(self, xs, mask=None):
+        return jnp.cos(xs @ self.w.T + self.b)
+
+    def apply_one(self, x):
+        return jnp.cos(self.w @ x + self.b)
+
+
+class RandomSignNode(Transformer):
+    """Elementwise Rademacher sign flip (nodes/stats/RandomSignNode.scala);
+    paired with PaddedFFT for fastfood-style random features."""
+
+    def __init__(self, signs: jnp.ndarray):
+        self.signs = signs
+
+    @classmethod
+    def init(cls, num_features: int, seed: int = 0) -> "RandomSignNode":
+        bits = jax.random.bernoulli(jax.random.PRNGKey(seed), 0.5, (num_features,))
+        return cls(bits.astype(jnp.float32) * 2.0 - 1.0)
+
+    def params(self):
+        return (self.signs.shape[0], id(self.signs))
+
+    def apply_batch(self, xs, mask=None):
+        return xs * self.signs
+
+    def apply_one(self, x):
+        return x * self.signs
+
+
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two and take a real FFT
+    (nodes/stats/PaddedFFT.scala — MNIST's featurizer).
+
+    Output = [Re(rfft), Im(rfft)] of the positive-frequency half (the
+    reference emits the complex spectrum's components as a real vector;
+    concatenation keeps full information with static shapes).  The FFT is
+    unitary (norm="ortho") so feature magnitudes stay at the input's
+    scale — important for the f32 normal-equation solvers downstream
+    (the f64-everywhere reference didn't need this).
+    """
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        d = xs.shape[-1]
+        padded = 1 << (d - 1).bit_length()
+        xs = jnp.pad(xs, [(0, 0)] * (xs.ndim - 1) + [(0, padded - d)])
+        spec = jnp.fft.rfft(xs, axis=-1, norm="ortho")
+        return jnp.concatenate([jnp.real(spec), jnp.imag(spec)], axis=-1)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class LinearRectifier(Transformer):
+    """max(x − α, maxVal) (nodes/stats/LinearRectifier.scala)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = float(max_val)
+        self.alpha = float(alpha)
+
+    def params(self):
+        return (self.max_val, self.alpha)
+
+    def apply_batch(self, xs, mask=None):
+        return jnp.maximum(xs - self.alpha, self.max_val)
+
+    def apply_one(self, x):
+        return jnp.maximum(x - self.alpha, self.max_val)
+
+
+class SignedHellingerMapper(Transformer):
+    """sign(x)·√|x| (nodes/stats/SignedHellingerMapper.scala) — the
+    power-normalization step after Fisher-vector encoding."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        out = jnp.sign(xs) * jnp.sqrt(jnp.abs(xs))
+        return (out, mask) if mask is not None else out
+
+    def apply_one(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class NormalizeRows(Transformer):
+    """L2 row normalization (nodes/stats/NormalizeRows.scala)."""
+
+    def __init__(self, eps: float = 1e-12):
+        self.eps = float(eps)
+
+    def params(self):
+        return (self.eps,)
+
+    def apply_batch(self, xs, mask=None):
+        norm = jnp.sqrt(jnp.sum(xs * xs, axis=-1, keepdims=True))
+        out = xs / jnp.maximum(norm, self.eps)
+        return (out, mask) if mask is not None else out
+
+    def apply_one(self, x):
+        return x / jnp.maximum(jnp.sqrt(jnp.sum(x * x)), self.eps)
+
+
+class StandardScalerModel(Transformer):
+    def __init__(self, mean: jnp.ndarray, std: Optional[jnp.ndarray] = None):
+        self.mean = mean
+        self.std = std
+
+    def apply_batch(self, xs, mask=None):
+        out = xs - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class StandardScaler(Estimator):
+    """Column mean/std via sharded moment sums — the treeAggregate
+    col-stats of nodes/stats/StandardScaler.scala."""
+
+    def __init__(self, normalize_std: bool = True, eps: float = 1e-8):
+        self.normalize_std = normalize_std
+        self.eps = float(eps)
+
+    def params(self):
+        return (self.normalize_std, self.eps)
+
+    def fit_dataset(self, data: Dataset) -> StandardScalerModel:
+        return self._fit(data.array, data.n)
+
+    def fit_arrays(self, x) -> StandardScalerModel:
+        x = jnp.asarray(x, jnp.float32)
+        return self._fit(x, x.shape[0])
+
+    def _fit(self, x, n):
+        mean, std = _moments(x, jnp.float32(n))
+        if not self.normalize_std:
+            return StandardScalerModel(mean, None)
+        return StandardScalerModel(mean, jnp.maximum(std, self.eps))
+
+
+@jax.jit
+def _moments(x, n):
+    x = constrain(x.astype(jnp.float32), DATA_AXIS)
+    s1 = constrain(jnp.sum(x, axis=0))
+    s2 = constrain(jnp.sum(x * x, axis=0))
+    mean = s1 / n
+    # unbiased, like Breeze's stddev (n-1 denominator)
+    var = jnp.maximum(s2 - n * mean * mean, 0.0) / jnp.maximum(n - 1.0, 1.0)
+    return mean, jnp.sqrt(var)
+
+
+class Sampler(Transformer):
+    """Row subsampling with a fixed seed (nodes/stats/Sampler.scala);
+    used to cut datasets down for PCA/GMM fitting."""
+
+    is_host = False
+    fusable = False
+
+    def __init__(self, size: int, seed: int = 0):
+        self.size = int(size)
+        self.seed = int(seed)
+
+    def params(self):
+        return (self.size, self.seed)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        k = min(self.size, ds.n)
+        idx = np.random.default_rng(self.seed).choice(ds.n, size=k, replace=False)
+        return Dataset(np.asarray(ds.array)[np.sort(idx)])
+
+    def apply_one(self, x):
+        return x
+
+
+class ColumnSampler(Transformer):
+    """Sample ``num_samples`` descriptors per item from ragged descriptor
+    sets (nodes/stats/ColumnSampler.scala — the reference samples columns
+    of per-image descriptor matrices before PCA/GMM fitting).
+
+    Input: Dataset with array (n, max_k, d) + mask (n, max_k).
+    Output: flat dense Dataset (n·num_samples, d), sampling only valid
+    descriptors (with replacement when an item has fewer than requested).
+    """
+
+    fusable = False
+
+    def __init__(self, num_samples: int, seed: int = 0):
+        self.num_samples = int(num_samples)
+        self.seed = int(seed)
+
+    def params(self):
+        return (self.num_samples, self.seed)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        arr = ds.array
+        if arr.ndim != 3:
+            raise ValueError("ColumnSampler expects (n, max_k, d) descriptor sets")
+        n = ds.n
+        out = _sample_descriptors(
+            arr,
+            ds.mask
+            if ds.mask is not None
+            else jnp.ones(arr.shape[:2], jnp.float32),
+            self.num_samples,
+            jax.random.PRNGKey(self.seed),
+        )
+        flat = out[:n].reshape(n * self.num_samples, arr.shape[-1])
+        return Dataset(flat)
+
+    def apply_one(self, x):
+        raise TypeError("ColumnSampler operates on datasets")
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("k",))
+def _sample_descriptors(arr, mask, k, key):
+    n, max_k, d = arr.shape
+    keys = jax.random.split(key, n)
+
+    def per_item(a, m, kk):
+        logits = jnp.where(m > 0, 0.0, -jnp.inf)
+        idx = jax.random.categorical(kk, logits, shape=(k,))
+        return a[idx]
+
+    return jax.vmap(per_item)(arr, mask, keys)

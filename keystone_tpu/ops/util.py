@@ -1,0 +1,159 @@
+"""Utility nodes (reference src/main/scala/nodes/util/)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class ClassLabelIndicators(Transformer):
+    """int label(s) → ±1 indicator vector
+    (nodes/util/ClassLabelIndicators.scala) — the regression targets for
+    least-squares classifiers."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = int(num_classes)
+
+    def params(self):
+        return (self.num_classes,)
+
+    def apply_batch(self, xs, mask=None):
+        onehot = jax.nn.one_hot(xs.astype(jnp.int32), self.num_classes)
+        return onehot * 2.0 - 1.0
+
+    def apply_one(self, x):
+        return jax.nn.one_hot(jnp.asarray(x, jnp.int32), self.num_classes) * 2.0 - 1.0
+
+
+class MaxClassifier(Transformer):
+    """argmax prediction head (nodes/util/MaxClassifier.scala)."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        return jnp.argmax(xs, axis=-1)
+
+    def apply_one(self, x):
+        return jnp.argmax(x)
+
+
+class TopKClassifier(Transformer):
+    """top-k class indices, best first (nodes/util/TopKClassifier.scala);
+    feeds the ImageNet top-5 evaluator."""
+
+    def __init__(self, k: int):
+        self.k = int(k)
+
+    def params(self):
+        return (self.k,)
+
+    def apply_batch(self, xs, mask=None):
+        _, idx = jax.lax.top_k(xs, min(self.k, xs.shape[-1]))
+        return idx
+
+    def apply_one(self, x):
+        return jax.lax.top_k(x, min(self.k, x.shape[-1]))[1]
+
+
+class VectorSplitter(Transformer):
+    """(n, d) → (n, num_blocks, block_size) feature blocks
+    (nodes/util/VectorSplitter.scala).  The block solvers do this
+    internally; the node exists for explicit pipeline use."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+
+    def params(self):
+        return (self.block_size,)
+
+    def apply_batch(self, xs, mask=None):
+        n, d = xs.shape
+        nb = -(-d // self.block_size)
+        if nb * self.block_size != d:
+            xs = jnp.pad(xs, ((0, 0), (0, nb * self.block_size - d)))
+        return xs.reshape(n, nb, self.block_size)
+
+    def apply_one(self, x):
+        return self.apply_batch(x[None])[0]
+
+
+class VectorCombiner(Transformer):
+    """Inverse of VectorSplitter: (n, nb, bs) → (n, nb·bs)."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        return xs.reshape(xs.shape[0], -1)
+
+    def apply_one(self, x):
+        return x.reshape(-1)
+
+
+class Densify(Transformer):
+    """scipy.sparse rows → dense device array
+    (nodes/util/Densify.scala — physical representation cast chosen by the
+    optimizer's node-choice rule; on TPU dense is the only MXU-friendly
+    form, so this is the ingest boundary for sparse text features)."""
+
+    is_host = True
+    fusable = False
+
+    def params(self):
+        return ()
+
+    def apply_one(self, x):
+        if hasattr(x, "toarray"):
+            return np.asarray(x.toarray()).ravel().astype(np.float32)
+        return np.asarray(x, np.float32)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        items = ds.items
+        if len(items) and hasattr(items[0], "toarray"):
+            import scipy.sparse as sp
+
+            stacked = sp.vstack(items).toarray().astype(np.float32)
+            return Dataset(stacked)
+        return Dataset(np.stack([self.apply_one(x) for x in items]).astype(np.float32))
+
+
+class Sparsify(Transformer):
+    """Dense rows → scipy CSR (nodes/util/Sparsify.scala); host-side."""
+
+    is_host = True
+    fusable = False
+
+    def params(self):
+        return ()
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        import scipy.sparse as sp
+
+        mat = sp.csr_matrix(np.asarray(ds.numpy()))
+        return ds.with_items([mat[i] for i in range(mat.shape[0])])
+
+    def apply_one(self, x):
+        import scipy.sparse as sp
+
+        return sp.csr_matrix(np.asarray(x))
+
+
+class FloatToDouble(Transformer):
+    """dtype cast (nodes/util/FloatToDouble.scala).  TPUs compute in
+    f32/bf16; this is a host-boundary cast for numpy interop."""
+
+    def params(self):
+        return ()
+
+    def apply_batch(self, xs, mask=None):
+        return xs.astype(jnp.float32)
+
+    def apply_one(self, x):
+        return jnp.asarray(x, jnp.float32)

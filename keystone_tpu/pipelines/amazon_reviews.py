@@ -1,0 +1,99 @@
+"""AmazonReviewsPipeline (reference
+pipelines/text/AmazonReviewsPipeline.scala): n-grams → term frequency →
+feature hashing → logistic regression (binary sentiment)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import BinaryClassifierEvaluator
+from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader
+from keystone_tpu.models import LogisticRegressionEstimator
+from keystone_tpu.ops import (
+    HashingTF,
+    LowerCase,
+    MaxClassifier,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trimmer,
+)
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    data_path: Optional[str] = None
+    num_features: int = 16384
+    ngrams: int = 2
+    lam: float = 1e-4
+    num_iters: int = 40
+    synthetic_n: int = 600
+
+
+class AmazonReviewsPipeline:
+    name = "AmazonReviewsPipeline"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        featurizer = (
+            Pipeline.of(Trimmer())
+            .and_then(LowerCase())
+            .and_then(Tokenizer())
+            .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
+            .and_then(TermFrequency(lambda v: math.log(v + 1.0)))
+            .and_then(HashingTF(config.num_features))
+        )
+        return featurizer.and_then(
+            LogisticRegressionEstimator(
+                num_classes=2, lam=config.lam, num_iters=config.num_iters
+            ),
+            train_x,
+            train_labels,
+        ).and_then(MaxClassifier())
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.data_path:
+            data = AmazonReviewsDataLoader.load(config.data_path)
+            train, test = data.split(0.8, seed=0)
+        else:
+            train = AmazonReviewsDataLoader.synthetic(config.synthetic_n, seed=1)
+            test = AmazonReviewsDataLoader.synthetic(config.synthetic_n // 4, seed=2)
+        t0 = time.time()
+        fitted = AmazonReviewsPipeline.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        m = BinaryClassifierEvaluator().evaluate(preds, test.labels)
+        return {
+            "pipeline": AmazonReviewsPipeline.name,
+            "fit_seconds": fit_time,
+            "accuracy": m.accuracy,
+            "f1": m.f1,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=AmazonReviewsPipeline.name)
+    p.add_argument("--data-path")
+    p.add_argument("--num-features", type=int, default=16384)
+    p.add_argument("--synthetic-n", type=int, default=600)
+    a = p.parse_args(argv)
+    print(
+        AmazonReviewsPipeline.run(
+            Config(
+                data_path=a.data_path,
+                num_features=a.num_features,
+                synthetic_n=a.synthetic_n,
+            )
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""ImageNetSiftLcsFV — the north-star workload (reference
+pipelines/images/imagenet/ImageNetSiftLcsFV.scala):
+
+Two branches over the input images:
+  SIFT: GrayScaler → dense SIFT → [PCA(64) fit on sampled descriptors] →
+        [GMM(k) fit on sampled projected descriptors] → FisherVector →
+        SignedHellinger → NormalizeRows
+  LCS:  LCSExtractor → same PCA/GMM/FV tail
+concat (gather) → BlockWeightedLeastSquares → TopKClassifier(5);
+top-5 error via MulticlassClassifierEvaluator / AugmentedExamplesEvaluator.
+
+The PCA and GMM vocabulary fits happen *inside* the pipeline graph on
+ColumnSampler-reduced descriptor sets rooted at the training Dataset, so
+the CSE rule merges the shared SIFT/LCS prefixes — the featurization of
+the training set runs once even though three estimators consume it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.imagenet import ImageNetLoader
+from keystone_tpu.models import BlockWeightedLeastSquaresEstimator, PCAEstimator
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    ColumnSampler,
+    GMMFisherVectorEstimator,
+    GrayScaler,
+    LCSExtractor,
+    MaxClassifier,
+    NormalizeRows,
+    SIFTExtractor,
+    SignedHellingerMapper,
+    TopKClassifier,
+)
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_classes: int = 16
+    sift_step: int = 6
+    sift_bin_size: int = 4
+    lcs_step: int = 6
+    lcs_subpatch: int = 6
+    pca_dims: int = 64
+    gmm_k: int = 16
+    gmm_iters: int = 10
+    descriptor_samples_per_image: int = 64
+    lam: float = 1e-4
+    mixture_weight: float = 0.25
+    solver_block_size: int = 4096
+    num_epochs: int = 2
+    top_k: int = 5
+    seed: int = 0
+    synthetic_n: int = 64
+    image_size: int = 64
+
+
+def _fv_branch(base: Pipeline, config: Config, train_x: Dataset, seed: int) -> Pipeline:
+    """descriptor extractor pipeline → PCA → GMM/FV → normalization."""
+    sampled = ColumnSampler(config.descriptor_samples_per_image, seed=seed)(
+        base(train_x)
+    )
+    pca_pipe = Pipeline.from_estimator(
+        PCAEstimator(config.pca_dims, center=True), sampled
+    )
+    with_pca = base.then_pipeline(pca_pipe)
+    gmm_sampled = ColumnSampler(config.descriptor_samples_per_image, seed=seed + 1)(
+        with_pca(train_x)
+    )
+    fv_pipe = Pipeline.from_estimator(
+        GMMFisherVectorEstimator(
+            config.gmm_k, max_iterations=config.gmm_iters, seed=seed
+        ),
+        gmm_sampled,
+    )
+    return (
+        with_pca.then_pipeline(fv_pipe)
+        .and_then(SignedHellingerMapper())
+        .and_then(NormalizeRows())
+    )
+
+
+class ImageNetSiftLcsFV:
+    name = "ImageNetSiftLcsFV"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        sift_base = Pipeline.of(GrayScaler()).and_then(
+            SIFTExtractor(step=config.sift_step, bin_sizes=(config.sift_bin_size,))
+        )
+        lcs_base = Pipeline.of(
+            LCSExtractor(step=config.lcs_step, subpatch_size=config.lcs_subpatch)
+        )
+        sift_branch = _fv_branch(sift_base, config, train_x, seed=config.seed)
+        lcs_branch = _fv_branch(lcs_base, config, train_x, seed=config.seed + 100)
+        featurizer = Pipeline.gather([sift_branch, lcs_branch])
+        labels_pm1 = ClassLabelIndicators(config.num_classes)(train_labels)
+        return featurizer.and_then(
+            BlockWeightedLeastSquaresEstimator(
+                block_size=config.solver_block_size,
+                num_iter=config.num_epochs,
+                lam=config.lam,
+                mixture_weight=config.mixture_weight,
+            ),
+            train_x,
+            labels_pm1,
+        ).and_then(TopKClassifier(config.top_k))
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.train_path:
+            train = ImageNetLoader.load(config.train_path)
+            test = ImageNetLoader.load(config.test_path or config.train_path)
+        else:
+            sz = (config.image_size, config.image_size)
+            train = ImageNetLoader.synthetic(
+                config.synthetic_n, config.num_classes, size=sz, seed=1
+            )
+            test = ImageNetLoader.synthetic(
+                max(8, config.synthetic_n // 4), config.num_classes, size=sz, seed=2
+            )
+        t0 = time.time()
+        fitted = ImageNetSiftLcsFV.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        topk = fitted(test.data).get().numpy()  # (n, top_k) class ids
+        labs = test.labels.numpy()
+        top1 = topk[:, 0]
+        topk_hit = (topk == labs[:, None]).any(axis=1)
+        m = MulticlassClassifierEvaluator(config.num_classes).evaluate(top1, labs)
+        return {
+            "pipeline": ImageNetSiftLcsFV.name,
+            "fit_seconds": fit_time,
+            "top1_error": m.total_error,
+            "top5_error": float(1.0 - topk_hit.mean()),
+            "accuracy": m.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=ImageNetSiftLcsFV.name)
+    p.add_argument("--train-path")
+    p.add_argument("--test-path")
+    p.add_argument("--num-classes", type=int, default=16)
+    p.add_argument("--gmm-k", type=int, default=16)
+    p.add_argument("--pca-dims", type=int, default=64)
+    p.add_argument("--lam", type=float, default=1e-4)
+    p.add_argument("--synthetic-n", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=64)
+    a = p.parse_args(argv)
+    cfg = Config(
+        train_path=a.train_path,
+        test_path=a.test_path,
+        num_classes=a.num_classes,
+        gmm_k=a.gmm_k,
+        pca_dims=a.pca_dims,
+        lam=a.lam,
+        synthetic_n=a.synthetic_n,
+        image_size=a.image_size,
+    )
+    print(ImageNetSiftLcsFV.run(cfg))
+
+
+if __name__ == "__main__":
+    main()

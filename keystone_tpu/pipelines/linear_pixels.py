@@ -1,0 +1,71 @@
+"""LinearPixels (reference pipelines/images/cifar/LinearPixels.scala):
+the CIFAR baseline — raw pixels → exact least squares → MaxClassifier."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import CifarLoader, NUM_CLASSES
+from keystone_tpu.models import LinearMapEstimator
+from keystone_tpu.ops import ClassLabelIndicators, ImageVectorizer, MaxClassifier
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    lam: float = 1e-3
+    synthetic_n: int = 1024
+
+
+class LinearPixels:
+    name = "LinearPixels"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        labels_pm1 = ClassLabelIndicators(NUM_CLASSES)(train_labels)
+        return (
+            Pipeline.of(ImageVectorizer())
+            .and_then(LinearMapEstimator(lam=config.lam), train_x, labels_pm1)
+            .and_then(MaxClassifier())
+        )
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.train_path:
+            train = CifarLoader.load(config.train_path)
+            test = CifarLoader.load(config.test_path or config.train_path)
+        else:
+            train = CifarLoader.synthetic(config.synthetic_n, seed=1)
+            test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
+        t0 = time.time()
+        fitted = LinearPixels.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)
+        return {
+            "pipeline": LinearPixels.name,
+            "fit_seconds": fit_time,
+            "test_error": m.total_error,
+            "accuracy": m.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=LinearPixels.name)
+    p.add_argument("--train-path")
+    p.add_argument("--test-path")
+    p.add_argument("--lam", type=float, default=1e-3)
+    p.add_argument("--synthetic-n", type=int, default=1024)
+    a = p.parse_args(argv)
+    print(LinearPixels.run(Config(a.train_path, a.test_path, a.lam, a.synthetic_n)))
+
+
+if __name__ == "__main__":
+    main()

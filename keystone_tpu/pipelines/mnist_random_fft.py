@@ -1,0 +1,98 @@
+"""MnistRandomFFT (reference
+pipelines/images/mnist/MnistRandomFFT.scala): replicate
+{RandomSignNode → PaddedFFT → LinearRectifier} × num_ffts over the pixel
+vector, gather/concat, exact least squares, MaxClassifier."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.mnist import MnistLoader, NUM_CLASSES
+from keystone_tpu.models import LinearMapEstimator
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    LinearRectifier,
+    MaxClassifier,
+    PaddedFFT,
+    PixelScaler,
+    RandomSignNode,
+)
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_ffts: int = 4
+    lam: float = 1e-2
+    seed: int = 0
+    synthetic_n: int = 2048
+
+
+class MnistRandomFFT:
+    name = "MnistRandomFFT"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        dim = train_x.array.shape[1]
+        branches = [
+            Pipeline.of(RandomSignNode.init(dim, seed=config.seed + i))
+            .and_then(PaddedFFT())
+            .and_then(LinearRectifier(0.0))
+            for i in range(config.num_ffts)
+        ]
+        # pixels → [0,1] before featurizing: keeps the f32 solver's normal
+        # equations well-conditioned (the f64 reference skipped this)
+        featurizer = Pipeline.of(PixelScaler()).then_pipeline(
+            Pipeline.gather(branches)
+        )
+        labels_pm1 = ClassLabelIndicators(NUM_CLASSES)(train_labels)
+        return featurizer.and_then(
+            LinearMapEstimator(lam=config.lam), train_x, labels_pm1
+        ).and_then(MaxClassifier())
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.train_path:
+            train = MnistLoader.load(config.train_path)
+            test = MnistLoader.load(config.test_path or config.train_path)
+        else:
+            train = MnistLoader.synthetic(config.synthetic_n, seed=1)
+            test = MnistLoader.synthetic(config.synthetic_n // 4, seed=2)
+        t0 = time.time()
+        pipeline = MnistRandomFFT.build(config, train.data, train.labels)
+        fitted = pipeline.fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        metrics = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(
+            preds, test.labels
+        )
+        return {
+            "pipeline": MnistRandomFFT.name,
+            "fit_seconds": fit_time,
+            "test_error": metrics.total_error,
+            "accuracy": metrics.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=MnistRandomFFT.name)
+    p.add_argument("--train-path")
+    p.add_argument("--test-path")
+    p.add_argument("--num-ffts", type=int, default=4)
+    p.add_argument("--lam", type=float, default=1e-2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--synthetic-n", type=int, default=2048)
+    a = p.parse_args(argv)
+    cfg = Config(a.train_path, a.test_path, a.num_ffts, a.lam, a.seed, a.synthetic_n)
+    print(MnistRandomFFT.run(cfg))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""NewsgroupsPipeline (reference pipelines/text/NewsgroupsPipeline.scala):
+Trim → LowerCase → Tokenizer → NGrams(1,2) → log TermFrequency →
+CommonSparseFeatures → NaiveBayes (or least squares) → MaxClassifier."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+from keystone_tpu.models import LinearMapEstimator, NaiveBayesEstimator
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    CommonSparseFeatures,
+    LowerCase,
+    MaxClassifier,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trimmer,
+)
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    data_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_features: int = 100000
+    ngrams: int = 2
+    head: str = "nb"  # "nb" | "ls"
+    nb_lam: float = 1.0
+    ls_lam: float = 1e-2
+    num_classes: int = 4
+    synthetic_n: int = 400
+
+
+class NewsgroupsPipeline:
+    name = "NewsgroupsPipeline"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        featurizer = (
+            Pipeline.of(Trimmer())
+            .and_then(LowerCase())
+            .and_then(Tokenizer())
+            .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
+            .and_then(TermFrequency(lambda v: math.log(v + 1.0)))
+            .and_then(CommonSparseFeatures(config.num_features), train_x)
+        )
+        if config.head == "nb":
+            head = featurizer.and_then(
+                NaiveBayesEstimator(config.num_classes, lam=config.nb_lam),
+                train_x,
+                train_labels,
+            )
+        else:
+            labels_pm1 = ClassLabelIndicators(config.num_classes)(train_labels)
+            head = featurizer.and_then(
+                LinearMapEstimator(lam=config.ls_lam), train_x, labels_pm1
+            )
+        return head.and_then(MaxClassifier())
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.data_path:
+            data = NewsgroupsDataLoader.load(config.data_path)
+            num_classes = int(data.labels.numpy().max()) + 1
+            config = dataclasses.replace(config, num_classes=num_classes)
+            train, test = data.split(0.8, seed=0)
+        else:
+            train = NewsgroupsDataLoader.synthetic(
+                config.synthetic_n, config.num_classes, seed=1
+            )
+            test = NewsgroupsDataLoader.synthetic(
+                config.synthetic_n // 4, config.num_classes, seed=2
+            )
+        t0 = time.time()
+        fitted = NewsgroupsPipeline.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        m = MulticlassClassifierEvaluator(config.num_classes).evaluate(
+            preds, test.labels
+        )
+        return {
+            "pipeline": NewsgroupsPipeline.name,
+            "fit_seconds": fit_time,
+            "test_error": m.total_error,
+            "accuracy": m.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=NewsgroupsPipeline.name)
+    p.add_argument("--data-path")
+    p.add_argument("--num-features", type=int, default=100000)
+    p.add_argument("--head", choices=["nb", "ls"], default="nb")
+    p.add_argument("--synthetic-n", type=int, default=400)
+    a = p.parse_args(argv)
+    cfg = Config(
+        data_path=a.data_path,
+        num_features=a.num_features,
+        head=a.head,
+        synthetic_n=a.synthetic_n,
+    )
+    print(NewsgroupsPipeline.run(cfg))
+
+
+if __name__ == "__main__":
+    main()

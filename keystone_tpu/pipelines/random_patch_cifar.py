@@ -1,0 +1,135 @@
+"""RandomPatchCifar (reference
+pipelines/images/cifar/RandomPatchCifar.scala):
+
+RandomPatcher (patches from train images) → ZCAWhitener (fit on patches)
+→ Convolver with the whitened patches as filters → SymmetricRectifier →
+sum-Pooler over a grid → flatten/standardize → BlockLeastSquares →
+MaxClassifier.
+
+As in the reference, the filter learning (patch sampling + ZCA) happens
+imperatively at build time; the resulting Convolver folds the whitening
+into its filters (Convolver.from_whitened_patches)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import CifarLoader, NUM_CLASSES
+from keystone_tpu.models import BlockLeastSquaresEstimator, ZCAWhitenerEstimator
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    Convolver,
+    ImageVectorizer,
+    MaxClassifier,
+    Pooler,
+    RandomPatcher,
+    SymmetricRectifier,
+)
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_filters: int = 256
+    patch_size: int = 6
+    patches_per_image: int = 10
+    pool_size: int = 13
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 1e-2
+    block_size: int = 1024
+    num_iter: int = 2
+    zca_eps: float = 0.1
+    seed: int = 0
+    synthetic_n: int = 512
+
+
+class RandomPatchCifar:
+    name = "RandomPatchCifar"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        # --- feature learning (imperative, as upstream) ---
+        patcher = RandomPatcher(
+            config.patches_per_image, config.patch_size, config.patch_size,
+            seed=config.seed,
+        )
+        patches = patcher.apply_dataset(train_x)  # (n*ppi, ps*ps*3)
+        num = min(config.num_filters, patches.n)
+        flat = patches.array[:num]
+        whitener = ZCAWhitenerEstimator(eps=config.zca_eps).fit_dataset(patches)
+        white_patches = whitener.apply_batch(flat)
+        conv = Convolver.from_whitened_patches(
+            white_patches,
+            whitener,
+            (config.patch_size, config.patch_size, 3),
+        )
+        featurizer = (
+            Pipeline.of(conv)
+            .and_then(SymmetricRectifier(alpha=config.alpha))
+            .and_then(Pooler(config.pool_stride, config.pool_size))
+            .and_then(ImageVectorizer())
+        )
+        labels_pm1 = ClassLabelIndicators(NUM_CLASSES)(train_labels)
+        scaled = featurizer.and_then(StandardScaler(), train_x)
+        return scaled.and_then(
+            BlockLeastSquaresEstimator(
+                block_size=config.block_size,
+                num_iter=config.num_iter,
+                lam=config.lam,
+            ),
+            train_x,
+            labels_pm1,
+        ).and_then(MaxClassifier())
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.train_path:
+            train = CifarLoader.load(config.train_path)
+            test = CifarLoader.load(config.test_path or config.train_path)
+        else:
+            train = CifarLoader.synthetic(config.synthetic_n, seed=1)
+            test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
+        t0 = time.time()
+        fitted = RandomPatchCifar.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)
+        return {
+            "pipeline": RandomPatchCifar.name,
+            "fit_seconds": fit_time,
+            "test_error": m.total_error,
+            "accuracy": m.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=RandomPatchCifar.name)
+    p.add_argument("--train-path")
+    p.add_argument("--test-path")
+    p.add_argument("--num-filters", type=int, default=256)
+    p.add_argument("--lam", type=float, default=1e-2)
+    p.add_argument("--synthetic-n", type=int, default=512)
+    a = p.parse_args(argv)
+    cfg = Config(
+        train_path=a.train_path,
+        test_path=a.test_path,
+        num_filters=a.num_filters,
+        lam=a.lam,
+        synthetic_n=a.synthetic_n,
+    )
+    print(RandomPatchCifar.run(cfg))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""TimitPipeline (reference pipelines/speech/timit/TimitPipeline.scala):
+MFCC frames → StandardScaler → CosineRandomFeatures (in blocks, gathered)
+→ BlockWeightedLeastSquares (147 classes) → MaxClassifier."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.timit import TimitFeaturesDataLoader, DIM, NUM_CLASSES
+from keystone_tpu.models import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    CosineRandomFeatures,
+    MaxClassifier,
+)
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+@dataclasses.dataclass
+class Config:
+    features_path: Optional[str] = None
+    labels_path: Optional[str] = None
+    test_features_path: Optional[str] = None
+    test_labels_path: Optional[str] = None
+    num_cosine_features: int = 4096
+    cosine_block_size: int = 1024
+    gamma: float = 0.05
+    num_epochs: int = 3
+    lam: float = 1e-3
+    mixture_weight: float = 0.5
+    solver_block_size: int = 1024
+    num_classes: int = NUM_CLASSES
+    seed: int = 0
+    synthetic_n: int = 4096
+
+
+class TimitPipeline:
+    name = "TimitPipeline"
+    Config = Config
+
+    @staticmethod
+    def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        dim = train_x.array.shape[1]
+        num_blocks = max(1, config.num_cosine_features // config.cosine_block_size)
+        branches = [
+            Pipeline.of(
+                CosineRandomFeatures.init(
+                    dim,
+                    config.cosine_block_size,
+                    gamma=config.gamma,
+                    seed=config.seed + i,
+                )
+            )
+            for i in range(num_blocks)
+        ]
+        featurizer = Pipeline.of(StandardScaler().with_data(train_x)).then_pipeline(
+            Pipeline.gather(branches)
+        )
+        labels_pm1 = ClassLabelIndicators(config.num_classes)(train_labels)
+        return featurizer.and_then(
+            BlockWeightedLeastSquaresEstimator(
+                block_size=config.solver_block_size,
+                num_iter=config.num_epochs,
+                lam=config.lam,
+                mixture_weight=config.mixture_weight,
+            ),
+            train_x,
+            labels_pm1,
+        ).and_then(MaxClassifier())
+
+    @staticmethod
+    def run(config: Config) -> dict:
+        if config.features_path:
+            train = TimitFeaturesDataLoader.load(config.features_path, config.labels_path)
+            test = (
+                TimitFeaturesDataLoader.load(
+                    config.test_features_path, config.test_labels_path
+                )
+                if config.test_features_path
+                else train
+            )
+        else:
+            train = TimitFeaturesDataLoader.synthetic(
+                config.synthetic_n, config.num_classes, seed=1
+            )
+            test = TimitFeaturesDataLoader.synthetic(
+                config.synthetic_n // 4, config.num_classes, seed=2
+            )
+        t0 = time.time()
+        fitted = TimitPipeline.build(config, train.data, train.labels).fit()
+        fit_time = time.time() - t0
+        preds = fitted(test.data).get()
+        m = MulticlassClassifierEvaluator(config.num_classes).evaluate(
+            preds, test.labels
+        )
+        return {
+            "pipeline": TimitPipeline.name,
+            "fit_seconds": fit_time,
+            "test_error": m.total_error,
+            "accuracy": m.accuracy,
+        }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=TimitPipeline.name)
+    p.add_argument("--features-path")
+    p.add_argument("--labels-path")
+    p.add_argument("--num-cosine-features", type=int, default=4096)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lam", type=float, default=1e-3)
+    p.add_argument("--num-classes", type=int, default=NUM_CLASSES)
+    p.add_argument("--synthetic-n", type=int, default=4096)
+    a = p.parse_args(argv)
+    cfg = Config(
+        features_path=a.features_path,
+        labels_path=a.labels_path,
+        num_cosine_features=a.num_cosine_features,
+        num_epochs=a.num_epochs,
+        lam=a.lam,
+        num_classes=a.num_classes,
+        synthetic_n=a.synthetic_n,
+    )
+    print(TimitPipeline.run(cfg))
+
+
+if __name__ == "__main__":
+    main()

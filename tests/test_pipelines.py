@@ -1,0 +1,117 @@
+"""End-to-end pipeline integration tests on synthetic data (SURVEY.md §4:
+mini pipelines in local mode asserting accuracy above a threshold)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.pipelines import (
+    AmazonReviewsPipeline,
+    ImageNetSiftLcsFV,
+    LinearPixels,
+    MnistRandomFFT,
+    NewsgroupsPipeline,
+    RandomPatchCifar,
+    TimitPipeline,
+    VOCSIFTFisher,
+)
+
+
+def test_mnist_random_fft_e2e():
+    cfg = MnistRandomFFT.Config(num_ffts=2, lam=1e-2, synthetic_n=512)
+    result = MnistRandomFFT.run(cfg)
+    assert result["accuracy"] > 0.8, result
+
+
+def test_linear_pixels_e2e():
+    cfg = LinearPixels.Config(lam=1e-3, synthetic_n=256)
+    result = LinearPixels.run(cfg)
+    assert result["accuracy"] > 0.8, result
+
+
+def test_random_patch_cifar_e2e():
+    cfg = RandomPatchCifar.Config(
+        num_filters=64,
+        patches_per_image=4,
+        block_size=256,
+        num_iter=2,
+        synthetic_n=192,
+    )
+    result = RandomPatchCifar.run(cfg)
+    assert result["accuracy"] > 0.6, result
+
+
+def test_newsgroups_nb_e2e():
+    cfg = NewsgroupsPipeline.Config(
+        num_features=2000, head="nb", num_classes=4, synthetic_n=300
+    )
+    result = NewsgroupsPipeline.run(cfg)
+    assert result["accuracy"] > 0.9, result
+
+
+def test_newsgroups_ls_e2e():
+    cfg = NewsgroupsPipeline.Config(
+        num_features=2000, head="ls", num_classes=4, synthetic_n=300
+    )
+    result = NewsgroupsPipeline.run(cfg)
+    assert result["accuracy"] > 0.9, result
+
+
+def test_timit_e2e():
+    cfg = TimitPipeline.Config(
+        num_cosine_features=1024,
+        cosine_block_size=512,
+        num_epochs=2,
+        num_classes=20,
+        synthetic_n=1024,
+        lam=1e-4,
+        gamma=0.02,
+    )
+    result = TimitPipeline.run(cfg)
+    assert result["accuracy"] > 0.5, result
+
+
+def test_imagenet_sift_lcs_fv_e2e():
+    cfg = ImageNetSiftLcsFV.Config(
+        num_classes=4,
+        gmm_k=4,
+        gmm_iters=4,
+        pca_dims=16,
+        descriptor_samples_per_image=32,
+        solver_block_size=512,
+        synthetic_n=48,
+        image_size=48,
+        sift_step=8,
+        lcs_step=8,
+    )
+    result = ImageNetSiftLcsFV.run(cfg)
+    assert result["top5_error"] <= result["top1_error"] + 1e-9, result
+    assert result["accuracy"] > 0.5, result
+
+
+def test_voc_sift_fisher_e2e():
+    cfg = VOCSIFTFisher.Config(
+        gmm_k=4,
+        gmm_iters=4,
+        pca_dims=16,
+        descriptor_samples_per_image=32,
+        solver_block_size=512,
+        synthetic_n=36,
+        image_size=48,
+        sift_step=8,
+    )
+    result = VOCSIFTFisher.run(cfg)
+    assert result["mean_ap"] > 0.2, result
+
+
+def test_amazon_reviews_e2e():
+    cfg = AmazonReviewsPipeline.Config(num_features=4096, synthetic_n=400)
+    result = AmazonReviewsPipeline.run(cfg)
+    assert result["accuracy"] > 0.9, result
+
+
+def test_cli_list(capsys):
+    from keystone_tpu.cli import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "MnistRandomFFT" in out and "ImageNetSiftLcsFV" in out
