@@ -173,17 +173,22 @@ class AugmentedExamplesEvaluator:
         s = np.asarray(_maybe_numpy(scores), np.float64)
         ids = np.asarray(_maybe_numpy(image_ids))
         labs = _as_int_array(labels)
-        uniq, inverse = np.unique(ids, return_inverse=True)
+        uniq, first_idx, inverse = np.unique(
+            ids, return_index=True, return_inverse=True
+        )
         agg = np.zeros((uniq.shape[0], s.shape[1]))
         np.add.at(agg, inverse, s)
         counts = np.bincount(inverse, minlength=uniq.shape[0])[:, None]
         agg = agg / np.maximum(counts, 1)
         pred = agg.argmax(axis=1)
-        # labels must be per unique id, aligned to uniq's order
         if labs.shape[0] == uniq.shape[0]:
-            lab_per_img = labs
+            # labs are per-image in FIRST-OCCURRENCE order; np.unique's uniq
+            # is sorted — realign by each unique id's occurrence rank
+            occ_order = np.argsort(first_idx)
+            lab_per_img = np.empty_like(labs)
+            lab_per_img[occ_order] = labs
         else:
-            first_idx = np.array([np.argmax(ids == u) for u in uniq])
+            # labs are per-view: take each image's first view's label
             lab_per_img = labs[first_idx]
         cm = np.asarray(
             _confusion(jnp.asarray(pred), jnp.asarray(lab_per_img), self.num_classes)

@@ -22,6 +22,12 @@ RECORD = 1 + H * W * C
 class CifarLoader:
     @staticmethod
     def load(path: str) -> LabeledData:
+        from keystone_tpu import native
+
+        res = native.read_cifar(path)
+        if res is not None:
+            pixels, labels = res
+            return LabeledData(Dataset(pixels), Dataset(labels))
         raw = np.fromfile(path, dtype=np.uint8)
         if raw.size % RECORD != 0:
             raise ValueError(f"{path}: size {raw.size} not a multiple of {RECORD}")

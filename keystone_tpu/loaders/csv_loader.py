@@ -8,22 +8,31 @@ from keystone_tpu.loaders.labeled import LabeledData
 from keystone_tpu.workflow.dataset import Dataset
 
 
+def _read_csv_matrix(path: str, delimiter: str) -> np.ndarray:
+    """Native mmap parser when available (comma-delimited), numpy fallback."""
+    if delimiter == ",":
+        from keystone_tpu import native
+
+        mat = native.read_csv(path)
+        if mat is not None:
+            return mat
+    mat = np.loadtxt(path, delimiter=delimiter, dtype=np.float32)
+    if mat.ndim == 1:
+        mat = mat[None, :]
+    return mat
+
+
 class CsvDataLoader:
     """CSV rows → feature vectors; optionally the first column is the label
     (the MNIST pipeline's input format: label, 784 pixels)."""
 
     @staticmethod
     def load(path: str, label_col: int = 0, delimiter: str = ",") -> LabeledData:
-        mat = np.loadtxt(path, delimiter=delimiter, dtype=np.float32)
-        if mat.ndim == 1:
-            mat = mat[None, :]
+        mat = _read_csv_matrix(path, delimiter)
         labels = mat[:, label_col].astype(np.int32)
         feats = np.delete(mat, label_col, axis=1)
         return LabeledData(Dataset(feats), Dataset(labels))
 
     @staticmethod
     def load_unlabeled(path: str, delimiter: str = ",") -> Dataset:
-        mat = np.loadtxt(path, delimiter=delimiter, dtype=np.float32)
-        if mat.ndim == 1:
-            mat = mat[None, :]
-        return Dataset(mat)
+        return Dataset(_read_csv_matrix(path, delimiter))

@@ -52,16 +52,42 @@ class ImageNetLoader:
                 os.path.splitext(os.path.basename(t))[0]: i
                 for i, t in enumerate(tars)
             }
+        from keystone_tpu import native
+
         images, labels = [], []
         for t in tars:
             synset = os.path.splitext(os.path.basename(t))[0]
             lab = label_map.get(synset, 0)
+            # fast path: native tar index + threaded libjpeg batch decode
+            index = native.tar_index(t)
+            if index is not None:
+                blobs = []
+                with open(t, "rb") as f:
+                    for _, off, sz in index:
+                        if limit is not None and len(images) + len(blobs) >= limit:
+                            break
+                        f.seek(off)
+                        blobs.append(f.read(sz))
+                decoded = native.decode_jpegs(blobs, size) if blobs else None
+                if decoded is not None:
+                    imgs, ok = decoded
+                    for i in range(imgs.shape[0]):
+                        if ok[i]:
+                            images.append(imgs[i])
+                            labels.append(lab)
+                    if limit is not None and len(images) >= limit:
+                        break
+                    continue
             with tarfile.open(t) as tf:
                 for m in tf.getmembers():
                     if not m.isfile():
                         continue
                     data = tf.extractfile(m).read()
-                    images.append(_decode_jpeg(data, size))
+                    try:
+                        img = _decode_jpeg(data, size)
+                    except Exception:
+                        continue  # skip undecodable members (native-path parity)
+                    images.append(img)
                     labels.append(lab)
                     if limit is not None and len(images) >= limit:
                         break

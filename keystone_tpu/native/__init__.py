@@ -1,0 +1,196 @@
+"""ctypes binding for the native IO library (native/keystone_native.cpp).
+
+The reference loads its C++ via JNI ``System.loadLibrary`` with the .so
+bundled in jar resources (SURVEY.md §2.8); here the .so lives next to
+this module, is built lazily with ``make -C native`` on first use, and
+every entry point has a pure-Python fallback in the loaders — the
+framework works without a compiler, it's just slower at ingest.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libkeystone_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            check=True,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("native build failed: %s", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            logger.warning("could not load native library: %s", e)
+            return None
+        lib.ks_read_csv.restype = ctypes.c_int
+        lib.ks_read_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ks_read_cifar.restype = ctypes.c_int
+        lib.ks_read_cifar.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ks_tar_index.restype = ctypes.c_int
+        lib.ks_tar_index.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ks_decode_jpegs.restype = ctypes.c_int
+        lib.ks_decode_jpegs.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ]
+        lib.ks_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _take_array(lib, ptr, shape, dtype):
+    """Copy a malloc'd native buffer into numpy and free it."""
+    count = int(np.prod(shape))
+    ctype = {np.float32: ctypes.c_float, np.int32: ctypes.c_int32}[dtype]
+    arr = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
+    ).copy()
+    lib.ks_free(ptr)
+    return arr.reshape(shape).astype(dtype, copy=False)
+
+
+def read_csv(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.ks_read_csv(path.encode(), ctypes.byref(out), ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        return None
+    return _take_array(lib, out, (rows.value, cols.value), np.float32)
+
+
+def read_cifar(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    px = ctypes.POINTER(ctypes.c_float)()
+    lb = ctypes.POINTER(ctypes.c_int32)()
+    n = ctypes.c_int64()
+    rc = lib.ks_read_cifar(path.encode(), ctypes.byref(px), ctypes.byref(lb), ctypes.byref(n))
+    if rc != 0:
+        return None
+    pixels = _take_array(lib, px, (n.value, 32, 32, 3), np.float32)
+    labels = _take_array(lib, lb, (n.value,), np.int32)
+    return pixels, labels
+
+
+def tar_index(path: str) -> Optional[list]:
+    """[(name, offset, size), ...] for regular members of a tar archive."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    names = ctypes.POINTER(ctypes.c_char)()
+    offs = ctypes.POINTER(ctypes.c_int64)()
+    sizes = ctypes.POINTER(ctypes.c_int64)()
+    n = ctypes.c_int64()
+    rc = lib.ks_tar_index(
+        path.encode(), ctypes.byref(names), ctypes.byref(offs),
+        ctypes.byref(sizes), ctypes.byref(n),
+    )
+    if rc != 0:
+        return None
+    count = n.value
+    out = []
+    for i in range(count):
+        raw = ctypes.string_at(ctypes.addressof(names.contents) + i * 101, 101)
+        name = raw.split(b"\x00", 1)[0].decode(errors="replace")
+        out.append((name, offs[i], sizes[i]))
+    lib.ks_free(names)
+    lib.ks_free(offs)
+    lib.ks_free(sizes)
+    return out
+
+
+def decode_jpegs(
+    blobs: list, target_hw: Tuple[int, int], threads: int = 0
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Decode a list of JPEG byte strings to (n, H, W, 3) float32 [0,1].
+    Returns (images, ok_mask)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(blobs)
+    blob = b"".join(blobs)
+    offsets = np.zeros((n,), np.int64)
+    sizes = np.asarray([len(b) for b in blobs], np.int64)
+    if n > 1:
+        offsets[1:] = np.cumsum(sizes)[:-1]
+    th, tw = target_hw
+    out = ctypes.POINTER(ctypes.c_float)()
+    ok = ctypes.POINTER(ctypes.c_int32)()
+    blob_arr = np.frombuffer(blob, np.uint8)
+    rc = lib.ks_decode_jpegs(
+        blob_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, th, tw, threads,
+        ctypes.byref(out), ctypes.byref(ok),
+    )
+    if rc != 0:
+        return None
+    images = _take_array(lib, out, (n, th, tw, 3), np.float32)
+    ok_mask = _take_array(lib, ok, (n,), np.int32)
+    return images, ok_mask == 0

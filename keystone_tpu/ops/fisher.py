@@ -32,15 +32,20 @@ from keystone_tpu.workflow.transformer import Transformer
 
 class FisherVector(Transformer):
     """Input: ragged ((n, max_k, d), mask) descriptor sets.
-    Output: dense (n, 2·K·D) Fisher vectors."""
+    Output: dense (n, 2·K·D) Fisher vectors.
+
+    ``use_pallas=True`` routes through the fused VMEM-resident TPU kernel
+    (ops/fisher_pallas.py) instead of the XLA einsum path.
+    """
 
     fusable = False
 
-    def __init__(self, gmm: GaussianMixtureModel):
+    def __init__(self, gmm: GaussianMixtureModel, use_pallas: bool = False):
         self.gmm = gmm
+        self.use_pallas = use_pallas
 
     def params(self):
-        return (id(self.gmm),)
+        return (id(self.gmm), self.use_pallas)
 
     def apply_batch(self, xs, mask=None):
         if xs.ndim == 2:
@@ -50,9 +55,16 @@ class FisherVector(Transformer):
             squeeze = False
         if mask is None:
             mask = jnp.ones(xs.shape[:2], jnp.float32)
-        out = _fisher_encode(
-            xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
-        )
+        if self.use_pallas:
+            from keystone_tpu.ops.fisher_pallas import fisher_encode_pallas
+
+            out = fisher_encode_pallas(
+                xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
+            )
+        else:
+            out = _fisher_encode(
+                xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
+            )
         return out[0] if squeeze else out
 
     def apply_one(self, x):

@@ -1,0 +1,328 @@
+// keystone_tpu native IO library.
+//
+// The reference ships C/C++ behind JNI for its hot host-side work
+// (utils/external/EncEval.scala, VLFeat.scala; src/main/cpp shims —
+// SURVEY.md §2.8).  On TPU the *compute* hot loops live in XLA, so the
+// native tier's job moves to the input pipeline: feeding the chip.  This
+// library provides the host-side fast paths the Python loaders bind via
+// ctypes (keystone_tpu/native):
+//
+//   ks_read_csv      — mmap'd single-pass float CSV parser
+//   ks_read_cifar    — CIFAR binary records -> (labels, NHWC float pixels)
+//   ks_tar_index     — POSIX tar member table (offset/size) for record reads
+//   ks_decode_jpegs  — libjpeg batch decode + bilinear resize, thread pool
+//
+// Build: make -C native   (produces libkeystone_native.so)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+#include <jpeglib.h>
+#include <csetjmp>
+#include <cmath>
+
+// Branch-light float parser: [-]int[.frac][e[-]exp].  Strictly bounded by
+// `end` (the mmap'd region is NOT NUL-terminated, so strtof would read
+// past it) and never crosses newlines (so a short/ragged row zero-fills
+// instead of misaligning the rest of the file).  Unusual forms (nan, inf,
+// hex) parse as no-progress -> caller zero-fills the cell.
+static inline float ks_parse_float(const char** pp, const char* end) {
+  const char* p = *pp;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) { neg = (*p == '-'); p++; }
+  if (p >= end || ((*p < '0' || *p > '9') && *p != '.')) {
+    return 0.0f;  // no progress; caller detects *pp unchanged
+  }
+  double mant = 0.0;
+  while (p < end && *p >= '0' && *p <= '9') { mant = mant * 10.0 + (*p - '0'); p++; }
+  if (p < end && *p == '.') {
+    p++;
+    double scale = 0.1;
+    while (p < end && *p >= '0' && *p <= '9') { mant += (*p - '0') * scale; scale *= 0.1; p++; }
+  }
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    p++;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); p++; }
+    int ex = 0;
+    while (p < end && *p >= '0' && *p <= '9') { ex = ex * 10 + (*p - '0'); p++; }
+    static const double pow10[] = {1e0,1e1,1e2,1e3,1e4,1e5,1e6,1e7,1e8,1e9,
+                                   1e10,1e11,1e12,1e13,1e14,1e15};
+    double f = ex < 16 ? pow10[ex] : std::pow(10.0, ex);
+    mant = eneg ? mant / f : mant * f;
+  }
+  *pp = p;
+  return (float)(neg ? -mant : mant);
+}
+
+extern "C" {
+
+// ---------------------------------------------------------------- CSV
+// Counts rows/cols on first pass, parses with strtof on second.
+// Returns 0 on success. Caller frees *out with ks_free.
+int ks_read_csv(const char* path, float** out, int64_t* rows, int64_t* cols) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -2; }
+  size_t size = (size_t)st.st_size;
+  char* data = (char*)mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (data == MAP_FAILED) return -3;
+
+  // first non-comment line -> column count; newline count -> row bound
+  size_t i = 0;
+  while (i < size && (data[i] == '#' || data[i] == '\n' || data[i] == '\r')) {
+    while (i < size && data[i] != '\n') i++;  // skip comment line
+    if (i < size) i++;
+  }
+  int64_t ncols = 1;
+  while (i < size && data[i] != '\n') {
+    if (data[i] == ',') ncols++;
+    i++;
+  }
+  int64_t nrows_bound = 0;
+  for (size_t j = 0; j < size; j++) nrows_bound += (data[j] == '\n');
+  if (size > 0 && data[size - 1] != '\n') nrows_bound++;
+
+  float* buf = (float*)malloc(sizeof(float) * (size_t)nrows_bound * ncols);
+  if (!buf) { munmap(data, size); return -4; }
+
+  int64_t r = 0;
+  const char* p = data;
+  const char* end = data + size;
+  while (p < end && r < nrows_bound) {
+    // skip empty lines and '#' comment lines (np.loadtxt parity)
+    while (p < end && (*p == '\n' || *p == '\r' || *p == '#')) {
+      if (*p == '#') {
+        while (p < end && *p != '\n') p++;
+      } else {
+        p++;
+      }
+    }
+    if (p >= end) break;
+    float* row = buf + r * ncols;
+    for (int64_t c = 0; c < ncols; c++) {
+      const char* before = p;
+      row[c] = ks_parse_float(&p, end);
+      if (p == before) row[c] = 0.0f;  // malformed cell: zero-fill
+      while (p < end && *p != ',' && *p != '\n') p++;
+      if (p < end && *p == ',') p++;
+    }
+    while (p < end && *p != '\n') p++;
+    r++;
+  }
+  munmap(data, size);
+  *out = buf;
+  *rows = r;
+  *cols = ncols;
+  return 0;
+}
+
+// --------------------------------------------------------------- CIFAR
+// Binary records: 1 label byte + 3072 channel-major pixel bytes.
+// Emits labels (int32) and NHWC float32 pixels in [0, 1].
+int ks_read_cifar(const char* path, float** pixels, int32_t** labels,
+                  int64_t* count) {
+  const int64_t H = 32, W = 32, C = 3, REC = 1 + H * W * C;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -2; }
+  if (st.st_size % REC != 0) { close(fd); return -5; }
+  int64_t n = st.st_size / REC;
+  uint8_t* data = (uint8_t*)mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (data == MAP_FAILED) return -3;
+
+  float* px = (float*)malloc(sizeof(float) * n * H * W * C);
+  int32_t* lb = (int32_t*)malloc(sizeof(int32_t) * n);
+  if (!px || !lb) { munmap(data, st.st_size); free(px); free(lb); return -4; }
+  const float inv = 1.0f / 255.0f;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* rec = data + i * REC;
+    lb[i] = rec[0];
+    const uint8_t* chan = rec + 1; // channel-major: R plane, G, B
+    float* out = px + i * H * W * C;
+    for (int64_t y = 0; y < H; y++)
+      for (int64_t x = 0; x < W; x++)
+        for (int64_t c = 0; c < C; c++)
+          out[(y * W + x) * C + c] = chan[c * H * W + y * W + x] * inv;
+  }
+  munmap(data, st.st_size);
+  *pixels = px;
+  *labels = lb;
+  *count = n;
+  return 0;
+}
+
+// ----------------------------------------------------------------- tar
+// POSIX/ustar member index: name (100 bytes), offset, size per member.
+int ks_tar_index(const char* path, char** names, int64_t** offsets,
+                 int64_t** sizes, int64_t* count) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -2; }
+  uint8_t* data = (uint8_t*)mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (data == MAP_FAILED) return -3;
+
+  std::vector<int64_t> offs, szs;
+  std::vector<char> nm;
+  int64_t pos = 0;
+  while (pos + 512 <= st.st_size) {
+    const uint8_t* hdr = data + pos;
+    if (hdr[0] == 0) break; // end-of-archive zero block
+    // require the ustar magic: rejects gzip'd tars and non-tar bytes so
+    // the Python side falls back to tarfile's auto-detection
+    if (memcmp(hdr + 257, "ustar", 5) != 0) {
+      munmap(data, st.st_size);
+      return -6;
+    }
+    char szfield[13];
+    memcpy(szfield, hdr + 124, 12);
+    szfield[12] = 0;
+    int64_t sz = strtoll(szfield, nullptr, 8);
+    char type = hdr[156];
+    if (type == '0' || type == 0) {
+      offs.push_back(pos + 512);
+      szs.push_back(sz);
+      char name[101];
+      memcpy(name, hdr, 100);
+      name[100] = 0;
+      nm.insert(nm.end(), name, name + 101);
+    }
+    pos += 512 + ((sz + 511) / 512) * 512;
+  }
+  munmap(data, st.st_size);
+  int64_t n = (int64_t)offs.size();
+  *offsets = (int64_t*)malloc(sizeof(int64_t) * n);
+  *sizes = (int64_t*)malloc(sizeof(int64_t) * n);
+  *names = (char*)malloc(nm.size() > 0 ? nm.size() : 1);
+  memcpy(*offsets, offs.data(), sizeof(int64_t) * n);
+  memcpy(*sizes, szs.data(), sizeof(int64_t) * n);
+  if (!nm.empty()) memcpy(*names, nm.data(), nm.size());
+  *count = n;
+  return 0;
+}
+
+// ---------------------------------------------------------------- JPEG
+struct KsJpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+static void ks_jpeg_error_exit(j_common_ptr cinfo) {
+  KsJpegErr* err = (KsJpegErr*)cinfo->err;
+  longjmp(err->jb, 1);
+}
+
+// decode one JPEG into out (target_h, target_w, 3) float32 [0,1] via
+// bilinear resize. Returns 0 on success.
+static int decode_one(const uint8_t* buf, int64_t len, int64_t th, int64_t tw,
+                      float* out) {
+  jpeg_decompress_struct cinfo;
+  KsJpegErr jerr;
+  // raw buffer, not std::vector: longjmp from the error handler must not
+  // skip a non-trivial destructor (UB + leak); freed on both paths
+  uint8_t* volatile imgbuf = nullptr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = ks_jpeg_error_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    free(imgbuf);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  int64_t h = cinfo.output_height, w = cinfo.output_width;
+  imgbuf = (uint8_t*)malloc((size_t)h * w * 3);
+  if (!imgbuf) {
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+  uint8_t* img = imgbuf;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* rowp = img + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &rowp, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  // bilinear resize to (th, tw)
+  const float inv255 = 1.0f / 255.0f;
+  for (int64_t y = 0; y < th; y++) {
+    float sy = th > 1 ? (float)y * (h - 1) / (th - 1) : 0.0f;
+    int64_t y0 = (int64_t)sy;
+    int64_t y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    float fy = sy - y0;
+    for (int64_t x = 0; x < tw; x++) {
+      float sx = tw > 1 ? (float)x * (w - 1) / (tw - 1) : 0.0f;
+      int64_t x0 = (int64_t)sx;
+      int64_t x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      float fx = sx - x0;
+      for (int64_t c = 0; c < 3; c++) {
+        float v00 = img[(y0 * w + x0) * 3 + c];
+        float v01 = img[(y0 * w + x1) * 3 + c];
+        float v10 = img[(y1 * w + x0) * 3 + c];
+        float v11 = img[(y1 * w + x1) * 3 + c];
+        float v = (1 - fy) * ((1 - fx) * v00 + fx * v01) +
+                  fy * ((1 - fx) * v10 + fx * v11);
+        out[(y * tw + x) * 3 + c] = v * inv255;
+      }
+    }
+  }
+  free(imgbuf);
+  return 0;
+}
+
+// Batch decode with a thread pool.  buffers: concatenated JPEG bytes with
+// per-item offsets/sizes.  out: (n, th, tw, 3) float32, caller-allocated
+// by us.  ok[i] = 0 on success per image.
+int ks_decode_jpegs(const uint8_t* blob, const int64_t* offsets,
+                    const int64_t* sizes, int64_t n, int64_t th, int64_t tw,
+                    int threads, float** out, int32_t** ok) {
+  float* buf = (float*)malloc(sizeof(float) * (size_t)n * th * tw * 3);
+  int32_t* st = (int32_t*)malloc(sizeof(int32_t) * (n > 0 ? n : 1));
+  if (!buf || !st) { free(buf); free(st); return -4; }
+  if (threads < 1) threads = (int)std::thread::hardware_concurrency();
+  if (threads < 1) threads = 1;
+  if ((int64_t)threads > n) threads = (int)n;  // never more threads than items
+  std::atomic<int64_t> next(0);
+  auto worker = [&]() {
+    while (true) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) break;
+      st[i] = decode_one(blob + offsets[i], sizes[i], th, tw,
+                         buf + (size_t)i * th * tw * 3);
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < threads; t++) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+  *out = buf;
+  *ok = st;
+  return 0;
+}
+
+void ks_free(void* p) { free(p); }
+
+int ks_version() { return 1; }
+
+}  // extern "C"

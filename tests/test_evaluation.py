@@ -53,3 +53,12 @@ def test_augmented_examples_evaluator():
     m = AugmentedExamplesEvaluator(2).evaluate(scores, ids, labels_per_image)
     # image 3: mean [0.25, 0.75] → 1 ✓; image 7: mean [0.55, 0.45] → 0 ✗
     assert abs(m.accuracy - 0.5) < 1e-9
+
+
+def test_augmented_examples_evaluator_unsorted_ids():
+    # ids occur as img9 first, img1 second; labels in occurrence order
+    scores = np.array([[1.0, 0.0], [1.0, 0.0], [0.0, 1.0], [0.0, 1.0]])
+    ids = np.array([9, 9, 1, 1])
+    labels_occurrence_order = np.array([0, 1])  # img9 -> 0, img1 -> 1
+    m = AugmentedExamplesEvaluator(2).evaluate(scores, ids, labels_occurrence_order)
+    assert m.accuracy == 1.0

@@ -1,0 +1,47 @@
+"""Pallas kernel tests (interpret mode on CPU — the TPU lowering is
+exercised by bench/verify runs on hardware)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.fisher import _fisher_encode
+from keystone_tpu.ops.fisher_pallas import fisher_encode_pallas
+
+
+def _setup(n=3, t=200, d=16, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, t, d)).astype(np.float32)
+    mask = (rng.random((n, t)) < 0.8).astype(np.float32)
+    w = np.abs(rng.random(k)).astype(np.float32)
+    w /= w.sum()
+    mu = rng.normal(size=(k, d)).astype(np.float32)
+    var = (0.5 + rng.random((k, d))).astype(np.float32)
+    return map(jnp.asarray, (xs, mask, w, mu, var))
+
+
+def test_pallas_fv_matches_xla_path():
+    xs, mask, w, mu, var = _setup()
+    ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))
+    got = np.asarray(fisher_encode_pallas(xs, mask, w, mu, var, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+def test_pallas_fv_nondivisible_t_padding():
+    xs, mask, w, mu, var = _setup(t=137)  # pads to 2 tiles of 128
+    ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))
+    got = np.asarray(fisher_encode_pallas(xs, mask, w, mu, var, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+def test_fisher_vector_transformer_pallas_flag():
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.fisher import FisherVector
+
+    xs, mask, w, mu, var = _setup(n=2, t=64)
+    gmm = GaussianMixtureModel(w, mu, var)
+    a = np.asarray(FisherVector(gmm).apply_batch(xs, mask=mask))
+    # interpret path via monkey wiring: call kernel directly (the flag
+    # itself routes to the TPU lowering, which CPU can't run un-interpreted)
+    b = np.asarray(fisher_encode_pallas(xs, mask, w, mu, var, interpret=True))
+    np.testing.assert_allclose(a, b, atol=2e-5)
