@@ -190,6 +190,45 @@ class NGramsCounts(Transformer):
         return Counter(ngrams)
 
 
+class NGramIndexer:
+    """Packs n-grams of word ids into single int64 keys
+    (nodes/nlp/NGramIndexer.scala — the reference packs up to 3 word ids
+    into a long for compact distributed count tables).
+
+    ``bits`` per word id (default 21 → 3-grams fit one int64, vocab ≤ 2M).
+    """
+
+    def __init__(self, bits: int = 21):
+        self.bits = int(bits)
+        self._vocab: Dict[str, int] = {}
+        self._reverse: Dict[int, str] = {}
+
+    def word_id(self, word: str) -> int:
+        idx = self._vocab.get(word)
+        if idx is None:
+            idx = len(self._vocab) + 1  # 0 reserved for empty slots
+            if idx >= (1 << self.bits):
+                raise OverflowError(f"vocabulary exceeds 2^{self.bits} words")
+            self._vocab[word] = idx
+            self._reverse[idx] = word
+        return idx
+
+    def pack(self, ngram: Sequence[str]) -> int:
+        if len(ngram) * self.bits > 63:
+            raise OverflowError(f"{len(ngram)}-gram at {self.bits} bits/word")
+        key = 0
+        for w in ngram:
+            key = (key << self.bits) | self.word_id(w)
+        return key
+
+    def unpack(self, key: int, order: int) -> tuple:
+        words = []
+        for _ in range(order):
+            words.append(self._reverse.get(key & ((1 << self.bits) - 1), "<unk>"))
+            key >>= self.bits
+        return tuple(reversed(words))
+
+
 class StupidBackoffLM(Transformer):
     """Stupid-backoff n-gram scorer (nodes/nlp/StupidBackoff.scala):
 

@@ -1,0 +1,124 @@
+"""Multi-host distribution: ICI within a slice, DCN across slices.
+
+The reference scales by adding Spark executors over ethernet; the TPU
+equivalent is multi-process JAX — one process per host, chips linked by
+ICI inside a slice and hosts by DCN across slices (SURVEY.md §2.9).
+
+Usage on each host of a pod/multislice job:
+
+    from keystone_tpu.parallel import multihost
+    multihost.initialize(coordinator_address="host0:1234",
+                         num_processes=N, process_id=i)
+    mesh = multihost.hybrid_mesh(model_parallelism=4)
+    set_mesh(mesh)
+
+After that every solver in keystone_tpu runs unchanged: batch-axis
+contractions all-reduce over ICI within a slice and DCN across slices,
+exactly where XLA places them.  Data loading is per-host: each process
+feeds its addressable shard (``process_batch_slice``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with TPU auto-detection when args are None.
+
+    MUST run before any other JAX call that touches a backend (even
+    ``jax.process_count()`` initializes XLA, after which distributed init
+    is impossible — so this function inspects jax's distributed state
+    directly instead of calling backend-touching APIs).  No-op when
+    already initialized, or when no coordinator is configured (plain
+    single-process use).  Real initialization errors propagate.
+    """
+    import os
+
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and os.environ.get("JAX_COORDINATOR_ADDRESS") is None
+        and os.environ.get("COORDINATOR_ADDRESS") is None
+        and os.environ.get("TPU_WORKER_HOSTNAMES") is None
+    ):
+        logger.debug("no coordinator configured; staying single-process")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def hybrid_mesh(model_parallelism: int = 1):
+    """('data', 'model') mesh laid out so 'model' stays inside a slice.
+
+    Model/feature-parallel collectives (the per-block solves' class-axis
+    sharding) are latency-sensitive → keep them on ICI; data-parallel
+    all-reduces tolerate DCN.  Uses mesh_utils' hybrid construction when
+    multiple slices are present, plain mesh otherwise.
+    """
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    if n % model_parallelism != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+        )
+    num_slices = getattr(devices[0], "num_slices", 1) or 1
+    if num_slices > 1:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(n // num_slices // model_parallelism, model_parallelism),
+            dcn_mesh_shape=(num_slices, 1),
+            devices=devices,
+        )
+    else:
+        arr = np.asarray(devices).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def process_batch_slice(global_n: int) -> slice:
+    """The [start, stop) of the global batch this host should load."""
+    per = -(-global_n // jax.process_count())
+    start = jax.process_index() * per
+    return slice(start, min(start + per, global_n))
+
+
+def make_global_dataset(host_array, global_n: Optional[int] = None):
+    """Assemble a globally-sharded Dataset from per-host shards via
+    jax.make_array_from_process_local_data (multi-host path), or a plain
+    Dataset in single-process mode."""
+    from keystone_tpu.parallel.mesh import current_mesh, data_sharding
+    from keystone_tpu.workflow.dataset import Dataset
+
+    if jax.process_count() == 1:
+        return Dataset(host_array)
+    mesh = current_mesh()
+    sharding = data_sharding(mesh, np.ndim(host_array))
+    garr = jax.make_array_from_process_local_data(sharding, np.asarray(host_array))
+    d = Dataset.__new__(Dataset)
+    d._host = None
+    d._array = garr
+    d.n = global_n if global_n is not None else garr.shape[0]
+    d.mask = None
+    d.name = None
+    return d

@@ -1,0 +1,53 @@
+"""Numeric debugging helpers.
+
+The reference relies on JVM memory safety and has no sanitizers
+(SURVEY.md §5 "Race detection"); the TPU-era equivalents are jit purity
+plus checkify/debug assertions for NaN and out-of-bounds detection —
+wrapped here so solvers/pipelines can opt in without touching jax APIs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def assert_all_finite(x, name: str = "array"):
+    """Host-side finiteness check for eager pipeline outputs."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    if not np.isfinite(arr).all():
+        bad = int((~np.isfinite(arr)).sum())
+        raise FloatingPointError(f"{name}: {bad} non-finite values")
+    return x
+
+
+def checked(fn: Callable) -> Callable:
+    """Wrap a jittable fn with checkify NaN/div checks; raises on error.
+
+    Usage: ``checked(solver_fn)(args)`` — compiles once, errors surface as
+    ``jax.experimental.checkify.JaxRuntimeError`` with location info.
+    """
+    checked_fn = checkify.checkify(
+        fn, errors=checkify.float_checks | checkify.index_checks
+    )
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = checked_fn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper
+
+
+def nan_guard_dataset(ds, name: str = "dataset"):
+    """Eagerly validate a Dataset's array (skips host payloads)."""
+    if not ds.is_host:
+        assert_all_finite(ds.numpy(), name)
+    return ds

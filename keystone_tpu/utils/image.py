@@ -73,3 +73,30 @@ def to_numpy(img) -> np.ndarray:
     if isinstance(img, Image):
         return np.asarray(img.data)
     return np.asarray(img)
+
+
+# ---- batched image utilities (utils/ImageUtils.scala) ----
+def crop(images: jnp.ndarray, y0: int, x0: int, h: int, w: int) -> jnp.ndarray:
+    """Crop batched NHWC (or HWC) images."""
+    if images.ndim == 3:
+        return images[y0 : y0 + h, x0 : x0 + w, :]
+    return images[:, y0 : y0 + h, x0 : x0 + w, :]
+
+
+def flip_horizontal(images: jnp.ndarray) -> jnp.ndarray:
+    return images[..., :, ::-1, :] if images.ndim >= 3 else images[:, ::-1]
+
+
+def flip_vertical(images: jnp.ndarray) -> jnp.ndarray:
+    return images[..., ::-1, :, :] if images.ndim >= 3 else images[::-1, :]
+
+
+def map_pixels(images: jnp.ndarray, fn) -> jnp.ndarray:
+    """Elementwise pixel transform (ImageUtils.mapPixels)."""
+    return fn(images)
+
+
+def pixel_stats(images: jnp.ndarray):
+    """(mean, std) over the batch per channel."""
+    axes = tuple(range(images.ndim - 1))
+    return jnp.mean(images, axis=axes), jnp.std(images, axis=axes)

@@ -1,0 +1,37 @@
+"""Framework interop converters.
+
+The reference bridges Breeze and Spark-MLlib linalg types
+(utils/MLlibUtils.scala); the ecosystem neighbors here are numpy and
+torch (CPU), e.g. for loading torchvision-prepped data or comparing
+against torch reference implementations.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def to_jax(x) -> jnp.ndarray:
+    """torch tensor / numpy array / scipy sparse → jnp array."""
+    if hasattr(x, "detach"):  # torch tensor
+        return jnp.asarray(x.detach().cpu().numpy())
+    if hasattr(x, "toarray"):  # scipy sparse
+        return jnp.asarray(x.toarray())
+    return jnp.asarray(x)
+
+
+def to_torch(x):
+    """jnp/numpy array → torch CPU tensor."""
+    import torch
+
+    # copy: jax arrays surface as non-writable numpy views
+    return torch.from_numpy(np.array(x, copy=True))
+
+
+def to_numpy(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        return x.detach().cpu().numpy()
+    if hasattr(x, "toarray"):
+        return x.toarray()
+    return np.asarray(x)

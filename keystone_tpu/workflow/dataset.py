@@ -34,7 +34,11 @@ class Dataset:
         n: Optional[int] = None,
         mask: Optional[jnp.ndarray] = None,
         shard: bool = True,
+        name: Optional[str] = None,
     ):
+        #: optional stable identity — lets prefix signatures (CSE, saved
+        #: state) match across processes; unnamed datasets use object id
+        self.name = name
         if isinstance(data, (list, tuple)) and not _all_arrays(data):
             # Host payload (strings, PyTrees, variable-shape objects).
             self._host: Optional[list] = list(data)
@@ -84,6 +88,7 @@ class Dataset:
         d._array = arr
         d.n = self.n
         d.mask = mask if mask is not None else None
+        d.name = None
         return d
 
     def with_items(self, items: Sequence) -> "Dataset":
@@ -92,6 +97,7 @@ class Dataset:
         d._array = None
         d.n = self.n
         d.mask = None
+        d.name = None
         return d
 
     def cache(self) -> "Dataset":

@@ -68,7 +68,10 @@ class GraphExecutor:
             if isinstance(result, DatasetExpr):
                 result.dataset.cache()
             self.timings[target] = time.perf_counter() - t0
-        self.results[target] = result
+        if not getattr(op, "no_memoize", False):
+            # no_memoize nodes (over the HBM budget — workflow/profiling.py)
+            # recompute per consumer instead of pinning their output
+            self.results[target] = result
         return result
 
     def _execute_op(self, op: G.Operator, deps):

@@ -72,7 +72,8 @@ class DatasetOperator(Operator):
         return "Dataset"
 
     def signature(self):
-        return ("dataset", id(self.dataset))
+        name = getattr(self.dataset, "name", None)
+        return ("dataset", name if name is not None else id(self.dataset))
 
 
 class DatumOperator(Operator):

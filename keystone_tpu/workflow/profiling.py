@@ -1,0 +1,151 @@
+"""Profiling-driven materialization (the AutoCacheRule proper).
+
+Reference: workflow/AutoCacheRule.scala — estimates per-node output size
+and compute time by running nodes on sampled partitions, then greedily
+places caches under a cluster-memory budget.
+
+TPU version: the budget is HBM (≈16 GB/chip — far tighter than a Spark
+cluster's aggregate RAM, SURVEY.md §7 hard part e), and the decision is
+materialize-vs-recompute: shared node outputs that fit keep an explicit
+materialization barrier (Cacher); shared outputs that don't fit are
+flagged no-memoize so the executor recomputes them per consumer instead
+of pinning them in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from keystone_tpu.workflow import graph as G
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.optimizer import Rule, _truncate_datasets
+from keystone_tpu.workflow.transformer import Cacher
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeProfile:
+    """Measured on a sample, extrapolated to the full dataset."""
+
+    seconds: float
+    output_bytes: int
+    scale: float  # full_n / sample_n extrapolation factor
+
+    @property
+    def full_bytes(self) -> int:
+        return int(self.output_bytes * self.scale)
+
+    @property
+    def full_seconds(self) -> float:
+        return self.seconds * self.scale
+
+
+def profile_graph(graph: G.Graph, sample_size: int = 64) -> Dict[G.NodeId, NodeProfile]:
+    """Run every reachable transformer node on truncated dataset literals,
+    recording wall time and output size (the reference's sampling pass)."""
+    from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
+
+    full_n = max(
+        (
+            op.dataset.n if isinstance(op.dataset, Dataset) else len(op.dataset)
+            for op in graph.operators.values()
+            if isinstance(op, G.DatasetOperator)
+        ),
+        default=1,
+    )
+    truncated = _truncate_datasets(graph, sample_size)
+    ex = GraphExecutor(truncated, profile=True)
+    profiles: Dict[G.NodeId, NodeProfile] = {}
+    for n in truncated.topological_nodes():
+        op = truncated.operators[n]
+        if not isinstance(op, (G.TransformerOperator, G.GatherOperator)):
+            continue
+        try:
+            expr = ex.execute(n)
+        except Exception as e:  # profiling is best-effort, like upstream
+            logger.debug("profiling failed at %s: %s", op.label(), e)
+            continue
+        nbytes = 0
+        sample_n = 1
+        if isinstance(expr, DatasetExpr) and not expr.dataset.is_host:
+            arr = expr.dataset.array
+            nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
+            sample_n = max(expr.dataset.n, 1)
+        profiles[n] = NodeProfile(
+            seconds=ex.timings.get(n, 0.0),
+            output_bytes=nbytes,
+            scale=max(full_n / sample_n, 1.0),
+        )
+    return profiles
+
+
+class ProfilingAutoCacheRule(Rule):
+    """Greedy cache placement under an HBM byte budget."""
+
+    name = "ProfilingAutoCache"
+
+    def __init__(self, budget_bytes: int = 8 << 30, sample_size: int = 64):
+        self.budget_bytes = int(budget_bytes)
+        self.sample_size = int(sample_size)
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        profiles = profile_graph(graph, self.sample_size)
+        shared = [
+            n
+            for n in graph.topological_nodes()
+            if isinstance(graph.operators.get(n), (G.TransformerOperator, G.GatherOperator))
+            and len([d for d in graph.dependents(n) if not isinstance(d, G.SinkId)]) > 1
+        ]
+        # most compute saved per byte pinned, first
+        shared.sort(
+            key=lambda n: (
+                -(profiles[n].full_seconds / max(profiles[n].full_bytes, 1))
+                if n in profiles
+                else 0.0
+            )
+        )
+        remaining = self.budget_bytes
+        for n in shared:
+            prof = profiles.get(n)
+            cost = prof.full_bytes if prof else 0
+            if cost <= remaining:
+                remaining -= cost
+                graph = _insert_cacher(graph, n)
+            else:
+                op = graph.operators[n]
+                if isinstance(op, G.TransformerOperator):
+                    logger.info(
+                        "over HBM budget: %s (%.1f MB) will recompute per consumer",
+                        op.label(),
+                        cost / 1e6,
+                    )
+                    # never mutate shared Operator instances (graphs share
+                    # them persistent-structure style): flag a fresh copy
+                    flagged = G.TransformerOperator(op.transformer)
+                    flagged.no_memoize = True
+                    graph = graph.set_operator(n, flagged)
+        return graph
+
+
+def _insert_cacher(graph: G.Graph, n: G.NodeId) -> G.Graph:
+    deps_on_n = [d for d in graph.dependents(n) if isinstance(d, G.NodeId)]
+    already = any(
+        isinstance(graph.operators.get(d), G.TransformerOperator)
+        and isinstance(graph.operators[d].transformer, Cacher)
+        for d in deps_on_n
+    )
+    if already:
+        return graph
+    graph, cache_node = graph.add_node(G.TransformerOperator(Cacher()), (n,))
+    for d in deps_on_n:
+        if d != cache_node:
+            graph = graph.set_dependencies(
+                d, tuple(cache_node if x == n else x for x in graph.dependencies[d])
+            )
+    return graph

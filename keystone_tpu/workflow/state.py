@@ -1,0 +1,146 @@
+"""Pipeline-level checkpoint/resume: saved materialized prefixes.
+
+Reference: workflow/SavedStateLoadRule.scala + ExtractSaveablePrefixes —
+materialized node outputs are saved under a state dir and reloaded by an
+optimizer rule on later runs, so re-running a pipeline skips the expensive
+featurization prefix (SURVEY.md §5 "Checkpoint/resume").
+
+Keys are the node's structural prefix signature.  Signatures embed Python
+``id()`` for unhashable params (datasets, weight arrays), which is not
+stable across processes — so cross-run reuse requires *named* datasets
+(``Dataset(..., name="train-images")``); unnamed roots simply never match
+and recompute, which is safe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.workflow import graph as G
+from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.optimizer import Rule
+
+logger = logging.getLogger(__name__)
+
+
+def _contains_object_id(sig) -> bool:
+    """True if any leaf looks like a CPython id() (memory address) —
+    unstable across processes, so unusable as a persistent key.  Real
+    params (dims, seeds, floats) are far below the 2^40 address range."""
+    if isinstance(sig, (tuple, list)):
+        return any(_contains_object_id(s) for s in sig)
+    return isinstance(sig, int) and sig >= (1 << 40)
+
+
+def _signature_key(sig) -> Optional[str]:
+    """Stable hash of a prefix signature; None when it contains id()s."""
+    if sig is None or _contains_object_id(sig):
+        return None
+    try:
+        text = repr(sig)
+    except Exception:
+        return None
+    if "unique" in text:
+        return None
+    return hashlib.sha256(text.encode()).hexdigest()[:24]
+
+
+class SavedStateLoadRule(Rule):
+    """Replace subgraphs whose prefix signature has a saved materialization
+    with a dataset literal loaded from the state dir."""
+
+    name = "SavedStateLoad"
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        if not os.path.isdir(self.state_dir):
+            return graph
+        # deepest-first: replacing a shallow prefix would rewrite deeper
+        # prefixes' signatures and orphan their saved results
+        for n in reversed(list(graph.topological_nodes())):
+            if n not in graph.operators:
+                continue  # removed by an earlier replacement
+            op = graph.operators[n]
+            if not isinstance(op, (G.TransformerOperator, G.GatherOperator)):
+                continue
+            key = _signature_key(graph.prefix_signature(n, {}))
+            if key is None:
+                continue
+            path = os.path.join(self.state_dir, key + ".npz")
+            if not os.path.exists(path):
+                continue
+            try:
+                loaded = load_dataset(path)
+            except Exception as e:
+                logger.warning("state reload failed for %s: %s", path, e)
+                continue
+            logger.info("reloaded saved prefix %s for %s", key, op.label())
+            graph, new_node = graph.add_node(G.DatasetOperator(loaded), ())
+            graph = graph.replace_dependency(n, new_node)
+            # drop the now-orphaned prefix
+            graph = graph.remove_node(n)
+        return _prune_orphans(graph)
+
+
+def save_dataset(ds: Dataset, path: str) -> None:
+    payload = {"array": np.asarray(ds.array), "n": np.asarray(ds.n)}
+    if ds.mask is not None:
+        payload["mask"] = np.asarray(ds.mask)
+    np.savez(path, **payload)
+
+
+def load_dataset(path: str) -> Dataset:
+    with np.load(path) as z:
+        arr = z["array"]
+        n = int(z["n"])
+        mask = z["mask"] if "mask" in z else None
+    d = Dataset(arr, n=n, shard=True)
+    if mask is not None:
+        import jax.numpy as jnp
+
+        d.mask = jnp.asarray(mask)
+    return d
+
+
+def save_pipeline_state(pipeline_dataset, state_dir: str) -> int:
+    """Materialize and save every saveable (stable-signature, device-array)
+    node output of a lazy result — ExtractSaveablePrefixes.  Returns the
+    number of saved prefixes."""
+    from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
+
+    os.makedirs(state_dir, exist_ok=True)
+    g = pipeline_dataset.graph
+    ex = GraphExecutor(g)
+    memo: dict = {}
+    saved = 0
+    for n in g.topological_nodes():
+        op = g.operators[n]
+        if not isinstance(op, (G.TransformerOperator, G.GatherOperator)):
+            continue
+        key = _signature_key(g.prefix_signature(n, memo))
+        if key is None:
+            continue
+        expr = ex.execute(n)
+        if isinstance(expr, DatasetExpr) and not expr.dataset.is_host:
+            save_dataset(expr.dataset, os.path.join(state_dir, key + ".npz"))
+            saved += 1
+    return saved
+
+
+def _prune_orphans(graph: G.Graph) -> G.Graph:
+    """Remove nodes not reachable from any sink (after prefix replacement)."""
+    keep = set()
+    for k in graph.sink_dependencies.values():
+        keep.add(k)
+        keep.update(graph.ancestors(k))
+    for n in list(graph.operators):
+        if n not in keep:
+            graph = graph.remove_node(n)
+    return graph

@@ -1,0 +1,32 @@
+"""Pipeline visualization: Graph → Graphviz DOT.
+
+The reference exposes ``Pipeline.toDOT`` for debugging its DAGs
+(workflow/Pipeline.scala); same idea here, plus optimizer before/after
+diffing is just two calls.
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.workflow import graph as G
+
+
+def to_dot(graph: G.Graph, name: str = "pipeline") -> str:
+    lines = [f"digraph {name} {{", "  rankdir=LR;", "  node [shape=box, fontsize=10];"]
+    for s in graph.sources:
+        lines.append(f'  "{s!r}" [shape=ellipse, label="source {s.id}"];')
+    for n in graph.topological_nodes():
+        op = graph.operators[n]
+        shape = {
+            G.DatasetOperator: "cylinder",
+            G.DatumOperator: "cylinder",
+            G.EstimatorOperator: "house",
+        }.get(type(op), "box")
+        label = op.label().replace('"', "'")
+        lines.append(f'  "{n!r}" [shape={shape}, label="{label}"];')
+        for d in graph.dependencies[n]:
+            lines.append(f'  "{d!r}" -> "{n!r}";')
+    for k, d in graph.sink_dependencies.items():
+        lines.append(f'  "{k!r}" [shape=ellipse, label="sink {k.id}"];')
+        lines.append(f'  "{d!r}" -> "{k!r}";')
+    lines.append("}")
+    return "\n".join(lines)
