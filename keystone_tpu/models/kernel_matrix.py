@@ -1,0 +1,73 @@
+"""Kernel matrix abstraction.
+
+Reference [fork]: nodes/learning/KernelMatrix.scala § KernelMatrix /
+BlockKernelMatrix — the interface the block-coordinate KRR solver uses to
+get kernel column blocks, with caching of materialized blocks (cached
+RDDs upstream).
+
+TPU form: blocks are computed on demand from row-sharded X via the gemm
+expansion and optionally kept in an HBM-side LRU (the cache analogue);
+the full n×n matrix never materializes.  KernelRidgeRegressionEstimator
+inlines this computation inside its jitted sweep for speed; this class is
+the standalone/introspection API.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+
+
+class BlockKernelMatrix:
+    """K(X, X) exposed as (row-block, col-block) tiles with LRU caching."""
+
+    def __init__(
+        self,
+        kernel_gen: GaussianKernelGenerator,
+        x: jnp.ndarray,
+        block_size: int = 1024,
+        cache_blocks: int = 8,
+    ):
+        self.kernel_gen = kernel_gen
+        self.x = jnp.asarray(x, jnp.float32)
+        self.block_size = int(block_size)
+        self.n = self.x.shape[0]
+        self.num_blocks = -(-self.n // self.block_size)
+        self._cache: "OrderedDict[Tuple[int, int], jnp.ndarray]" = OrderedDict()
+        self._cache_blocks = int(cache_blocks)
+
+    def _rows(self, b: int) -> jnp.ndarray:
+        lo = b * self.block_size
+        return self.x[lo : lo + self.block_size]
+
+    def block(self, i: int, j: int) -> jnp.ndarray:
+        """K[X_i, X_j] — (<=bs, <=bs)."""
+        key = (i, j)
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            return self._cache[key]
+        blk = self.kernel_gen(self._rows(i), self._rows(j))
+        self._cache[key] = blk
+        if len(self._cache) > self._cache_blocks:
+            self._cache.popitem(last=False)
+        return blk
+
+    def column_block(self, j: int) -> jnp.ndarray:
+        """K[:, X_j] — (n, <=bs); the unit the BCD sweep consumes."""
+        return self.kernel_gen(self.x, self._rows(j))
+
+    def diag_block(self, j: int) -> jnp.ndarray:
+        return self.block(j, j)
+
+    def matvec(self, v: jnp.ndarray) -> jnp.ndarray:
+        """K @ v computed blockwise (n never squares in memory)."""
+        out = jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
+        for j in range(self.num_blocks):
+            lo = j * self.block_size
+            vj = v[lo : lo + self.block_size]
+            out = out + self.column_block(j) @ vj
+        return out

@@ -45,7 +45,12 @@ class FisherVector(Transformer):
         self.use_pallas = use_pallas
 
     def params(self):
-        return (id(self.gmm), self.use_pallas)
+        from keystone_tpu.utils.hashing import cached_fingerprint
+
+        fp = cached_fingerprint(
+            self, "_fp", self.gmm.weights, self.gmm.means, self.gmm.variances
+        )
+        return (fp, self.use_pallas)
 
     def apply_batch(self, xs, mask=None):
         if xs.ndim == 2:

@@ -49,7 +49,13 @@ class Convolver(Transformer):
         return cls(filters, stride=stride, offset=offset)
 
     def params(self):
-        return (self.filters.shape, id(self.filters), self.stride)
+        from keystone_tpu.utils.hashing import cached_fingerprint
+
+        if self.offset is None:
+            fp = cached_fingerprint(self, "_fp", self.filters)
+        else:
+            fp = cached_fingerprint(self, "_fp", self.filters, self.offset)
+        return (self.filters.shape, fp, self.stride, self.offset is None)
 
     def apply_batch(self, xs, mask=None):
         if xs.ndim == 3:

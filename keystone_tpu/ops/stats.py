@@ -53,7 +53,9 @@ class CosineRandomFeatures(Transformer):
         return cls(w, b)
 
     def params(self):
-        return (self.w.shape, id(self.w))
+        from keystone_tpu.utils.hashing import cached_fingerprint
+
+        return (self.w.shape, cached_fingerprint(self, "_fp", self.w, self.b))
 
     def apply_batch(self, xs, mask=None):
         return jnp.cos(xs @ self.w.T + self.b)
@@ -75,7 +77,9 @@ class RandomSignNode(Transformer):
         return cls(bits.astype(jnp.float32) * 2.0 - 1.0)
 
     def params(self):
-        return (self.signs.shape[0], id(self.signs))
+        from keystone_tpu.utils.hashing import cached_fingerprint
+
+        return (self.signs.shape[0], cached_fingerprint(self, "_fp", self.signs))
 
     def apply_batch(self, xs, mask=None):
         return xs * self.signs

@@ -1,0 +1,32 @@
+"""Content fingerprints for arrays.
+
+Weight-carrying transformers (random features, convolution filters, GMM
+vocabularies) need a *stable* identity for CSE and saved-state keys —
+``id()`` is only unique within a process and unusable as a persistent
+key.  A short digest of the array bytes is both.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def array_fingerprint(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        arr = np.asarray(a)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def cached_fingerprint(obj, attr: str, *arrays) -> str:
+    """Compute once per object, cache on the instance."""
+    fp = getattr(obj, attr, None)
+    if fp is None:
+        fp = array_fingerprint(*arrays)
+        setattr(obj, attr, fp)
+    return fp

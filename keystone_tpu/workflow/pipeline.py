@@ -38,17 +38,47 @@ from keystone_tpu.workflow.transformer import Chainable, Transformer
 
 class PipelineEnv:
     """Process-global pipeline environment (workflow/PipelineEnv.scala):
-    the optimizer instance and the state directory for saved pipelines."""
+    the optimizer instance and the state directory for saved pipelines.
+
+    Setting ``state_dir`` prepends a SavedStateLoadRule batch to the
+    default optimizer, so previously-materialized prefixes reload
+    automatically (the reference's saved-state flow)."""
 
     optimizer = None  # lazily constructed default
     state_dir: Optional[str] = None
+    _built_for_state_dir: Optional[str] = None
+    _user_optimizer = False
+
+    @classmethod
+    def set_optimizer(cls, optimizer) -> None:
+        """Install a custom optimizer; it is never overwritten by the
+        state_dir wiring (compose SavedStateLoadRule yourself if needed)."""
+        cls.optimizer = optimizer
+        cls._user_optimizer = optimizer is not None
 
     @classmethod
     def get_optimizer(cls):
-        if cls.optimizer is None:
-            from keystone_tpu.workflow.optimizer import default_optimizer
+        if cls._user_optimizer and cls.optimizer is not None:
+            return cls.optimizer
+        if cls.optimizer is None or cls._built_for_state_dir != cls.state_dir:
+            from keystone_tpu.workflow.optimizer import (
+                Once,
+                RuleBatch,
+                default_optimizer,
+            )
 
-            cls.optimizer = default_optimizer()
+            opt = default_optimizer()
+            if cls.state_dir:
+                from keystone_tpu.workflow.state import SavedStateLoadRule
+
+                opt.batches.insert(
+                    0,
+                    RuleBatch(
+                        "saved-state", Once(), [SavedStateLoadRule(cls.state_dir)]
+                    ),
+                )
+            cls.optimizer = opt
+            cls._built_for_state_dir = cls.state_dir
         return cls.optimizer
 
 
@@ -188,6 +218,12 @@ class Pipeline(Chainable):
             g = g.remove_node(n)
         g = _prune_unreachable(g, self.sink, keep_sources=(self.source,))
         return FittedPipeline(g, self.source, self.sink)
+
+    def to_dot(self, name: str = "pipeline") -> str:
+        """Graphviz DOT of this pipeline's DAG (Pipeline.toDOT analogue)."""
+        from keystone_tpu.workflow.viz import to_dot
+
+        return to_dot(self.graph, name)
 
     def __repr__(self):
         return f"Pipeline({self.graph!r})"

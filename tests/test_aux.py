@@ -212,3 +212,52 @@ def test_image_utils():
     np.testing.assert_allclose(np.asarray(f[:, :, 0]), np.asarray(imgs[:, :, -1]))
     mean, std = pixel_stats(imgs)
     assert mean.shape == (3,)
+
+
+def test_block_kernel_matrix():
+    from keystone_tpu.models import GaussianKernelGenerator
+    from keystone_tpu.models.kernel_matrix import BlockKernelMatrix
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 5)).astype(np.float32)
+    kern = GaussianKernelGenerator(0.3)
+    bk = BlockKernelMatrix(kern, x, block_size=16)
+    full = np.asarray(kern(jnp.asarray(x), jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(bk.block(0, 1)), full[:16, 16:32], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bk.column_block(2)), full[:, 32:], atol=1e-6)
+    v = rng.normal(size=(40, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(bk.matvec(jnp.asarray(v))), full @ v, atol=1e-4)
+    _ = bk.block(0, 1)  # cached path
+
+
+def test_pipeline_env_state_dir_roundtrip(tmp_path):
+    from keystone_tpu.workflow import Pipeline, PipelineEnv
+    from keystone_tpu.workflow.state import save_pipeline_state
+
+    state = str(tmp_path / "env-state")
+    data = Dataset(np.full((8, 3), 2.0, np.float32), name="env-train")
+    pipe = Expensive("env") | AddC(1.0)
+    save_pipeline_state(pipe(data), state)
+    try:
+        PipelineEnv.state_dir = state
+        Expensive.calls = 0  # reload must NOT recompute the prefix
+        out = (Expensive("env") | AddC(1.0))(
+            Dataset(np.full((8, 3), 2.0, np.float32), name="env-train")
+        ).get()
+        np.testing.assert_allclose(out.numpy(), 5.0)
+        assert Expensive.calls == 0
+    finally:
+        PipelineEnv.state_dir = None
+
+
+def test_pipeline_env_user_optimizer_not_overwritten(tmp_path):
+    from keystone_tpu.workflow import Optimizer, PipelineEnv
+
+    custom = Optimizer([])
+    try:
+        PipelineEnv.set_optimizer(custom)
+        PipelineEnv.state_dir = str(tmp_path)
+        assert PipelineEnv.get_optimizer() is custom
+    finally:
+        PipelineEnv.set_optimizer(None)
+        PipelineEnv.state_dir = None
