@@ -7,6 +7,15 @@ against the same JAX program on one host CPU (the closest stand-in for
 the reference's BLAS-on-CPU executors; the reference repo publishes no
 numbers — BASELINE.json "published": {}).
 
+Methodology: throughput is the *marginal* per-batch time of a pipelined
+dispatch stream — time(long run) − time(short run), divided by the extra
+iterations.  This measures sustained streaming throughput (batches
+continuously in flight, as in production inference) and cancels the fixed
+host↔device round-trip of the final synchronization, which in this
+environment is a ~60 ms network tunnel hop that would otherwise dominate
+and massively understate the chip.  Both the TPU leg and the CPU
+baseline leg use the same estimator.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Usage: python bench.py            # TPU (or default backend) + cached CPU baseline
@@ -23,14 +32,19 @@ import time
 
 import numpy as np
 
-BATCH = 512  # large batches amortize dispatch; see BASELINE.md measurements
+BATCH = 512  # device-optimal: VMEM-friendly working set (see BASELINE.md)
 IMAGE_HW = 64
 GMM_K = 64
 PCA_DIMS = 64
 NUM_CLASSES = 1000
-WARMUP = 2
-ITERS = 10
+WARMUP = 3
+SHORT_ITERS = 10
+LONG_ITERS = 60
+TRIALS = 5
 _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
+# bump whenever the measurement methodology or CPU-leg parameters change,
+# so stale cached baselines from older estimators are discarded
+_BASELINE_VERSION = 2
 
 
 def build_forward():
@@ -81,7 +95,13 @@ def build_forward():
     return forward
 
 
-def measure_ips(batch: int, iters: int, warmup: int) -> float:
+def measure_ips(
+    batch: int,
+    short_iters: int = SHORT_ITERS,
+    long_iters: int = LONG_ITERS,
+    warmup: int = WARMUP,
+    trials: int = TRIALS,
+) -> float:
     import jax
 
     forward = jax.jit(build_forward())
@@ -93,19 +113,46 @@ def measure_ips(batch: int, iters: int, warmup: int) -> float:
     images = jnp.asarray(images)
     for _ in range(warmup):
         forward(images).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = forward(images)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    return batch * iters / dt
+
+    def run(iters: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = forward(images)
+        out.block_until_ready()
+        return time.perf_counter() - t0
+
+    slopes = []
+    means = []
+    for _ in range(trials):
+        t_short = run(short_iters)
+        t_long = run(long_iters)
+        per_iter = (t_long - t_short) / (long_iters - short_iters)
+        if per_iter > 0:
+            slopes.append(per_iter)
+        means.append(t_long / long_iters)
+    if slopes:
+        # median across trials: robust to a single noisy t_short/t_long pair
+        # (max-over-trials would keep the luckiest outlier)
+        per_iter = float(np.median(slopes))
+    else:
+        # every trial's slope drowned in timing noise; fall back to the
+        # sync-dominated mean and say so — this measures a different
+        # quantity (includes the final host<->device round-trip)
+        per_iter = float(np.median(means))
+        sys.stderr.write(
+            "bench: slope estimator degenerate; reporting sync-dominated mean\n"
+        )
+    return batch / per_iter
 
 
 def cpu_baseline_ips() -> float:
     if os.path.exists(_BASELINE_CACHE):
         try:
             with open(_BASELINE_CACHE) as f:
-                return float(json.load(f)["ips"])
+                cached = json.load(f)
+            if cached.get("v") == _BASELINE_VERSION:
+                return float(cached["ips"])
         except Exception:
             pass
     proc = subprocess.run(
@@ -122,7 +169,7 @@ def cpu_baseline_ips() -> float:
         sys.stderr.write(f"cpu baseline failed: {proc.stderr[-500:]}\n")
         return 0.0
     with open(_BASELINE_CACHE, "w") as f:
-        json.dump({"ips": ips}, f)
+        json.dump({"ips": ips, "v": _BASELINE_VERSION}, f)
     return ips
 
 
@@ -131,15 +178,17 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        # same per-image program; batch chosen so the CPU leg also gets
-        # dispatch amortization (larger batches don't change its ips)
-        ips = measure_ips(batch=64, iters=2, warmup=1)
+        # same per-image program + same marginal-time estimator, scaled down
+        # (the CPU leg is ~1000× slower; a handful of iterations suffices)
+        ips = measure_ips(
+            batch=64, short_iters=1, long_iters=6, warmup=1, trials=2
+        )
         print(json.dumps({"cpu_ips": ips}))
         return
 
     import jax
 
-    ips = measure_ips(BATCH, ITERS, WARMUP)
+    ips = measure_ips(BATCH)
     cpu_ips = cpu_baseline_ips()
     vs = ips / cpu_ips if cpu_ips > 0 else None
     print(

@@ -57,17 +57,46 @@ class BlockKernelMatrix:
         return blk
 
     def column_block(self, j: int) -> jnp.ndarray:
-        """K[:, X_j] — (n, <=bs); the unit the BCD sweep consumes."""
+        """K[:, X_j] — (n, <=bs); the unit the BCD sweep consumes.
+
+        Assembled from (i, j) tiles only when a full sweep's tiles fit
+        in the LRU (num_blocks² ≤ cache_blocks — repeat sweeps then get
+        pure cache hits); otherwise a sweep would insert-then-evict every
+        tile, so compute the column as the single O(n·bs·d) gemm."""
+        if self.num_blocks == 0:
+            return jnp.zeros((0, 0), jnp.float32)
+        if self.num_blocks * self.num_blocks <= self._cache_blocks:
+            return jnp.concatenate(
+                [self.block(i, j) for i in range(self.num_blocks)], axis=0
+            )
         return self.kernel_gen(self.x, self._rows(j))
 
     def diag_block(self, j: int) -> jnp.ndarray:
         return self.block(j, j)
 
     def matvec(self, v: jnp.ndarray) -> jnp.ndarray:
-        """K @ v computed blockwise (n never squares in memory)."""
+        """K @ v computed blockwise (n never squares in memory).
+
+        Goes tile-by-tile through the LRU only when every tile fits
+        (num_blocks² ≤ cache_blocks — repeat matvecs then recompute
+        nothing); otherwise streams column gemms without polluting the
+        cache."""
+        if self.num_blocks == 0:
+            return jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
+        if self.num_blocks * self.num_blocks <= self._cache_blocks:
+            parts = []
+            for i in range(self.num_blocks):
+                acc = None
+                for j in range(self.num_blocks):
+                    lo = j * self.block_size
+                    vj = v[lo : lo + self.block_size]
+                    term = self.block(i, j) @ vj
+                    acc = term if acc is None else acc + term
+                parts.append(acc)
+            return jnp.concatenate(parts, axis=0)
         out = jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
         for j in range(self.num_blocks):
             lo = j * self.block_size
             vj = v[lo : lo + self.block_size]
-            out = out + self.column_block(j) @ vj
+            out = out + self.kernel_gen(self.x, self._rows(j)) @ vj
         return out

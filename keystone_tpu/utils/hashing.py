@@ -24,9 +24,22 @@ def array_fingerprint(*arrays) -> str:
 
 
 def cached_fingerprint(obj, attr: str, *arrays) -> str:
-    """Compute once per object, cache on the instance."""
-    fp = getattr(obj, attr, None)
-    if fp is None:
-        fp = array_fingerprint(*arrays)
-        setattr(obj, attr, fp)
+    """Compute once per object, cache on the instance.
+
+    The cache records the array objects that were hashed (strong refs —
+    they're alive through the owning transformer anyway) and is valid
+    only while the same objects are passed, so reassigning a
+    transformer's weights (``t.filters = new``) invalidates it instead
+    of reporting the stale digest (which would let CSE or saved-state
+    rules silently alias nodes with different weights).  Bare ``id()``
+    keys would be unsound here: CPython reuses addresses after GC."""
+    cached = getattr(obj, attr, None)
+    if (
+        cached is not None
+        and len(cached[0]) == len(arrays)
+        and all(a is b for a, b in zip(cached[0], arrays))
+    ):
+        return cached[1]
+    fp = array_fingerprint(*arrays)
+    setattr(obj, attr, (tuple(arrays), fp))
     return fp

@@ -47,18 +47,30 @@ class PipelineEnv:
     optimizer = None  # lazily constructed default
     state_dir: Optional[str] = None
     _built_for_state_dir: Optional[str] = None
-    _user_optimizer = False
+    _auto_built = None  # the instance get_optimizer constructed itself
+    _auto_built_sig = ()  # identity of its rule batches at build time
 
     @classmethod
     def set_optimizer(cls, optimizer) -> None:
         """Install a custom optimizer; it is never overwritten by the
         state_dir wiring (compose SavedStateLoadRule yourself if needed)."""
         cls.optimizer = optimizer
-        cls._user_optimizer = optimizer is not None
+        cls._auto_built = None
+        cls._auto_built_sig = ()
 
     @classmethod
     def get_optimizer(cls):
-        if cls._user_optimizer and cls.optimizer is not None:
+        # anything not built by this method — via set_optimizer, direct
+        # assignment to the public attribute, or in-place extension of
+        # the auto-built default's rule batches — is user-owned: honor it
+        if cls.optimizer is not None and (
+            cls.optimizer is not cls._auto_built
+            or len(cls.optimizer.batches) != len(cls._auto_built_sig)
+            or any(
+                b is not s
+                for b, s in zip(cls.optimizer.batches, cls._auto_built_sig)
+            )
+        ):
             return cls.optimizer
         if cls.optimizer is None or cls._built_for_state_dir != cls.state_dir:
             from keystone_tpu.workflow.optimizer import (
@@ -78,6 +90,8 @@ class PipelineEnv:
                     ),
                 )
             cls.optimizer = opt
+            cls._auto_built = opt
+            cls._auto_built_sig = tuple(opt.batches)
             cls._built_for_state_dir = cls.state_dir
         return cls.optimizer
 

@@ -261,3 +261,54 @@ def test_pipeline_env_user_optimizer_not_overwritten(tmp_path):
     finally:
         PipelineEnv.set_optimizer(None)
         PipelineEnv.state_dir = None
+
+
+def test_pipeline_env_direct_assignment_honored(tmp_path):
+    # assigning the public attribute (without set_optimizer) must survive
+    # a later state_dir change — the state-dir wiring only replaces
+    # optimizers it built itself
+    from keystone_tpu.workflow import Optimizer, PipelineEnv
+
+    custom = Optimizer([])
+    try:
+        PipelineEnv.optimizer = custom
+        PipelineEnv.state_dir = str(tmp_path)
+        assert PipelineEnv.get_optimizer() is custom
+    finally:
+        PipelineEnv.set_optimizer(None)
+        PipelineEnv.state_dir = None
+
+
+def test_cached_fingerprint_invalidates_on_reassignment():
+    # a transformer whose weights are swapped must change identity, or
+    # CSE/saved-state rules would alias nodes with different weights
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops import Convolver
+
+    f1 = jnp.ones((2, 3, 3, 1), jnp.float32)
+    f2 = jnp.zeros((2, 3, 3, 1), jnp.float32)
+    conv = Convolver(f1)
+    fp1 = conv.params()
+    conv.filters = f2
+    fp2 = conv.params()
+    assert fp1 != fp2
+    # and same content produces the same fingerprint across instances
+    assert Convolver(f1).params() == Convolver(jnp.ones((2, 3, 3, 1))).params()
+
+
+def test_pipeline_env_inplace_extension_honored(tmp_path):
+    # extending the auto-built default in place is a user customization;
+    # a later state_dir change must not silently rebuild over it
+    from keystone_tpu.workflow import PipelineEnv
+    from keystone_tpu.workflow.optimizer import Once, RuleBatch
+
+    try:
+        PipelineEnv.set_optimizer(None)
+        opt = PipelineEnv.get_optimizer()
+        opt.batches.append(RuleBatch("custom", Once(), []))
+        PipelineEnv.state_dir = str(tmp_path)
+        assert PipelineEnv.get_optimizer() is opt
+    finally:
+        PipelineEnv.set_optimizer(None)
+        PipelineEnv.state_dir = None

@@ -1,0 +1,47 @@
+"""Guards the headline benchmark program (bench.py).
+
+bench.py only executes on the real chip at round end; this smoke test
+compiles and runs the exact same forward on the CPU mesh so a regression
+in any stage (SIFT → PCA → FV → normalize → block-linear) is caught by
+the suite, not by the driver.
+"""
+
+import sys
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_bench_forward_compiles_and_is_finite():
+    fwd = jax.jit(bench.build_forward())
+    imgs = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (4, bench.IMAGE_HW, bench.IMAGE_HW, 3)),
+        jnp.float32,
+    )
+    out = fwd(imgs)
+    assert out.shape == (4, bench.NUM_CLASSES)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_bench_forward_batch_invariance():
+    # per-image results must not depend on batch packing (pure map semantics,
+    # the reference's Transformer.apply(RDD) contract)
+    fwd = jax.jit(bench.build_forward())
+    imgs = jnp.asarray(
+        np.random.default_rng(1).uniform(0, 1, (6, bench.IMAGE_HW, bench.IMAGE_HW, 3)),
+        jnp.float32,
+    )
+    full = fwd(imgs)
+    half = fwd(imgs[:3])
+    np.testing.assert_allclose(np.asarray(full[:3]), np.asarray(half), rtol=2e-4, atol=2e-4)
+
+
+def test_measure_ips_runs_on_cpu():
+    ips = bench.measure_ips(batch=2, short_iters=1, long_iters=3, warmup=1, trials=1)
+    assert ips > 0

@@ -1,5 +1,6 @@
 """Driver entry-point regression tests (8-device CPU mesh)."""
 
+import os
 import sys
 
 import jax
@@ -8,7 +9,7 @@ import pytest
 
 
 def _load():
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import __graft_entry__ as g
 
     return g
