@@ -27,19 +27,32 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def build_and_load(
+    so_path: str, make_target: Optional[str] = None
+) -> Optional[ctypes.CDLL]:
+    """Build (via ``make -C native [target]``) if missing, then CDLL-load.
+
+    Shared by the IO binding below and the XLA-FFI binding
+    (ops/fisher_ffi.py).  Returns None when the toolchain or library is
+    unavailable — callers fall back to pure-Python paths."""
+    if not os.path.exists(so_path):
+        cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
+        if make_target:
+            cmd.append(make_target)
+        try:
+            subprocess.run(
+                cmd, capture_output=True, text=True, timeout=300, check=True
+            )
+        except (subprocess.SubprocessError, OSError) as e:
+            logger.debug("native build failed: %s", e)
+            return None
+        if not os.path.exists(so_path):
+            return None
     try:
-        subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-            capture_output=True,
-            text=True,
-            timeout=300,
-            check=True,
-        )
-        return os.path.exists(_SO_PATH)
-    except (subprocess.SubprocessError, OSError) as e:
-        logger.debug("native build failed: %s", e)
-        return False
+        return ctypes.CDLL(os.path.abspath(so_path))
+    except OSError as e:
+        logger.warning("could not load native library %s: %s", so_path, e)
+        return None
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -48,12 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            logger.warning("could not load native library: %s", e)
+        lib = build_and_load(_SO_PATH)
+        if lib is None:
             return None
         lib.ks_read_csv.restype = ctypes.c_int
         lib.ks_read_csv.argtypes = [

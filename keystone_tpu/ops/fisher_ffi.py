@@ -1,0 +1,105 @@
+"""Fisher-vector encode as a C++ XLA custom call (host/CPU).
+
+Reference: the production FV encode in the reference is EncEval, a C++
+library working in double precision on the host, reached over JNI
+(utils/external/EncEval.scala; SURVEY.md §2.8 "JNI shim layer →
+equivalent = XLA custom-call/FFI registration (C++)").  This module is
+that equivalent: ``native/keystone_ffi.cpp`` registered through the XLA
+FFI, accumulating in f64 regardless of I/O dtype.
+
+Use it (a) as the precision reference in parity tests for the f32 TPU
+paths (ops/fisher.py einsums, ops/fisher_pallas.py kernel) and (b) as a
+CPU-backend encode.  TPU execution keeps the pure-XLA/Pallas paths — the
+custom call is registered for platform="cpu" only, mirroring how EncEval
+ran on the executors' host CPUs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(
+    os.path.dirname(__file__), "..", "native", "libkeystone_ffi.so"
+)
+_lock = threading.Lock()
+_available: bool | None = None
+
+_TARGETS = {
+    np.dtype(np.float32): "ks_fisher_encode_f32",
+    np.dtype(np.float64): "ks_fisher_encode_f64",
+}
+_SYMBOLS = {
+    np.dtype(np.float32): "KsFisherEncodeF32",
+    np.dtype(np.float64): "KsFisherEncodeF64",
+}
+
+
+def ffi_available() -> bool:
+    """Load + register the custom-call library (build lazily if needed)."""
+    global _available
+    with _lock:
+        if _available is not None:
+            return _available
+        from keystone_tpu.native import build_and_load
+
+        lib = build_and_load(_SO_PATH, make_target="ffi")
+        if lib is None:
+            _available = False
+            return False
+        try:
+            for dt, target in _TARGETS.items():
+                jax.ffi.register_ffi_target(
+                    target,
+                    jax.ffi.pycapsule(getattr(lib, _SYMBOLS[dt])),
+                    platform="cpu",
+                )
+            _available = True
+        except (OSError, AttributeError) as e:
+            logger.warning("could not register FFI targets: %s", e)
+            _available = False
+    return _available
+
+
+def fisher_encode_ffi(xs, mask, w, mu, var):
+    """xs: (n, T, d); mask: (n, T); GMM (w (K,), mu/var (K, d)) → (n, 2KD).
+
+    Same contract as ops/fisher.py § _fisher_encode, computed by the C++
+    double-accumulation host kernel.  CPU backend only — raises
+    RuntimeError when the library can't be built/loaded.
+    """
+    if not ffi_available():
+        raise RuntimeError(
+            "keystone FFI library unavailable (g++ or jaxlib FFI headers missing)"
+        )
+    xs = np.asarray(xs)
+    dt = np.dtype(xs.dtype)
+    if dt not in _TARGETS:
+        dt = np.dtype(np.float32)
+    xs = xs.astype(dt)
+    n, t, d = xs.shape
+    mu = np.asarray(mu, dt)
+    k = mu.shape[0]
+    # the targets are registered for platform="cpu" only (mirroring
+    # EncEval running on host CPUs); pin placement so a TPU/GPU default
+    # backend doesn't lower the call for a platform that lacks it
+    cpu = jax.devices("cpu")[0]
+    call = jax.ffi.ffi_call(
+        _TARGETS[dt],
+        jax.ShapeDtypeStruct((n, 2 * k * d), dt),
+    )
+    with jax.default_device(cpu):
+        return call(
+            jax.device_put(xs, cpu),
+            jax.device_put(np.asarray(mask, dt), cpu),
+            jax.device_put(np.asarray(w, dt), cpu),
+            jax.device_put(mu, cpu),
+            jax.device_put(np.asarray(var, dt), cpu),
+        )

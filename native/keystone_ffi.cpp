@@ -1,0 +1,173 @@
+// XLA FFI host (CPU) implementation of Fisher-vector encoding.
+//
+// Role (SURVEY.md §2.8): the reference's Fisher-vector encode lives in
+// EncEval, a C++ library computing in double precision on the host
+// (utils/external/EncEval.scala JNI wrapper).  The TPU path here is f32
+// (ops/fisher.py XLA einsums, ops/fisher_pallas.py fused kernel); this
+// file is the first-class C++ equivalent of the reference's native tier:
+// a double-accumulation host implementation registered as an XLA custom
+// call, used as the precision reference in parity tests and as a CPU
+// fallback.  Same math as ops/fisher.py § _fisher_encode:
+//
+//   γ_tk  = softmax_k( log w_k + log N(x_t; μ_k, σ²_k) ) · mask_t
+//   Φ¹_k  = (Σγx − s0·μ)/σ / (T·√w_k)
+//   Φ²_k  = ((Σγx² − 2μΣγx + s0μ²)/σ² − s0) / (T·√(2w_k))
+//   out   = [Φ¹ flattened ; Φ² flattened]           (per image: 2·K·D)
+//
+// Built against the XLA FFI headers shipped in jaxlib (jax.ffi.include_dir());
+// registered from Python via jax.ffi.register_ffi_target (ops/fisher_ffi.py).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+constexpr double kLog2Pi = 1.8378770664093453;
+
+// One image's encode with double accumulators.  xs: (T, D) strided flat,
+// mask: (T,), gmm arrays (K,)/(K, D); out: (2*K*D,).
+template <typename In, typename Out>
+void EncodeOne(const In* xs, const In* mask, const In* w, const In* mu,
+               const In* var, int64_t t_len, int64_t k, int64_t d, Out* out,
+               std::vector<double>& s0, std::vector<double>& s1,
+               std::vector<double>& s2, std::vector<double>& logp,
+               const std::vector<double>& log_norm) {
+  std::fill(s0.begin(), s0.end(), 0.0);
+  std::fill(s1.begin(), s1.end(), 0.0);
+  std::fill(s2.begin(), s2.end(), 0.0);
+  double count = 0.0;
+
+  for (int64_t t = 0; t < t_len; ++t) {
+    const double m = static_cast<double>(mask[t]);
+    if (m == 0.0) continue;
+    count += m;
+    const In* x = xs + t * d;
+    double mx = -INFINITY;
+    for (int64_t kk = 0; kk < k; ++kk) {
+      double quad = 0.0;
+      const In* muk = mu + kk * d;
+      const In* vk = var + kk * d;
+      for (int64_t dd = 0; dd < d; ++dd) {
+        const double diff = static_cast<double>(x[dd]) - static_cast<double>(muk[dd]);
+        quad += diff * diff / static_cast<double>(vk[dd]);
+      }
+      logp[kk] = log_norm[kk] - 0.5 * quad;
+      if (logp[kk] > mx) mx = logp[kk];
+    }
+    double z = 0.0;
+    for (int64_t kk = 0; kk < k; ++kk) {
+      logp[kk] = std::exp(logp[kk] - mx);
+      z += logp[kk];
+    }
+    for (int64_t kk = 0; kk < k; ++kk) {
+      const double gamma = m * logp[kk] / z;
+      if (gamma == 0.0) continue;
+      s0[kk] += gamma;
+      double* s1k = s1.data() + kk * d;
+      double* s2k = s2.data() + kk * d;
+      for (int64_t dd = 0; dd < d; ++dd) {
+        const double xv = static_cast<double>(x[dd]);
+        s1k[dd] += gamma * xv;
+        s2k[dd] += gamma * xv * xv;
+      }
+    }
+  }
+
+  const double tn = std::max(count, 1.0);
+  for (int64_t kk = 0; kk < k; ++kk) {
+    const double wk = static_cast<double>(w[kk]);
+    const double n1 = tn * std::sqrt(wk);
+    const double n2 = tn * std::sqrt(2.0 * wk);
+    const In* muk = mu + kk * d;
+    const In* vk = var + kk * d;
+    Out* phi1 = out + kk * d;
+    Out* phi2 = out + (k + kk) * d;
+    for (int64_t dd = 0; dd < d; ++dd) {
+      const double mukd = static_cast<double>(muk[dd]);
+      const double vkd = static_cast<double>(vk[dd]);
+      const double sigma = std::sqrt(vkd);
+      const double a = (s1[kk * d + dd] - s0[kk] * mukd) / sigma / n1;
+      const double b =
+          ((s2[kk * d + dd] - 2.0 * mukd * s1[kk * d + dd] + s0[kk] * mukd * mukd) /
+               vkd -
+           s0[kk]) /
+          n2;
+      phi1[dd] = static_cast<Out>(a);
+      phi2[dd] = static_cast<Out>(b);
+    }
+  }
+}
+
+template <ffi::DataType DT>
+ffi::Error FisherEncodeImpl(ffi::Buffer<DT> xs, ffi::Buffer<DT> mask,
+                            ffi::Buffer<DT> w, ffi::Buffer<DT> mu,
+                            ffi::Buffer<DT> var, ffi::Result<ffi::Buffer<DT>> out) {
+  auto xdims = xs.dimensions();
+  if (xdims.size() != 3) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "xs must be (n, T, d)");
+  }
+  const int64_t n = xdims[0], t_len = xdims[1], d = xdims[2];
+  auto mdims = mu.dimensions();
+  if (mdims.size() != 2 || mdims[1] != d) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "mu must be (K, d)");
+  }
+  const int64_t k = mdims[0];
+  if (mask.element_count() != n * t_len || w.element_count() != k ||
+      var.element_count() != k * d || out->element_count() != n * 2 * k * d) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "shape mismatch");
+  }
+
+  using T = ffi::NativeType<DT>;
+  const T* xp = xs.typed_data();
+  const T* mp = mask.typed_data();
+  const T* wp = w.typed_data();
+  const T* mup = mu.typed_data();
+  const T* vp = var.typed_data();
+  T* op = out->typed_data();
+
+  // per-component log normalizer: log w_k − ½(Σ_d log σ²_kd + D·log 2π)
+  std::vector<double> log_norm(k);
+  for (int64_t kk = 0; kk < k; ++kk) {
+    double sum_log_var = 0.0;
+    for (int64_t dd = 0; dd < d; ++dd) {
+      sum_log_var += std::log(static_cast<double>(vp[kk * d + dd]));
+    }
+    log_norm[kk] = std::log(static_cast<double>(wp[kk])) -
+                   0.5 * (sum_log_var + static_cast<double>(d) * kLog2Pi);
+  }
+
+  std::vector<double> s0(k), s1(k * d), s2(k * d), logp(k);
+  for (int64_t i = 0; i < n; ++i) {
+    EncodeOne<T, T>(xp + i * t_len * d, mp + i * t_len, wp, mup, vp, t_len, k,
+                    d, op + i * 2 * k * d, s0, s1, s2, logp, log_norm);
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(KsFisherEncodeF32,
+                              FisherEncodeImpl<ffi::DataType::F32>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(KsFisherEncodeF64,
+                              FisherEncodeImpl<ffi::DataType::F64>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F64>>());

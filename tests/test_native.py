@@ -118,3 +118,65 @@ def test_tar_index_rejects_gzip(tmp_path):
     # no ustar magic -> error (None) or empty; either way the loader falls
     # back to tarfile's auto-detection
     assert not native.tar_index(path)
+
+
+def test_fisher_encode_ffi_matches_xla():
+    # the C++ double-accumulation custom call (the EncEval-tier equivalent)
+    # must agree with the f32 XLA einsum path
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.fisher import _fisher_encode
+    from keystone_tpu.ops.fisher_ffi import ffi_available, fisher_encode_ffi
+
+    if not ffi_available():
+        import pytest
+
+        pytest.skip("FFI library unavailable")
+    rng = np.random.default_rng(0)
+    n, t, d, k = 3, 17, 8, 5
+    xs = rng.normal(size=(n, t, d)).astype(np.float32)
+    mask = (rng.uniform(size=(n, t)) > 0.3).astype(np.float32)
+    w = rng.dirichlet(np.ones(k)).astype(np.float32)
+    mu = rng.normal(size=(k, d)).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, size=(k, d)).astype(np.float32)
+    ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))
+    out = np.asarray(fisher_encode_ffi(xs, mask, w, mu, var))
+    np.testing.assert_allclose(ref, out, atol=1e-4, rtol=1e-4)
+
+
+def test_fisher_encode_ffi_f64_precision_reference():
+    # in float64 the custom call serves as the precision reference
+    # (SURVEY §7 hard part (a): f64-on-host parity for FV numerics)
+    import jax
+
+    from keystone_tpu.ops.fisher_ffi import ffi_available, fisher_encode_ffi
+
+    if not ffi_available():
+        import pytest
+
+        pytest.skip("FFI library unavailable")
+    rng = np.random.default_rng(1)
+    n, t, d, k = 2, 9, 4, 3
+    xs = rng.normal(size=(n, t, d))
+    mask = np.ones((n, t))
+    w = rng.dirichlet(np.ones(k))
+    mu = rng.normal(size=(k, d))
+    var = rng.uniform(0.5, 2.0, size=(k, d))
+    with jax.enable_x64(True):
+        out64 = np.asarray(
+            fisher_encode_ffi(
+                xs.astype(np.float64), mask, w, mu, var
+            )
+        )
+    assert out64.dtype == np.float64
+    out32 = np.asarray(
+        fisher_encode_ffi(
+            xs.astype(np.float32),
+            mask.astype(np.float32),
+            w.astype(np.float32),
+            mu.astype(np.float32),
+            var.astype(np.float32),
+        )
+    )
+    # f32 I/O with f64 accumulation stays within f32 rounding of the f64 run
+    np.testing.assert_allclose(out32, out64, atol=5e-5, rtol=5e-4)
