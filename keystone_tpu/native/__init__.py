@@ -34,20 +34,22 @@ def build_and_load(
 
     Shared by the IO binding below and the XLA-FFI binding
     (ops/fisher_ffi.py).  Returns None when the toolchain or library is
-    unavailable — callers fall back to pure-Python paths."""
+    unavailable — callers fall back to pure-Python paths.
+
+    make always runs (a no-op when the .so is fresh) so edits to the C++
+    sources rebuild instead of silently loading a stale binary; if make
+    itself is unavailable, an existing .so is still loaded."""
+    cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
+    if make_target:
+        cmd.append(make_target)
+    try:
+        subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300, check=True
+        )
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("native build failed: %s", e)
     if not os.path.exists(so_path):
-        cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
-        if make_target:
-            cmd.append(make_target)
-        try:
-            subprocess.run(
-                cmd, capture_output=True, text=True, timeout=300, check=True
-            )
-        except (subprocess.SubprocessError, OSError) as e:
-            logger.debug("native build failed: %s", e)
-            return None
-        if not os.path.exists(so_path):
-            return None
+        return None
     try:
         return ctypes.CDLL(os.path.abspath(so_path))
     except OSError as e:

@@ -83,6 +83,12 @@ def fisher_encode_ffi(xs, mask, w, mu, var):
     dt = np.dtype(xs.dtype)
     if dt not in _TARGETS:
         dt = np.dtype(np.float32)
+    if dt == np.float64 and not jax.config.jax_enable_x64:
+        # without x64, device_put canonicalizes f64 operands to f32 while
+        # the f64 FFI target still declares F64 buffers — the call would be
+        # rejected at runtime; compute in f32 I/O instead (accumulation is
+        # f64 inside the kernel either way)
+        dt = np.dtype(np.float32)
     xs = xs.astype(dt)
     n, t, d = xs.shape
     mu = np.asarray(mu, dt)

@@ -36,6 +36,7 @@ class NodeProfile:
     seconds: float
     output_bytes: int
     scale: float  # full_n / sample_n extrapolation factor
+    hlo_seconds: Optional[float] = None  # full-scale roofline estimate
 
     @property
     def full_bytes(self) -> int:
@@ -43,12 +44,64 @@ class NodeProfile:
 
     @property
     def full_seconds(self) -> float:
+        # the static estimate, when available, is already at full scale and
+        # immune to wall-clock noise / sub-sample fixed overheads
+        if self.hlo_seconds is not None:
+            return self.hlo_seconds
         return self.seconds * self.scale
 
 
-def profile_graph(graph: G.Graph, sample_size: int = 64) -> Dict[G.NodeId, NodeProfile]:
+# roofline peaks (f32 flops/s, HBM bytes/s) used to turn compiled HLO
+# counters into a time estimate.  Only the *relative* ranking across nodes
+# matters for cache placement, but the constants are real hardware numbers.
+_ROOFLINE_PEAKS = {
+    "tpu": (4.9e13, 8.1e11),  # TPU v5 lite: ~197 Tf/s bf16 → ~49 Tf/s f32; 819 GB/s
+    "axon": (4.9e13, 8.1e11),
+    "cpu": (5e10, 3e10),
+}
+
+
+def hlo_stage_cost(fn, *avals) -> Optional[dict]:
+    """Compile ``fn`` for the given ShapeDtypeStructs and read XLA's cost
+    analysis (SURVEY.md §5: "per-stage cost model from compiled HLO cost
+    analysis instead of sampling runs").  Returns {'flops', 'bytes',
+    'seconds_est'} or None when analysis is unavailable.
+
+    Nothing executes and no buffers are allocated — this prices a stage at
+    *full* batch size without paying for a full-size run."""
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*avals).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        byts = float(ca.get("bytes accessed", 0.0) or 0.0)
+        if flops <= 0.0 and byts <= 0.0:
+            return None
+        platform = jax.devices()[0].platform
+        peak_f, peak_b = _ROOFLINE_PEAKS.get(platform, _ROOFLINE_PEAKS["cpu"])
+        return {
+            "flops": flops,
+            "bytes": byts,
+            "seconds_est": max(flops / peak_f, byts / peak_b),
+        }
+    except Exception as e:  # cost analysis is best-effort
+        logger.debug("hlo cost analysis failed: %s", e)
+        return None
+
+
+def profile_graph(
+    graph: G.Graph, sample_size: int = 64, static_cost: bool = False
+) -> Dict[G.NodeId, NodeProfile]:
     """Run every reachable transformer node on truncated dataset literals,
-    recording wall time and output size (the reference's sampling pass)."""
+    recording wall time and output size (the reference's sampling pass).
+
+    With ``static_cost=True``, additionally price each device transformer
+    at FULL batch size from its compiled HLO (hlo_stage_cost) — sampled
+    runs still provide shapes and output sizes, but the seconds estimate
+    comes from XLA's own cost counters instead of extrapolated wall time."""
     from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
 
     full_n = max(
@@ -77,25 +130,67 @@ def profile_graph(graph: G.Graph, sample_size: int = 64) -> Dict[G.NodeId, NodeP
             arr = expr.dataset.array
             nbytes = int(np.prod(arr.shape)) * arr.dtype.itemsize
             sample_n = max(expr.dataset.n, 1)
+        hlo_seconds = None
+        if static_cost:
+            hlo_seconds = _static_node_seconds(truncated, ex, n, op, full_n)
         profiles[n] = NodeProfile(
             seconds=ex.timings.get(n, 0.0),
             output_bytes=nbytes,
             scale=max(full_n / sample_n, 1.0),
+            hlo_seconds=hlo_seconds,
         )
     return profiles
 
 
+def _static_node_seconds(graph: G.Graph, ex, n: G.NodeId, op, full_n: int):
+    """Full-scale roofline estimate for one transformer node, from the
+    sampled input's shape with the batch axis widened to full_n."""
+    import jax
+
+    if not isinstance(op, G.TransformerOperator):
+        return None
+    from keystone_tpu.workflow.executor import DatasetExpr
+
+    deps = graph.dependencies.get(n, ())
+    if len(deps) != 1:
+        return None
+    d = ex.results.get(deps[0])
+    if not isinstance(d, DatasetExpr) or d.dataset.is_host:
+        return None
+    ds = d.dataset
+    arr_aval = jax.ShapeDtypeStruct((full_n,) + tuple(ds.array.shape[1:]), ds.array.dtype)
+    t = op.transformer
+    if ds.mask is not None:
+        mask_aval = jax.ShapeDtypeStruct(
+            (full_n,) + tuple(ds.mask.shape[1:]), ds.mask.dtype
+        )
+        cost = hlo_stage_cost(lambda a, m: t.apply_batch(a, mask=m), arr_aval, mask_aval)
+    else:
+        cost = hlo_stage_cost(lambda a: t.apply_batch(a), arr_aval)
+    return cost["seconds_est"] if cost else None
+
+
 class ProfilingAutoCacheRule(Rule):
-    """Greedy cache placement under an HBM byte budget."""
+    """Greedy cache placement under an HBM byte budget.
+
+    ``static_cost=True`` prices nodes from compiled-HLO counters at full
+    batch size (jitter-free) instead of extrapolated sampled wall time."""
 
     name = "ProfilingAutoCache"
 
-    def __init__(self, budget_bytes: int = 8 << 30, sample_size: int = 64):
+    def __init__(
+        self,
+        budget_bytes: int = 8 << 30,
+        sample_size: int = 64,
+        static_cost: bool = False,
+    ):
         self.budget_bytes = int(budget_bytes)
         self.sample_size = int(sample_size)
+        self.static_cost = bool(static_cost)
 
     def apply(self, graph: G.Graph) -> G.Graph:
-        profiles = profile_graph(graph, self.sample_size)
+        profiles = profile_graph(graph, self.sample_size, static_cost=self.static_cost)
+        seconds = _comparable_seconds(profiles)
         shared = [
             n
             for n in graph.topological_nodes()
@@ -105,7 +200,7 @@ class ProfilingAutoCacheRule(Rule):
         # most compute saved per byte pinned, first
         shared.sort(
             key=lambda n: (
-                -(profiles[n].full_seconds / max(profiles[n].full_bytes, 1))
+                -(seconds[n] / max(profiles[n].full_bytes, 1))
                 if n in profiles
                 else 0.0
             )
@@ -131,6 +226,31 @@ class ProfilingAutoCacheRule(Rule):
                     flagged.no_memoize = True
                     graph = graph.set_operator(n, flagged)
         return graph
+
+
+def _comparable_seconds(profiles: Dict[G.NodeId, NodeProfile]) -> Dict[G.NodeId, float]:
+    """Per-node cost in ONE unit.
+
+    Roofline estimates (hlo_seconds) are idealized lower bounds, often far
+    below wall time; ranking them directly against extrapolated wall times
+    for nodes static pricing couldn't handle (gathers, host nodes) would
+    systematically favor the wall-priced nodes.  Calibrate: median
+    roofline/wall ratio over nodes that have both, applied to wall-only
+    nodes, so every entry is in pseudo-roofline seconds."""
+    ratios = [
+        p.hlo_seconds / (p.seconds * p.scale)
+        for p in profiles.values()
+        if p.hlo_seconds is not None and p.seconds > 0
+    ]
+    calib = float(np.median(ratios)) if ratios else 1.0
+    return {
+        n: (
+            p.hlo_seconds
+            if p.hlo_seconds is not None
+            else p.seconds * p.scale * calib
+        )
+        for n, p in profiles.items()
+    }
 
 
 def _insert_cacher(graph: G.Graph, n: G.NodeId) -> G.Graph:

@@ -312,3 +312,34 @@ def test_pipeline_env_inplace_extension_honored(tmp_path):
     finally:
         PipelineEnv.set_optimizer(None)
         PipelineEnv.state_dir = None
+
+
+def test_hlo_stage_cost_counts_matmul_flops():
+    import jax
+
+    from keystone_tpu.workflow.profiling import hlo_stage_cost
+
+    a = jax.ShapeDtypeStruct((256, 128), jnp.float32)
+    b = jax.ShapeDtypeStruct((128, 64), jnp.float32)
+    cost = hlo_stage_cost(lambda x, y: x @ y, a, b)
+    assert cost is not None
+    # 2*m*n*k flops, allow XLA accounting slack
+    assert cost["flops"] >= 256 * 128 * 64
+    assert cost["seconds_est"] > 0
+
+
+def test_profile_graph_static_cost_ranks_heavier_node_higher():
+    from keystone_tpu.workflow import transformer
+    from keystone_tpu.workflow.profiling import profile_graph
+
+    big = transformer(lambda x: (x @ jnp.ones((64, 512))) @ jnp.ones((512, 8)))
+    small = transformer(lambda x: x[:8] * 2.0)  # per-example, vmapped
+    p = Pipeline.gather([Pipeline.of(big), Pipeline.of(small)])
+    lazy = p(Dataset(np.ones((2048, 64), np.float32)))
+    profiles = profile_graph(lazy.graph, sample_size=16, static_cost=True)
+    static = {
+        n: pr for n, pr in profiles.items() if pr.hlo_seconds is not None
+    }
+    assert len(static) >= 2
+    times = sorted(pr.hlo_seconds for pr in static.values())
+    assert times[-1] > times[0]  # the matmul chain prices above the slice

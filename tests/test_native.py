@@ -180,3 +180,24 @@ def test_fisher_encode_ffi_f64_precision_reference():
     )
     # f32 I/O with f64 accumulation stays within f32 rounding of the f64 run
     np.testing.assert_allclose(out32, out64, atol=5e-5, rtol=5e-4)
+
+
+def test_fisher_encode_ffi_f64_input_without_x64_falls_back():
+    # with jax_enable_x64 off (the default), f64 inputs canonicalize to
+    # f32 on device; the call must route to the f32 target, not crash
+    from keystone_tpu.ops.fisher_ffi import ffi_available, fisher_encode_ffi
+
+    if not ffi_available():
+        import pytest
+
+        pytest.skip("FFI library unavailable")
+    rng = np.random.default_rng(2)
+    n, t, d, k = 2, 5, 3, 2
+    xs = rng.normal(size=(n, t, d))          # float64 by default
+    mask = np.ones((n, t))
+    w = rng.dirichlet(np.ones(k))
+    mu = rng.normal(size=(k, d))
+    var = rng.uniform(0.5, 2.0, size=(k, d))
+    out = np.asarray(fisher_encode_ffi(xs, mask, w, mu, var))
+    assert out.dtype == np.float32
+    assert np.isfinite(out).all()
