@@ -123,14 +123,11 @@ class GaussianMixtureModelEstimator(Estimator):
         return GaussianMixtureModel(w, m, v)
 
 
-@partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters"))
-def _gmm_fit(x, n, row_ok, k, iters, min_var, key, kmeans_iters):
-    x = constrain(x.astype(jnp.float32), DATA_AXIS)
-    means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key)
-    gmean = jnp.sum(x * row_ok[:, None], axis=0) / n
-    gvar = jnp.sum((x - gmean) ** 2 * row_ok[:, None], axis=0) / n
-    var0 = jnp.tile(jnp.maximum(gvar, min_var)[None, :], (k, 1))
-    w0 = jnp.full((k,), 1.0 / k, jnp.float32)
+@partial(jax.jit, static_argnames=("iters",))
+def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var):
+    """``iters`` EM steps from a given initial GMM (the deterministic part
+    of the fit; also the contract of the native C++ EM in
+    ops/fisher_ffi.py § gmm_em_ffi, which parity-tests against this)."""
 
     def em(carry, _):
         w, mu, var = carry
@@ -145,5 +142,16 @@ def _gmm_fit(x, n, row_ok, k, iters, min_var, key, kmeans_iters):
         w_new = nk / n
         return (w_new, mu_new, var_new), None
 
-    (w, mu, var), _ = lax.scan(em, (w0, means0, var0), None, length=iters)
+    (w, mu, var), _ = lax.scan(em, (w0, mu0, var0), None, length=iters)
     return w, mu, var
+
+
+@partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters"))
+def _gmm_fit(x, n, row_ok, k, iters, min_var, key, kmeans_iters):
+    x = constrain(x.astype(jnp.float32), DATA_AXIS)
+    means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key)
+    gmean = jnp.sum(x * row_ok[:, None], axis=0) / n
+    gvar = jnp.sum((x - gmean) ** 2 * row_ok[:, None], axis=0) / n
+    var0 = jnp.tile(jnp.maximum(gvar, min_var)[None, :], (k, 1))
+    w0 = jnp.full((k,), 1.0 / k, jnp.float32)
+    return _em_steps(x, n, row_ok, w0, means0, var0, iters, min_var)

@@ -40,6 +40,10 @@ _SYMBOLS = {
     np.dtype(np.float32): "KsFisherEncodeF32",
     np.dtype(np.float64): "KsFisherEncodeF64",
 }
+_EM_TARGETS = {
+    np.dtype(np.float32): ("ks_gmm_em_f32", "KsGmmEmF32"),
+    np.dtype(np.float64): ("ks_gmm_em_f64", "KsGmmEmF64"),
+}
 
 
 def ffi_available() -> bool:
@@ -60,6 +64,10 @@ def ffi_available() -> bool:
                     target,
                     jax.ffi.pycapsule(getattr(lib, _SYMBOLS[dt])),
                     platform="cpu",
+                )
+            for dt, (target, symbol) in _EM_TARGETS.items():
+                jax.ffi.register_ffi_target(
+                    target, jax.ffi.pycapsule(getattr(lib, symbol)), platform="cpu"
                 )
             _available = True
         except (OSError, AttributeError) as e:
@@ -108,4 +116,47 @@ def fisher_encode_ffi(xs, mask, w, mu, var):
             jax.device_put(np.asarray(w, dt), cpu),
             jax.device_put(mu, cpu),
             jax.device_put(np.asarray(var, dt), cpu),
+        )
+
+
+def gmm_em_ffi(x, mask, w0, mu0, var0, iters: int = 25, min_var: float = 1e-6):
+    """Run ``iters`` EM steps from the given initial GMM, in C++ with f64
+    accumulators (the EncEval-EM equivalent; models/gmm.py § _gmm_fit is
+    the jitted TPU path).  Initialization stays in Python — the seeded
+    k-means++ there can't be reproduced in C++ — so parity tests feed both
+    paths the same init.  Returns (weights (K,), means (K, d), variances
+    (K, d)).  CPU backend only."""
+    if not ffi_available():
+        raise RuntimeError(
+            "keystone FFI library unavailable (g++ or jaxlib FFI headers missing)"
+        )
+    x = np.asarray(x)
+    dt = np.dtype(x.dtype)
+    if dt not in _EM_TARGETS:
+        dt = np.dtype(np.float32)
+    if dt == np.float64 and not jax.config.jax_enable_x64:
+        dt = np.dtype(np.float32)  # see fisher_encode_ffi
+    x = x.astype(dt)
+    n, d = x.shape
+    mu0 = np.asarray(mu0, dt)
+    k = mu0.shape[0]
+    target, _ = _EM_TARGETS[dt]
+    cpu = jax.devices("cpu")[0]
+    call = jax.ffi.ffi_call(
+        target,
+        (
+            jax.ShapeDtypeStruct((k,), dt),
+            jax.ShapeDtypeStruct((k, d), dt),
+            jax.ShapeDtypeStruct((k, d), dt),
+        ),
+    )
+    with jax.default_device(cpu):
+        return call(
+            jax.device_put(x, cpu),
+            jax.device_put(np.asarray(mask, dt), cpu),
+            jax.device_put(np.asarray(w0, dt), cpu),
+            jax.device_put(mu0, cpu),
+            jax.device_put(np.asarray(var0, dt), cpu),
+            iters=np.int64(iters),
+            min_var=np.float64(min_var),
         )

@@ -152,6 +152,137 @@ ffi::Error FisherEncodeImpl(ffi::Buffer<DT> xs, ffi::Buffer<DT> mask,
 
 }  // namespace
 
+namespace {
+
+// Diagonal-covariance GMM EM, double accumulators, from given initial
+// parameters (initialization stays in Python — k-means++ there is seeded
+// jax.random, which C++ can't reproduce; EM itself is deterministic).
+// Mirrors models/gmm.py § _gmm_fit's em() body: responsibilities from the
+// log-density, nk floored at 1e-10, variances floored at min_var, weights
+// nk / Σmask.
+template <ffi::DataType DT>
+ffi::Error GmmEmImpl(ffi::Buffer<DT> x, ffi::Buffer<DT> mask,
+                     ffi::Buffer<DT> w0, ffi::Buffer<DT> mu0,
+                     ffi::Buffer<DT> var0, ffi::Result<ffi::Buffer<DT>> w_out,
+                     ffi::Result<ffi::Buffer<DT>> mu_out,
+                     ffi::Result<ffi::Buffer<DT>> var_out, int64_t iters,
+                     double min_var) {
+  auto xdims = x.dimensions();
+  if (xdims.size() != 2) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "x must be (n, d)");
+  }
+  const int64_t n = xdims[0], d = xdims[1];
+  const int64_t k = w0.element_count();
+  if (mask.element_count() != n || mu0.element_count() != k * d ||
+      var0.element_count() != k * d || w_out->element_count() != k ||
+      mu_out->element_count() != k * d || var_out->element_count() != k * d) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument, "shape mismatch");
+  }
+
+  using T = ffi::NativeType<DT>;
+  const T* xp = x.typed_data();
+  const T* mp = mask.typed_data();
+
+  std::vector<double> w(k), mu(k * d), var(k * d);
+  for (int64_t i = 0; i < k; ++i) w[i] = static_cast<double>(w0.typed_data()[i]);
+  for (int64_t i = 0; i < k * d; ++i) {
+    mu[i] = static_cast<double>(mu0.typed_data()[i]);
+    var[i] = static_cast<double>(var0.typed_data()[i]);
+  }
+  double count = 0.0;
+  for (int64_t t = 0; t < n; ++t) count += static_cast<double>(mp[t]);
+  if (count <= 0.0) count = 1.0;
+
+  std::vector<double> log_norm(k), logp(k), nk(k), s1(k * d), s2(k * d);
+  for (int64_t it = 0; it < iters; ++it) {
+    for (int64_t kk = 0; kk < k; ++kk) {
+      double sum_log_var = 0.0;
+      for (int64_t dd = 0; dd < d; ++dd) sum_log_var += std::log(var[kk * d + dd]);
+      log_norm[kk] =
+          std::log(w[kk]) - 0.5 * (sum_log_var + static_cast<double>(d) * kLog2Pi);
+    }
+    std::fill(nk.begin(), nk.end(), 0.0);
+    std::fill(s1.begin(), s1.end(), 0.0);
+    std::fill(s2.begin(), s2.end(), 0.0);
+    for (int64_t t = 0; t < n; ++t) {
+      const double m = static_cast<double>(mp[t]);
+      if (m == 0.0) continue;
+      const T* xt = xp + t * d;
+      double mx = -INFINITY;
+      for (int64_t kk = 0; kk < k; ++kk) {
+        double quad = 0.0;
+        for (int64_t dd = 0; dd < d; ++dd) {
+          const double diff =
+              static_cast<double>(xt[dd]) - mu[kk * d + dd];
+          quad += diff * diff / var[kk * d + dd];
+        }
+        logp[kk] = log_norm[kk] - 0.5 * quad;
+        if (logp[kk] > mx) mx = logp[kk];
+      }
+      double z = 0.0;
+      for (int64_t kk = 0; kk < k; ++kk) {
+        logp[kk] = std::exp(logp[kk] - mx);
+        z += logp[kk];
+      }
+      for (int64_t kk = 0; kk < k; ++kk) {
+        const double r = m * logp[kk] / z;
+        if (r == 0.0) continue;
+        nk[kk] += r;
+        for (int64_t dd = 0; dd < d; ++dd) {
+          const double xv = static_cast<double>(xt[dd]);
+          s1[kk * d + dd] += r * xv;
+          s2[kk * d + dd] += r * xv * xv;
+        }
+      }
+    }
+    for (int64_t kk = 0; kk < k; ++kk) {
+      const double nkk = std::max(nk[kk], 1e-10);
+      for (int64_t dd = 0; dd < d; ++dd) {
+        const double m1 = s1[kk * d + dd] / nkk;
+        mu[kk * d + dd] = m1;
+        var[kk * d + dd] =
+            std::max(s2[kk * d + dd] / nkk - m1 * m1, min_var);
+      }
+      w[kk] = nkk / count;
+    }
+  }
+
+  for (int64_t i = 0; i < k; ++i) w_out->typed_data()[i] = static_cast<T>(w[i]);
+  for (int64_t i = 0; i < k * d; ++i) {
+    mu_out->typed_data()[i] = static_cast<T>(mu[i]);
+    var_out->typed_data()[i] = static_cast<T>(var[i]);
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(KsGmmEmF32, GmmEmImpl<ffi::DataType::F32>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F32>>()
+                                  .Attr<int64_t>("iters")
+                                  .Attr<double>("min_var"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(KsGmmEmF64, GmmEmImpl<ffi::DataType::F64>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Arg<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Ret<ffi::Buffer<ffi::DataType::F64>>()
+                                  .Attr<int64_t>("iters")
+                                  .Attr<double>("min_var"));
+
 XLA_FFI_DEFINE_HANDLER_SYMBOL(KsFisherEncodeF32,
                               FisherEncodeImpl<ffi::DataType::F32>,
                               ffi::Ffi::Bind()

@@ -201,3 +201,37 @@ def test_fisher_encode_ffi_f64_input_without_x64_falls_back():
     out = np.asarray(fisher_encode_ffi(xs, mask, w, mu, var))
     assert out.dtype == np.float32
     assert np.isfinite(out).all()
+
+
+def test_gmm_em_ffi_matches_jitted_em():
+    # same init -> the C++ double-accumulation EM and the jitted EM must
+    # agree (the EncEval-EM parity check; init stays in Python)
+    import jax.numpy as jnp
+
+    from keystone_tpu.models.gmm import _em_steps
+    from keystone_tpu.ops.fisher_ffi import ffi_available, gmm_em_ffi
+
+    if not ffi_available():
+        import pytest
+
+        pytest.skip("FFI library unavailable")
+    rng = np.random.default_rng(0)
+    n, d, k = 200, 6, 3
+    centers = rng.normal(scale=4.0, size=(k, d))
+    x = (centers[rng.integers(0, k, n)] + rng.normal(size=(n, d))).astype(
+        np.float32
+    )
+    mask = np.ones((n,), np.float32)
+    w0 = np.full((k,), 1.0 / k, np.float32)
+    mu0 = x[:k].copy()
+    var0 = np.ones((k, d), np.float32)
+
+    w_j, mu_j, var_j = _em_steps(
+        jnp.asarray(x), jnp.float32(n), jnp.asarray(mask),
+        jnp.asarray(w0), jnp.asarray(mu0), jnp.asarray(var0), 10, 1e-6,
+    )
+    w_c, mu_c, var_c = gmm_em_ffi(x, mask, w0, mu0, var0, iters=10)
+    np.testing.assert_allclose(np.asarray(w_j), np.asarray(w_c), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mu_j), np.asarray(mu_c), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(var_j), np.asarray(var_c), atol=2e-4)
+    assert abs(float(np.sum(np.asarray(w_c))) - 1.0) < 1e-5

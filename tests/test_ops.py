@@ -346,3 +346,69 @@ def test_ragged_flow_sift_to_fv():
     fv_t = fv_est.fit_dataset(sampled)
     fv_ds = fv_t.apply_dataset(sift_ds)
     assert fv_ds.numpy().shape == (2, 2 * 2 * 128)
+
+
+def test_sift_matches_independent_numpy_reference():
+    # independent slow implementation of the same dense-SIFT spec
+    # (loops + np.convolve vs the jitted conv program) — the golden-value
+    # pattern the reference uses for its image ops (SURVEY §4)
+    from keystone_tpu.ops.sift import (
+        SIFTExtractor,
+        _keypoint_grid,
+        _triangular_kernel,
+    )
+
+    rng = np.random.default_rng(0)
+    h = w = 32
+    step, bin_size, o, grid = 4, 4, 8, 4
+    img = rng.uniform(0, 1, (h, w)).astype(np.float32)
+
+    # gradients (central differences, zero at borders)
+    dy = np.zeros((h, w), np.float32)
+    dx = np.zeros((h, w), np.float32)
+    dy[1:-1, :] = (img[2:, :] - img[:-2, :]) * 0.5
+    dx[:, 1:-1] = (img[:, 2:] - img[:, :-2]) * 0.5
+    mag = np.sqrt(dx * dx + dy * dy)
+    ang = np.arctan2(dy, dx) % (2 * np.pi)
+
+    # soft orientation binning
+    theta = ang * (o / (2 * np.pi))
+    lo = np.floor(theta).astype(int) % o
+    hi = (lo + 1) % o
+    frac = theta - np.floor(theta)
+    omap = np.zeros((h, w, o), np.float32)
+    for yy in range(h):
+        for xx in range(w):
+            omap[yy, xx, lo[yy, xx]] += mag[yy, xx] * (1 - frac[yy, xx])
+            omap[yy, xx, hi[yy, xx]] += mag[yy, xx] * frac[yy, xx]
+
+    # separable triangular window, SAME padding
+    k1 = _triangular_kernel(bin_size)
+    pad = len(k1) // 2
+    sm = np.zeros_like(omap)
+    for c in range(o):
+        tmp = np.zeros((h, w), np.float32)
+        for xx in range(w):
+            tmp[:, xx] = np.convolve(omap[:, xx, c], k1, mode="same")
+        for yy in range(h):
+            sm[yy, :, c] = np.convolve(tmp[yy, :], k1, mode="same")
+
+    ys = _keypoint_grid(h, step, bin_size)
+    xs_ = _keypoint_grid(w, step, bin_size)
+    offs = ((np.arange(grid) - (grid - 1) / 2.0) * bin_size).astype(int)
+    descs = []
+    for cy in ys:
+        for cx in xs_:
+            d = np.stack(
+                [sm[cy + oy, cx + ox] for oy in offs for ox in offs]
+            ).reshape(-1)
+            n1 = max(np.linalg.norm(d), 1e-8)
+            d = np.minimum(d / n1, 0.2)
+            d = d / max(np.linalg.norm(d), 1e-8)
+            descs.append(d)
+    ref = np.stack(descs)
+
+    out, mask = SIFTExtractor(step=step, bin_sizes=(bin_size,)).apply_batch(
+        img[None]
+    )
+    np.testing.assert_allclose(np.asarray(out[0]), ref, atol=2e-5, rtol=2e-4)
