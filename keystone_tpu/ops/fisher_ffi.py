@@ -30,7 +30,9 @@ _SO_PATH = os.path.join(
     os.path.dirname(__file__), "..", "native", "libkeystone_ffi.so"
 )
 _lock = threading.Lock()
-_available: bool | None = None
+# registered per target group, so a stale prebuilt .so missing the newer
+# EM symbols still serves the fisher-encode targets it does have
+_registered: dict[str, bool] = {}
 
 _TARGETS = {
     np.dtype(np.float32): "ks_fisher_encode_f32",
@@ -44,36 +46,54 @@ _EM_TARGETS = {
     np.dtype(np.float32): ("ks_gmm_em_f32", "KsGmmEmF32"),
     np.dtype(np.float64): ("ks_gmm_em_f64", "KsGmmEmF64"),
 }
+_GROUPS = {
+    "fisher": [(_TARGETS[dt], _SYMBOLS[dt]) for dt in _TARGETS],
+    "em": list(_EM_TARGETS.values()),
+}
+_lib = None
+_lib_loaded = False
 
 
-def ffi_available() -> bool:
-    """Load + register the custom-call library (build lazily if needed)."""
-    global _available
+def ffi_available(group: str = "fisher") -> bool:
+    """Load the custom-call library (build lazily) and register the given
+    target group ("fisher" or "em")."""
+    global _lib, _lib_loaded
     with _lock:
-        if _available is not None:
-            return _available
-        from keystone_tpu.native import build_and_load
+        if group in _registered:
+            return _registered[group]
+        if not _lib_loaded:
+            from keystone_tpu.native import build_and_load
 
-        lib = build_and_load(_SO_PATH, make_target="ffi")
+            _lib = build_and_load(_SO_PATH, make_target="ffi")
+            _lib_loaded = True
+        lib = _lib
         if lib is None:
-            _available = False
+            _registered[group] = False
             return False
         try:
-            for dt, target in _TARGETS.items():
-                jax.ffi.register_ffi_target(
-                    target,
-                    jax.ffi.pycapsule(getattr(lib, _SYMBOLS[dt])),
-                    platform="cpu",
-                )
-            for dt, (target, symbol) in _EM_TARGETS.items():
+            for target, symbol in _GROUPS[group]:
                 jax.ffi.register_ffi_target(
                     target, jax.ffi.pycapsule(getattr(lib, symbol)), platform="cpu"
                 )
-            _available = True
+            _registered[group] = True
         except (OSError, AttributeError) as e:
-            logger.warning("could not register FFI targets: %s", e)
-            _available = False
-    return _available
+            logger.warning("could not register FFI targets (%s): %s", group, e)
+            _registered[group] = False
+    return _registered[group]
+
+
+def _resolve_dtype(arr: np.ndarray, targets) -> np.dtype:
+    """Pick the FFI I/O dtype for ``arr``: f32/f64 by input dtype, but fall
+    back to f32 when x64 is disabled — device_put would canonicalize f64
+    operands to f32 while the f64 target still declares F64 buffers, and
+    the call would be rejected at runtime.  (Accumulation is f64 inside
+    the kernels either way.)"""
+    dt = np.dtype(arr.dtype)
+    if dt not in targets:
+        dt = np.dtype(np.float32)
+    if dt == np.float64 and not jax.config.jax_enable_x64:
+        dt = np.dtype(np.float32)
+    return dt
 
 
 def fisher_encode_ffi(xs, mask, w, mu, var):
@@ -88,15 +108,7 @@ def fisher_encode_ffi(xs, mask, w, mu, var):
             "keystone FFI library unavailable (g++ or jaxlib FFI headers missing)"
         )
     xs = np.asarray(xs)
-    dt = np.dtype(xs.dtype)
-    if dt not in _TARGETS:
-        dt = np.dtype(np.float32)
-    if dt == np.float64 and not jax.config.jax_enable_x64:
-        # without x64, device_put canonicalizes f64 operands to f32 while
-        # the f64 FFI target still declares F64 buffers — the call would be
-        # rejected at runtime; compute in f32 I/O instead (accumulation is
-        # f64 inside the kernel either way)
-        dt = np.dtype(np.float32)
+    dt = _resolve_dtype(xs, _TARGETS)
     xs = xs.astype(dt)
     n, t, d = xs.shape
     mu = np.asarray(mu, dt)
@@ -126,16 +138,13 @@ def gmm_em_ffi(x, mask, w0, mu0, var0, iters: int = 25, min_var: float = 1e-6):
     k-means++ there can't be reproduced in C++ — so parity tests feed both
     paths the same init.  Returns (weights (K,), means (K, d), variances
     (K, d)).  CPU backend only."""
-    if not ffi_available():
+    if not ffi_available("em"):
         raise RuntimeError(
-            "keystone FFI library unavailable (g++ or jaxlib FFI headers missing)"
+            "keystone FFI library unavailable (g++ or jaxlib FFI headers missing,"
+            " or a stale library without the EM symbols)"
         )
     x = np.asarray(x)
-    dt = np.dtype(x.dtype)
-    if dt not in _EM_TARGETS:
-        dt = np.dtype(np.float32)
-    if dt == np.float64 and not jax.config.jax_enable_x64:
-        dt = np.dtype(np.float32)  # see fisher_encode_ffi
+    dt = _resolve_dtype(x, _EM_TARGETS)
     x = x.astype(dt)
     n, d = x.shape
     mu0 = np.asarray(mu0, dt)

@@ -1,0 +1,347 @@
+"""Loader tests (reference `src/test/scala/loaders/*Suite.scala` — tiny
+fixture files exercising each on-disk format, SURVEY.md §4).
+
+Each loader is tested against a hand-built fixture file in the format the
+reference consumes, plus the synthetic() constructors used when no
+datasets ship with the environment.
+"""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders import (
+    AmazonReviewsDataLoader,
+    CifarLoader,
+    CsvDataLoader,
+    ImageNetLoader,
+    LabeledData,
+    MnistLoader,
+    NewsgroupsDataLoader,
+    TimitFeaturesDataLoader,
+    VOCLoader,
+)
+from keystone_tpu.loaders.stream import ShardedBatchStream, batched
+from keystone_tpu.workflow.dataset import Dataset
+
+
+def _jpeg_bytes(h=32, w=32, color=(255, 0, 0)):
+    from PIL import Image as PILImage
+
+    img = PILImage.new("RGB", (w, h), color)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------- CSV / MNIST
+
+
+def test_csv_loader_labelled(tmp_path):
+    p = tmp_path / "mnist.csv"
+    rows = np.array(
+        [[3, 0.5, 1.5, 2.5], [7, 4.0, 5.0, 6.0], [1, -1.0, 0.0, 1.0]],
+        np.float32,
+    )
+    np.savetxt(p, rows, delimiter=",")
+    ld = CsvDataLoader.load(str(p), label_col=0)
+    assert ld.n == 3
+    np.testing.assert_array_equal(ld.labels.numpy(), [3, 7, 1])
+    np.testing.assert_allclose(ld.data.numpy(), rows[:, 1:], rtol=1e-6)
+
+
+def test_csv_loader_unlabeled_and_delimiter(tmp_path):
+    p = tmp_path / "data.tsv"
+    p.write_text("1.0\t2.0\n3.0\t4.0\n")
+    ds = CsvDataLoader.load_unlabeled(str(p), delimiter="\t")
+    np.testing.assert_allclose(ds.numpy(), [[1, 2], [3, 4]])
+
+
+def test_csv_loader_single_row(tmp_path):
+    p = tmp_path / "one.csv"
+    p.write_text("5,1.0,2.0\n")
+    ld = CsvDataLoader.load(str(p))
+    assert ld.n == 1 and int(ld.labels.numpy()[0]) == 5
+
+
+def test_mnist_loader_reads_csv(tmp_path):
+    p = tmp_path / "mnist.csv"
+    n, d = 4, 784
+    rng = np.random.default_rng(0)
+    mat = np.concatenate(
+        [rng.integers(0, 10, (n, 1)), rng.uniform(0, 255, (n, d))], axis=1
+    )
+    np.savetxt(p, mat, delimiter=",")
+    ld = MnistLoader.load(str(p))
+    assert ld.data.numpy().shape == (n, d)
+    np.testing.assert_array_equal(ld.labels.numpy(), mat[:, 0].astype(np.int32))
+
+
+def test_mnist_synthetic_separable_structure():
+    tr = MnistLoader.synthetic(n=256, seed=0)
+    te = MnistLoader.synthetic(n=128, seed=1)
+    assert tr.data.numpy().shape == (256, 784)
+    assert te.labels.numpy().min() >= 0 and te.labels.numpy().max() < 10
+    # train/test share class prototypes: per-class means should correlate
+    xtr, ytr = tr.data.numpy(), tr.labels.numpy()
+    xte, yte = te.data.numpy(), te.labels.numpy()
+    for c in range(3):
+        if (ytr == c).sum() > 4 and (yte == c).sum() > 4:
+            mtr = xtr[ytr == c].mean(0)
+            mte = xte[yte == c].mean(0)
+            r = np.corrcoef(mtr, mte)[0, 1]
+            assert r > 0.5
+
+
+# -------------------------------------------------------------------- CIFAR
+
+
+def _write_cifar(path, n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    pixels = rng.integers(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+    recs = np.concatenate([labels[:, None], pixels.reshape(n, -1)], axis=1)
+    recs.tofile(path)
+    return labels, pixels
+
+
+def test_cifar_loader_binary_format(tmp_path):
+    p = tmp_path / "data_batch.bin"
+    labels, pixels = _write_cifar(str(p))
+    ld = CifarLoader.load(str(p))
+    np.testing.assert_array_equal(ld.labels.numpy(), labels)
+    x = ld.data.numpy()
+    assert x.shape == (6, 32, 32, 3)
+    # channel-major planes → NHWC, scaled to [0,1]
+    np.testing.assert_allclose(
+        x, pixels.transpose(0, 2, 3, 1).astype(np.float32) / 255.0, atol=1e-6
+    )
+
+
+def test_cifar_loader_rejects_truncated(tmp_path):
+    p = tmp_path / "bad.bin"
+    p.write_bytes(b"\x00" * 100)
+    with pytest.raises(ValueError):
+        # force the pure-python path's validation by making native fail too
+        CifarLoader.load(str(p))
+
+
+# ------------------------------------------------------------------- TIMIT
+
+
+def test_timit_loader_npy_and_csv(tmp_path):
+    n = 5
+    feats = np.random.default_rng(0).normal(size=(n, 440)).astype(np.float32)
+    labels = np.arange(n, dtype=np.int64)
+    fp, lp = tmp_path / "f.npy", tmp_path / "l.npy"
+    np.save(fp, feats)
+    np.save(lp, labels)
+    ld = TimitFeaturesDataLoader.load(str(fp), str(lp))
+    np.testing.assert_allclose(ld.data.numpy(), feats, rtol=1e-6)
+    np.testing.assert_array_equal(ld.labels.numpy(), labels)
+
+    fc, lc = tmp_path / "f.csv", tmp_path / "l.txt"
+    np.savetxt(fc, feats, delimiter=",")
+    np.savetxt(lc, labels, fmt="%d")
+    ld2 = TimitFeaturesDataLoader.load(str(fc), str(lc))
+    np.testing.assert_allclose(ld2.data.numpy(), feats, rtol=1e-5)
+    np.testing.assert_array_equal(ld2.labels.numpy(), labels)
+
+
+# -------------------------------------------------------------- Newsgroups
+
+
+def test_newsgroups_directory_tree(tmp_path):
+    for gi, g in enumerate(["alt.atheism", "sci.space"]):
+        d = tmp_path / g
+        d.mkdir()
+        for k in range(3):
+            (d / f"{1000 + k}").write_text(f"post {k} about group {gi}")
+    ld = NewsgroupsDataLoader.load(str(tmp_path))
+    assert ld.n == 6
+    np.testing.assert_array_equal(ld.labels.numpy(), [0, 0, 0, 1, 1, 1])
+    assert "post 0" in ld.data.items[0]
+
+
+def test_newsgroups_explicit_group_order(tmp_path):
+    for g in ["b.group", "a.group"]:
+        d = tmp_path / g
+        d.mkdir()
+        (d / "1").write_text(g)
+    ld = NewsgroupsDataLoader.load(str(tmp_path), groups=["b.group", "a.group"])
+    assert ld.data.items[0] == "b.group"
+    assert list(ld.labels.numpy()) == [0, 1]
+
+
+# ------------------------------------------------------------------ Amazon
+
+
+def test_amazon_reviews_jsonl(tmp_path):
+    p = tmp_path / "reviews.json"
+    recs = [
+        {"reviewText": "love it", "overall": 5.0},
+        {"reviewText": "meh", "overall": 3.0},
+        {"text": "alt key", "rating": 4.0},  # alternate field names
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in recs) + "\n\n")
+    ld = AmazonReviewsDataLoader.load(str(p))
+    assert ld.n == 3
+    np.testing.assert_array_equal(ld.labels.numpy(), [1, 0, 1])
+    assert ld.data.items[2] == "alt key"
+
+
+# ---------------------------------------------------------------- ImageNet
+
+
+def test_imagenet_tar_labels_and_decode(tmp_path):
+    colors = {"n001": (255, 0, 0), "n002": (0, 255, 0)}
+    for synset, color in colors.items():
+        with tarfile.open(tmp_path / f"{synset}.tar", "w") as tf:
+            for k in range(2):
+                blob = _jpeg_bytes(16, 16, color)
+                info = tarfile.TarInfo(f"{synset}_{k}.JPEG")
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+    ld = ImageNetLoader.load(str(tmp_path), size=(16, 16))
+    assert ld.data.numpy().shape == (4, 16, 16, 3)
+    np.testing.assert_array_equal(ld.labels.numpy(), [0, 0, 1, 1])
+    # red synset decodes red-dominant, green synset green-dominant
+    x = ld.data.numpy()
+    assert x[0, ..., 0].mean() > 0.8 and x[0, ..., 1].mean() < 0.2
+    assert x[2, ..., 1].mean() > 0.8 and x[2, ..., 0].mean() < 0.2
+
+
+def test_imagenet_limit_and_label_map(tmp_path):
+    with tarfile.open(tmp_path / "syn.tar", "w") as tf:
+        for k in range(5):
+            blob = _jpeg_bytes(8, 8)
+            info = tarfile.TarInfo(f"img{k}.JPEG")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    ld = ImageNetLoader.load(
+        str(tmp_path / "syn.tar"), label_map={"syn": 7}, size=(8, 8), limit=3
+    )
+    assert ld.n == 3
+    assert set(ld.labels.numpy().tolist()) == {7}
+
+
+def test_imagenet_skips_undecodable_members(tmp_path):
+    with tarfile.open(tmp_path / "syn.tar", "w") as tf:
+        good = _jpeg_bytes(8, 8)
+        for name, blob in [("a.JPEG", good), ("bad.JPEG", b"not a jpeg"), ("c.JPEG", good)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    ld = ImageNetLoader.load(str(tmp_path / "syn.tar"), size=(8, 8))
+    assert ld.n == 2
+
+
+def test_imagenet_synthetic_class_signal():
+    ld = ImageNetLoader.synthetic(n=8, num_classes=4, size=(32, 32), seed=0)
+    assert ld.data.numpy().shape == (8, 32, 32, 3)
+    assert ld.data.numpy().min() >= 0 and ld.data.numpy().max() <= 1
+
+
+# --------------------------------------------------------------------- VOC
+
+
+def test_voc_loader_multilabel(tmp_path):
+    imgs = tmp_path / "JPEGImages"
+    anns = tmp_path / "Annotations"
+    imgs.mkdir()
+    anns.mkdir()
+    (imgs / "000001.jpg").write_bytes(_jpeg_bytes(16, 16))
+    (anns / "000001.xml").write_text(
+        "<annotation><object><name>dog</name></object>"
+        "<object><name>cat</name></object>"
+        "<object><name>notaclass</name></object></annotation>"
+    )
+    # annotation without a matching jpg is skipped
+    (anns / "000002.xml").write_text(
+        "<annotation><object><name>dog</name></object></annotation>"
+    )
+    ld = VOCLoader.load(str(imgs), str(anns), size=(16, 16))
+    assert ld.n == 1
+    y = ld.labels.numpy()[0]
+    from keystone_tpu.loaders.voc import VOC_CLASSES
+
+    assert y[VOC_CLASSES.index("dog")] == 1.0
+    assert y[VOC_CLASSES.index("cat")] == 1.0
+    assert y.sum() == 2.0
+
+
+def test_voc_synthetic_multilabel():
+    ld = VOCLoader.synthetic(n=16, size=(32, 32), seed=0)
+    y = ld.labels.numpy()
+    assert y.shape == (16, 20)
+    assert ((y == 0) | (y == 1)).all()
+    assert (y.sum(axis=1) >= 1).all()
+
+
+# ------------------------------------------------------------- LabeledData
+
+
+def test_labeled_data_split_deterministic():
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.int32)
+    ld = LabeledData(Dataset(x), Dataset(y))
+    a1, b1 = ld.split(0.75, seed=3)
+    a2, b2 = ld.split(0.75, seed=3)
+    assert a1.n == 15 and b1.n == 5
+    np.testing.assert_array_equal(a1.labels.numpy(), a2.labels.numpy())
+    # rows stay paired with their labels
+    np.testing.assert_array_equal(a1.data.numpy()[:, 0], a1.labels.numpy() * 2)
+    # no overlap, full coverage
+    assert set(a1.labels.numpy()) | set(b1.labels.numpy()) == set(range(20))
+
+
+def test_labeled_data_split_host_items():
+    texts = [f"doc{i}" for i in range(10)]
+    ld = LabeledData(Dataset(texts), Dataset(np.arange(10, dtype=np.int32)))
+    a, b = ld.split(0.5, seed=0)
+    assert a.n == 5 and b.n == 5
+    for t, lab in zip(a.data.items, a.labels.numpy()):
+        assert t == f"doc{lab}"
+
+
+# ------------------------------------------------------------------ stream
+
+
+def test_sharded_batch_stream_order_and_transform():
+    data = np.arange(64, dtype=np.float32).reshape(16, 4)
+    stream = ShardedBatchStream(batched(data, 8), transform=lambda b: b * 2)
+    out = np.concatenate([np.asarray(b) for b in stream])
+    np.testing.assert_allclose(out, data * 2)
+
+
+def test_sharded_batch_stream_reiterable():
+    data = np.arange(16, dtype=np.float32).reshape(8, 2)
+    stream = ShardedBatchStream(batched(data, 4))
+    first = [np.asarray(b) for b in stream]
+    second = [np.asarray(b) for b in stream]
+    assert len(first) == len(second) == 2
+    np.testing.assert_allclose(np.concatenate(first), np.concatenate(second))
+
+
+def test_sharded_batch_stream_propagates_worker_error():
+    def bad_source():
+        yield np.zeros((4, 2), np.float32)
+        raise RuntimeError("decode failed")
+
+    stream = ShardedBatchStream(bad_source())
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(stream)
+
+
+def test_sharded_batch_stream_batches_are_device_sharded(mesh):
+    import jax
+
+    data = np.arange(64, dtype=np.float32).reshape(16, 4)
+    batches = list(ShardedBatchStream(batched(data, 8)))
+    assert all(isinstance(b, jax.Array) for b in batches)
+    # batch axis sharded over the 'data' axis of the mesh
+    assert batches[0].sharding.spec[0] == "data"

@@ -211,7 +211,7 @@ def test_gmm_em_ffi_matches_jitted_em():
     from keystone_tpu.models.gmm import _em_steps
     from keystone_tpu.ops.fisher_ffi import ffi_available, gmm_em_ffi
 
-    if not ffi_available():
+    if not ffi_available("em"):
         import pytest
 
         pytest.skip("FFI library unavailable")

@@ -384,7 +384,6 @@ def test_sift_matches_independent_numpy_reference():
 
     # separable triangular window, SAME padding
     k1 = _triangular_kernel(bin_size)
-    pad = len(k1) // 2
     sm = np.zeros_like(omap)
     for c in range(o):
         tmp = np.zeros((h, w), np.float32)
