@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Pipeline launcher — the reference's bin/run-pipeline.sh (spark-submit
+# wrapper with KEYSTONE_MEM) re-imagined for the TPU runtime.
+#
+#   bin/run-pipeline.sh <PipelineName> [pipeline flags...]
+#   bin/run-pipeline.sh --list
+#
+# Environment knobs (all optional):
+#   KEYSTONE_PLATFORM   jax platform to force (e.g. "cpu" for the virtual
+#                       device path; default: whatever the env provides)
+#   KEYSTONE_NUM_CPU_DEVICES
+#                       with KEYSTONE_PLATFORM=cpu, number of virtual host
+#                       devices to expose (the LocalSparkContext analogue)
+#   KEYSTONE_MEM        fraction of HBM jax may preallocate, e.g. "0.8".
+#                       NOTE: plays the role of the reference's
+#                       executor-memory knob but takes a fraction in
+#                       (0,1], NOT a JVM size like "4g"
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+if [[ -n "${KEYSTONE_PLATFORM:-}" ]]; then
+  export JAX_PLATFORMS="${KEYSTONE_PLATFORM}"
+fi
+if [[ -n "${KEYSTONE_NUM_CPU_DEVICES:-}" ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${KEYSTONE_NUM_CPU_DEVICES}"
+fi
+if [[ -n "${KEYSTONE_MEM:-}" ]]; then
+  if ! [[ "${KEYSTONE_MEM}" =~ ^0?\.[0-9]+$|^1(\.0+)?$ ]]; then
+    echo "KEYSTONE_MEM must be a fraction in (0,1], e.g. 0.8 (got '${KEYSTONE_MEM}')" >&2
+    exit 2
+  fi
+  export XLA_PYTHON_CLIENT_MEM_FRACTION="${KEYSTONE_MEM}"
+fi
+
+exec python -m keystone_tpu.cli "$@"

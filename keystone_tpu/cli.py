@@ -7,6 +7,7 @@
 from __future__ import annotations
 
 import importlib
+import os
 import sys
 
 _PIPELINE_MODULES = {
@@ -21,7 +22,21 @@ _PIPELINE_MODULES = {
 }
 
 
+def _apply_platform_env() -> None:
+    """Honor KEYSTONE_PLATFORM before any backend is initialized.
+
+    Some environments force a platform programmatically at interpreter
+    start (overriding JAX_PLATFORMS), so the launcher's env var must be
+    re-applied through jax.config here."""
+    platform = os.environ.get("KEYSTONE_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def main(argv=None):
+    _apply_platform_env()
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")

@@ -46,3 +46,9 @@ from keystone_tpu.models.kernel_ridge import (  # noqa: F401
     KernelBlockLinearMapper,
     KernelRidgeRegressionEstimator,
 )
+
+# Reference-named aliases (KeystoneML class names without the Estimator
+# suffix: nodes/learning/BlockWeightedLeastSquares.scala,
+# nodes/learning/KernelRidgeRegression.scala)
+BlockWeightedLeastSquares = BlockWeightedLeastSquaresEstimator
+KernelRidgeRegression = KernelRidgeRegressionEstimator

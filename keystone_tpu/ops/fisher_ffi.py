@@ -57,6 +57,8 @@ _lib_loaded = False
 def ffi_available(group: str = "fisher") -> bool:
     """Load the custom-call library (build lazily) and register the given
     target group ("fisher" or "em")."""
+    if group not in _GROUPS:
+        raise ValueError(f"unknown FFI group {group!r}; valid: {sorted(_GROUPS)}")
     global _lib, _lib_loaded
     with _lock:
         if group in _registered:

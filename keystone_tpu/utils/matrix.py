@@ -28,6 +28,9 @@ def matrix_to_rows(mat) -> list:
     return [mat[i] for i in range(mat.shape[0])]
 
 
+matrix_to_row_array = matrix_to_rows  # reference-named alias
+
+
 def shuffle_rows(mat, seed: int = 0) -> jnp.ndarray:
     """Row permutation with a fixed seed (MatrixUtils.shuffleArray analogue)."""
     mat = jnp.asarray(mat)

@@ -144,3 +144,8 @@ def _prune_orphans(graph: G.Graph) -> G.Graph:
         if n not in keep:
             graph = graph.remove_node(n)
     return graph
+
+
+# Reference-named alias: workflow/ExtractSaveablePrefixes.scala — the pass
+# that walks a pipeline result and persists every stable-signature prefix.
+ExtractSaveablePrefixes = save_pipeline_state

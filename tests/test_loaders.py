@@ -122,11 +122,14 @@ def test_cifar_loader_binary_format(tmp_path):
     )
 
 
-def test_cifar_loader_rejects_truncated(tmp_path):
+def test_cifar_loader_rejects_truncated(tmp_path, monkeypatch):
+    from keystone_tpu import native
+
+    # force the pure-python path so its validation is what's under test
+    monkeypatch.setattr(native, "read_cifar", lambda path: None)
     p = tmp_path / "bad.bin"
     p.write_bytes(b"\x00" * 100)
     with pytest.raises(ValueError):
-        # force the pure-python path's validation by making native fail too
         CifarLoader.load(str(p))
 
 
