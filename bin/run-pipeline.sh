@@ -27,7 +27,8 @@ if [[ -n "${KEYSTONE_NUM_CPU_DEVICES:-}" ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${KEYSTONE_NUM_CPU_DEVICES}"
 fi
 if [[ -n "${KEYSTONE_MEM:-}" ]]; then
-  if ! [[ "${KEYSTONE_MEM}" =~ ^0?\.[0-9]+$|^1(\.0+)?$ ]]; then
+  # a fraction in (0,1]: either has a nonzero digit after the point, or is 1
+  if ! [[ "${KEYSTONE_MEM}" =~ ^0?\.[0-9]*[1-9][0-9]*$|^1(\.0+)?$ ]]; then
     echo "KEYSTONE_MEM must be a fraction in (0,1], e.g. 0.8 (got '${KEYSTONE_MEM}')" >&2
     exit 2
   fi

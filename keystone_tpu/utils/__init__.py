@@ -14,3 +14,8 @@ from keystone_tpu.utils.stats import (  # noqa: F401
     rand_matrix_gaussian,
     rand_matrix_uniform,
 )
+from keystone_tpu.utils import tracing  # noqa: F401
+
+# Test-fixture generators (the reference's src/test/scala/utils/TestUtils
+# analogue) live in keystone_tpu.utils.test_utils — import that module
+# directly from test code; they are deliberately NOT re-exported here.

@@ -1,0 +1,64 @@
+"""Test fixture generators.
+
+Reference: src/test/scala/utils/TestUtils.scala — helpers used across the
+suites (``genChannelMajorArrayVectorizedImage`` random images,
+``loadTestImage`` resource images).  The reference ships tiny binary
+fixtures in test resources; this repo carries none, so ``load_test_image``
+returns deterministic *procedural* images (gradient / checkerboard /
+blobs) that play the same role: small, known content, stable across runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.utils.image import Image, image_from_array
+
+
+def gen_image(
+    height: int = 16, width: int = 16, channels: int = 3, seed: int = 0
+) -> Image:
+    """Random image with values in [0, 1) — the
+    genChannelMajorArrayVectorizedImage analogue (layout is XLA's concern;
+    data is (H, W, C))."""
+    rng = np.random.default_rng(seed)
+    return image_from_array(
+        rng.uniform(size=(height, width, channels)).astype(np.float32)
+    )
+
+
+def gen_image_batch(
+    n: int = 4, height: int = 16, width: int = 16, channels: int = 3, seed: int = 0
+) -> np.ndarray:
+    """(N, H, W, C) float32 batch of random images."""
+    rng = np.random.default_rng(seed)
+    return rng.uniform(size=(n, height, width, channels)).astype(np.float32)
+
+
+def load_test_image(name: str = "gradient", size: int = 32) -> Image:
+    """Deterministic known-content test image (loadTestImage analogue).
+
+    ``gradient``     — channel 0 ramps along x, channel 1 along y,
+                       channel 2 radial.
+    ``checkerboard`` — 4-pixel checker tiles, all channels equal.
+    ``blobs``        — two Gaussian bumps (distinct scales/positions);
+                       useful for keypoint/descriptor ops.
+    """
+    x = np.linspace(0.0, 1.0, size, dtype=np.float32)
+    xx, yy = np.meshgrid(x, x, indexing="ij")
+    if name == "gradient":
+        r = np.sqrt((xx - 0.5) ** 2 + (yy - 0.5) ** 2) / np.sqrt(0.5)
+        img = np.stack([xx, yy, r.astype(np.float32)], axis=-1)
+    elif name == "checkerboard":
+        tile = ((xx * size // 4).astype(int) + (yy * size // 4).astype(int)) % 2
+        img = np.repeat(tile[:, :, None].astype(np.float32), 3, axis=-1)
+    elif name == "blobs":
+        b1 = np.exp(-(((xx - 0.3) ** 2 + (yy - 0.3) ** 2) / 0.02))
+        b2 = np.exp(-(((xx - 0.7) ** 2 + (yy - 0.65) ** 2) / 0.08))
+        g = (b1 + 0.6 * b2).astype(np.float32)
+        img = np.stack([g, g, g], axis=-1)
+    else:
+        raise ValueError(
+            f"unknown test image {name!r}: gradient | checkerboard | blobs"
+        )
+    return image_from_array(img)
