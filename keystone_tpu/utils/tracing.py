@@ -77,7 +77,7 @@ def stage_timings(result) -> Dict[str, float]:
 
     g = PipelineEnv.get_optimizer().execute(result.graph)
     ex = GraphExecutor(g, profile=True)
-    ex.execute(g.sink_dependencies.get(result.sink, result.sink))
+    ex.execute(result.sink)
     out: Dict[str, float] = {}
     for node, seconds in ex.timings.items():
         label = g.operators[node].label() if node in g.operators else str(node)

@@ -18,6 +18,8 @@ import logging
 import time
 from typing import Any, Dict, Optional
 
+import jax
+
 from keystone_tpu.workflow import graph as G
 from keystone_tpu.workflow.dataset import Dataset, as_dataset
 from keystone_tpu.workflow.estimator import Estimator, LabelEstimator
@@ -65,8 +67,7 @@ class GraphExecutor:
         t0 = time.perf_counter() if self.profile else 0.0
         result = self._execute_op(op, deps)
         if self.profile:
-            if isinstance(result, DatasetExpr):
-                result.dataset.cache()
+            _sync_expr(result)
             self.timings[target] = time.perf_counter() - t0
         if not getattr(op, "no_memoize", False):
             # no_memoize nodes (over the HBM budget — workflow/profiling.py)
@@ -91,6 +92,26 @@ class GraphExecutor:
         if isinstance(op, G.GatherOperator):
             return _gather(deps)
         raise TypeError(f"unknown operator {op!r}")
+
+
+def _sync_expr(result) -> None:
+    """Block until a node's result is actually computed, so profile-mode
+    timings charge each node its own device time.  Fit nodes return a
+    Transformer (not a pytree) — block on every array attribute it holds,
+    else the async solve would be misattributed to the next dataset node."""
+    if isinstance(result, DatasetExpr):
+        result.dataset.cache()
+    elif isinstance(result, DatumExpr):
+        jax.block_until_ready(
+            [x for x in jax.tree.leaves(result.value) if hasattr(x, "block_until_ready")]
+        )
+    elif isinstance(result, TransformerExpr):
+        arrays = [
+            v
+            for v in jax.tree.leaves(vars(result.transformer))
+            if hasattr(v, "block_until_ready")
+        ]
+        jax.block_until_ready(arrays)
 
 
 def _apply_transformer(t: Transformer, deps):

@@ -1,0 +1,145 @@
+"""Property-based invariant tests (hypothesis).
+
+SURVEY.md §4 notes the reference tests numerics against closed forms on
+small matrices; hypothesis generalizes that pattern — each op's defining
+algebraic invariant is checked over randomized inputs.  Shapes are fixed
+per test (values vary) so each property compiles one XLA program.
+"""
+
+import numpy as np
+from hypothesis import assume, given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from keystone_tpu.ops import (
+    ClassLabelIndicators,
+    LinearRectifier,
+    MaxClassifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+    TopKClassifier,
+    VectorCombiner,
+    VectorSplitter,
+)
+from keystone_tpu.utils.matrix import matrix_to_rows, rows_to_matrix
+
+SETTINGS = dict(max_examples=15, deadline=None)
+
+# subnormals excluded: XLA flushes them to zero (FTZ), which is correct
+# hardware behavior but breaks exact sign/involution comparisons
+floats = st.floats(
+    min_value=-100.0,
+    max_value=100.0,
+    allow_nan=False,
+    allow_subnormal=False,
+    width=32,
+)
+
+
+def batch(rows=8, cols=12):
+    return arrays(np.float32, (rows, cols), elements=floats)
+
+
+@given(batch())
+@settings(**SETTINGS)
+def test_random_sign_is_an_involution(x):
+    node = RandomSignNode.init(x.shape[1], seed=3)
+    twice = np.asarray(node.apply_batch(node.apply_batch(x)))
+    np.testing.assert_allclose(twice, x, rtol=1e-6)
+
+
+@given(batch(), batch(), st.floats(-3, 3, width=32), st.floats(-3, 3, width=32))
+@settings(**SETTINGS)
+def test_padded_fft_is_linear(x, y, a, b):
+    fft = PaddedFFT()
+    lhs = np.asarray(fft.apply_batch(a * x + b * y))
+    rhs = a * np.asarray(fft.apply_batch(x)) + b * np.asarray(fft.apply_batch(y))
+    np.testing.assert_allclose(lhs, rhs, atol=1e-2)
+    padded = 1 << (x.shape[1] - 1).bit_length()
+    assert lhs.shape == (x.shape[0], 2 * (padded // 2 + 1))
+
+
+@given(batch(), st.floats(-2, 2, width=32), st.floats(-2, 2, width=32))
+@settings(**SETTINGS)
+def test_linear_rectifier_bounds(x, max_val, alpha):
+    out = np.asarray(LinearRectifier(max_val, alpha).apply_batch(x))
+    assert (out >= max_val - 1e-6).all()
+    active = (x - alpha) >= max_val
+    np.testing.assert_allclose(out[active], (x - alpha)[active], rtol=1e-6)
+
+
+@given(batch())
+@settings(**SETTINGS)
+def test_signed_hellinger_preserves_sign_and_squares_back(x):
+    out = np.asarray(SignedHellingerMapper().apply_batch(x))
+    assert (np.sign(out) == np.sign(x)).all()
+    np.testing.assert_allclose(out * out, np.abs(x), rtol=1e-4, atol=1e-5)
+
+
+@given(batch())
+@settings(**SETTINGS)
+def test_normalize_rows_gives_unit_norms(x):
+    assume((np.linalg.norm(x, axis=1) > 1e-3).all())
+    out = np.asarray(NormalizeRows().apply_batch(x))
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=1), np.ones(x.shape[0]), rtol=1e-4
+    )
+
+
+@given(batch(rows=6, cols=13), st.integers(1, 16))
+@settings(**SETTINGS)
+def test_vector_split_combine_roundtrip(x, block_size):
+    blocks = VectorSplitter(block_size).apply_batch(x)
+    combined = np.asarray(VectorCombiner().apply_batch(blocks))
+    d = x.shape[1]
+    np.testing.assert_array_equal(combined[:, :d], x)
+    assert (combined[:, d:] == 0).all()  # zero padding, never garbage
+
+
+@given(st.lists(st.integers(0, 6), min_size=1, max_size=20))
+@settings(**SETTINGS)
+def test_class_label_indicators_one_hot_pm1(labels):
+    y = np.asarray(labels, np.int32)
+    out = np.asarray(ClassLabelIndicators(7).apply_batch(y))
+    assert out.shape == (len(labels), 7)
+    assert set(np.unique(out)) <= {-1.0, 1.0}
+    assert (out.argmax(axis=1) == y).all()
+    np.testing.assert_allclose(out.sum(axis=1), 2.0 - 7.0)
+
+
+@given(arrays(np.float32, (9, 5), elements=floats), st.integers(1, 5))
+@settings(**SETTINGS)
+def test_topk_scores_are_the_k_largest(scores, k):
+    # compare VALUES, not indices: ties make index order implementation-
+    # defined, but the multiset of selected scores is fully determined
+    top = np.asarray(TopKClassifier(k).apply_batch(scores))
+    argmax = np.asarray(MaxClassifier().apply_batch(scores))
+    assert top.shape == (9, k)
+    picked = np.take_along_axis(scores, top, axis=1)
+    expected = np.sort(scores, axis=1)[:, ::-1][:, :k]
+    np.testing.assert_array_equal(np.sort(picked, axis=1), np.sort(expected, axis=1))
+    head = np.take_along_axis(scores, top[:, :1], axis=1)[:, 0]
+    argmax_scores = np.take_along_axis(scores, argmax[:, None], axis=1)[:, 0]
+    np.testing.assert_array_equal(head, argmax_scores)
+
+
+@given(arrays(np.float32, (32, 6), elements=floats))
+@settings(**SETTINGS)
+def test_standard_scaler_centers_and_scales(x):
+    assume((x.std(axis=0) > 1e-2).all())
+    model = StandardScaler().fit_arrays(x)
+    out = np.asarray(model.apply_batch(x))
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-3)
+    np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, atol=1e-2)
+
+
+@given(batch(rows=10, cols=4))
+@settings(**SETTINGS)
+def test_rows_to_matrix_roundtrip(x):
+    rows = [r for r in x]
+    m = rows_to_matrix(rows)
+    back = matrix_to_rows(m)
+    np.testing.assert_array_equal(np.stack([np.asarray(r) for r in back]), x)

@@ -34,6 +34,27 @@ def test_stage_timings_labels_every_node():
     assert all(t >= 0 for t in timings.values())
 
 
+def test_stage_timings_synchronizes_fit_nodes():
+    """A fit node's solve must be charged to the fit node itself, not
+    dispatched async and absorbed by the next dataset-producing node."""
+    from keystone_tpu.models import LinearMapEstimator
+    from keystone_tpu.ops import ClassLabelIndicators
+
+    rng = np.random.default_rng(0)
+    x = Dataset(rng.normal(size=(512, 128)).astype(np.float32))
+    y = ClassLabelIndicators(4)(
+        Dataset(rng.integers(0, 4, size=(512,)).astype(np.int32))
+    )
+    pipe = Pipeline.of(LinearRectifier(0.0)).and_then(
+        LinearMapEstimator(lam=1e-2), x, y
+    )
+    result = pipe(x)
+    timings = tracing.stage_timings(result)
+    fit_keys = [k for k in timings if "LinearMapEstimator" in k]
+    assert fit_keys, f"fit node missing from timings: {list(timings)}"
+    assert timings[fit_keys[0]] >= 0
+
+
 def test_trace_context_writes_profile(tmp_path):
     logdir = str(tmp_path / "trace")
     with tracing.trace(logdir, annotation="toy-pipeline"):
