@@ -8,12 +8,15 @@ the reference's BLAS-on-CPU executors; the reference repo publishes no
 numbers — BASELINE.json "published": {}).
 
 Methodology: throughput is the *marginal* per-batch time of a pipelined
-dispatch stream — time(long run) − time(short run), divided by the extra
-iterations.  This measures sustained streaming throughput (batches
-continuously in flight, as in production inference) and cancels the fixed
-host↔device round-trip of the final synchronization, which in this
-environment is a ~60 ms network tunnel hop that would otherwise dominate
-and massively understate the chip.  Both the TPU leg and the CPU
+dispatch stream.  Total time of an n-iteration run is
+t(n) = fixed_sync + n·per_iter; per_iter is fitted as the Theil–Sen
+slope (median of pairwise slopes) over runs of several lengths
+(RUN_LENGTHS × REPS).  This measures sustained streaming throughput
+(batches continuously in flight, as in production inference) and cancels
+the fixed host↔device round-trip of the final synchronization, which in
+this environment is a ~60 ms network tunnel hop that would otherwise
+dominate and massively understate the chip; the pairwise-median fit is
+robust to individual jittered runs.  Both the TPU leg and the CPU
 baseline leg use the same estimator.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
@@ -38,13 +41,15 @@ GMM_K = 64
 PCA_DIMS = 64
 NUM_CLASSES = 1000
 WARMUP = 3
-SHORT_ITERS = 10
-LONG_ITERS = 60
-TRIALS = 5
+# run lengths for the slope fit: spread wide so the fitted line rests on
+# ~150 ms of device work end-to-end, with repeats so single jittered
+# points (the host↔device sync rides a network tunnel here) are outvoted
+RUN_LENGTHS = (10, 35, 60, 110, 160, 210)
+REPS = 2
 _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
 # bump whenever the measurement methodology or CPU-leg parameters change,
 # so stale cached baselines from older estimators are discarded
-_BASELINE_VERSION = 2
+_BASELINE_VERSION = 3
 
 
 def build_forward():
@@ -97,10 +102,9 @@ def build_forward():
 
 def measure_ips(
     batch: int,
-    short_iters: int = SHORT_ITERS,
-    long_iters: int = LONG_ITERS,
+    run_lengths=RUN_LENGTHS,
+    reps: int = REPS,
     warmup: int = WARMUP,
-    trials: int = TRIALS,
 ) -> float:
     import jax
 
@@ -122,24 +126,30 @@ def measure_ips(
         out.block_until_ready()
         return time.perf_counter() - t0
 
-    slopes = []
-    means = []
-    for _ in range(trials):
-        t_short = run(short_iters)
-        t_long = run(long_iters)
-        per_iter = (t_long - t_short) / (long_iters - short_iters)
-        if per_iter > 0:
-            slopes.append(per_iter)
-        means.append(t_long / long_iters)
-    if slopes:
-        # median across trials: robust to a single noisy t_short/t_long pair
-        # (max-over-trials would keep the luckiest outlier)
-        per_iter = float(np.median(slopes))
-    else:
-        # every trial's slope drowned in timing noise; fall back to the
-        # sync-dominated mean and say so — this measures a different
-        # quantity (includes the final host<->device round-trip)
-        per_iter = float(np.median(means))
+    # t(n) = fixed_sync + n·per_iter.  Fit per_iter by Theil–Sen (median of
+    # pairwise slopes): a single two-point slope can collapse to ~0 when
+    # jitter inflates the short run, which once reported a 50× bogus
+    # throughput; the pairwise median is immune to any minority of bad
+    # points.  Interleave lengths across reps so drift hits all lengths.
+    points = []
+    for _ in range(reps):
+        for n in run_lengths:
+            points.append((n, run(n)))
+    slopes = [
+        (tj - ti) / (nj - ni)
+        for i, (ni, ti) in enumerate(points)
+        for nj, tj in points[i + 1:]
+        if nj != ni
+    ]
+    per_iter = float(np.median(slopes)) if slopes else float("nan")
+    if not per_iter > 0:  # catches non-positive AND NaN (empty/degenerate)
+        # pathological timing environment; fall back to the sync-dominated
+        # mean and say so — this measures a different quantity (includes
+        # the final host<->device round-trip)
+        n_max = max(run_lengths)
+        per_iter = float(
+            np.median([t / n for n, t in points if n == n_max])
+        )
         sys.stderr.write(
             "bench: slope estimator degenerate; reporting sync-dominated mean\n"
         )
@@ -181,7 +191,7 @@ def main():
         # same per-image program + same marginal-time estimator, scaled down
         # (the CPU leg is ~1000× slower; a handful of iterations suffices)
         ips = measure_ips(
-            batch=64, short_iters=1, long_iters=6, warmup=1, trials=2
+            batch=64, run_lengths=(1, 2, 4, 6), reps=2, warmup=1
         )
         print(json.dumps({"cpu_ips": ips}))
         return

@@ -34,13 +34,22 @@ class FisherVector(Transformer):
     """Input: ragged ((n, max_k, d), mask) descriptor sets.
     Output: dense (n, 2·K·D) Fisher vectors.
 
-    ``use_pallas=True`` routes through the fused VMEM-resident TPU kernel
-    (ops/fisher_pallas.py) instead of the XLA einsum path.
+    ``use_pallas`` — True routes through the fused VMEM-resident TPU
+    kernel (ops/fisher_pallas.py); False forces the XLA einsum path; None
+    (default) picks per call: the fused kernel on TPU when the
+    responsibility tensor γ (T·K floats per image) is large enough to be
+    HBM-bandwidth bound (measured crossover on v5 lite: ~1.5× faster at
+    T=512, K=256; parity below T·K ≈ 32k), einsum otherwise.
     """
 
     fusable = False
 
-    def __init__(self, gmm: GaussianMixtureModel, use_pallas: bool = False):
+    # per-image γ elements above which the fused kernel measurably wins
+    _PALLAS_GAMMA_THRESHOLD = 32768
+
+    def __init__(
+        self, gmm: GaussianMixtureModel, use_pallas: Optional[bool] = None
+    ):
         self.gmm = gmm
         self.use_pallas = use_pallas
 
@@ -60,7 +69,16 @@ class FisherVector(Transformer):
             squeeze = False
         if mask is None:
             mask = jnp.ones(xs.shape[:2], jnp.float32)
-        if self.use_pallas:
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            from keystone_tpu.ops.fisher_pallas import pallas_supported
+
+            gamma_elems = xs.shape[1] * self.gmm.means.shape[0]
+            use_pallas = (
+                gamma_elems >= self._PALLAS_GAMMA_THRESHOLD
+                and pallas_supported(xs)
+            )
+        if use_pallas:
             from keystone_tpu.ops.fisher_pallas import fisher_encode_pallas
 
             out = fisher_encode_pallas(

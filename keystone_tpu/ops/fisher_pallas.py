@@ -138,9 +138,28 @@ def fisher_encode_pallas(xs, mask, w, mu, var, interpret: bool = False):
     return out.reshape(n, 2 * k * d)
 
 
-def pallas_supported() -> bool:
-    """True when the default backend can run TPU pallas kernels."""
+def pallas_supported(x=None) -> bool:
+    """True when the computation targets a device that can run TPU pallas
+    kernels.  The target is resolved in priority order: the active
+    framework mesh (covers CPU-mesh dryruns on TPU hosts), the concrete
+    input array's committed devices, then the default backend."""
+    _TPU = ("tpu", "axon")
     try:
-        return jax.devices()[0].platform in ("tpu", "axon")
+        from keystone_tpu.parallel.mesh import active_mesh
+
+        m = active_mesh()
+        if m is not None and m.devices.size:
+            return m.devices.flat[0].platform in _TPU
+    except Exception:
+        pass
+    if x is not None:
+        try:
+            devs = x.devices() if callable(getattr(x, "devices", None)) else None
+            if devs:
+                return next(iter(devs)).platform in _TPU
+        except Exception:
+            pass  # tracers and numpy inputs carry no device info
+    try:
+        return jax.devices()[0].platform in _TPU
     except Exception:
         return False

@@ -85,6 +85,14 @@ def current_mesh() -> Mesh:
         return _CTX.mesh
 
 
+def active_mesh() -> Optional[Mesh]:
+    """The active mesh if one was set, WITHOUT creating the default —
+    for callers that only want to inspect (e.g. which platform the
+    computation targets) and must not instantiate device state."""
+    with _LOCK:
+        return _CTX.mesh
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh) -> Iterator[Mesh]:
     prev = _CTX.mesh

@@ -43,5 +43,5 @@ def test_bench_forward_batch_invariance():
 
 
 def test_measure_ips_runs_on_cpu():
-    ips = bench.measure_ips(batch=2, short_iters=1, long_iters=3, warmup=1, trials=1)
+    ips = bench.measure_ips(batch=2, run_lengths=(1, 2, 3), reps=1, warmup=1)
     assert ips > 0

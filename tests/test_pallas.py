@@ -34,6 +34,39 @@ def test_pallas_fv_nondivisible_t_padding():
     np.testing.assert_allclose(got, ref, atol=2e-5)
 
 
+def test_fisher_vector_auto_mode_selects_by_gamma_size(monkeypatch):
+    """use_pallas=None: fused kernel only on TPU and only when the
+    per-image responsibility tensor is large enough to be bandwidth-bound."""
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.ops import fisher as fisher_mod
+    from keystone_tpu.ops import fisher_pallas as fp_mod
+
+    calls = []
+
+    def fake_pallas(xs, mask, w, mu, var, interpret=False):
+        calls.append("pallas")
+        return fisher_mod._fisher_encode(xs, mask, w, mu, var)
+
+    monkeypatch.setattr(fp_mod, "pallas_supported", lambda x=None: True)
+    monkeypatch.setattr(fp_mod, "fisher_encode_pallas", fake_pallas)
+
+    xs, mask, w, mu, var = _setup(n=2, t=64, k=8)  # γ = 512 elems: einsum
+    gmm = GaussianMixtureModel(w, mu, var)
+    FisherVector = fisher_mod.FisherVector
+    FisherVector(gmm).apply_batch(xs, mask=mask)
+    assert calls == []
+
+    big_t = FisherVector._PALLAS_GAMMA_THRESHOLD // 8  # γ = threshold: pallas
+    xs2, mask2, *_ = _setup(n=2, t=big_t, k=8)
+    FisherVector(gmm).apply_batch(xs2, mask=mask2)
+    assert calls == ["pallas"]
+
+    # explicit False always wins over a capable backend
+    calls.clear()
+    FisherVector(gmm, use_pallas=False).apply_batch(xs2, mask=mask2)
+    assert calls == []
+
+
 def test_fisher_vector_transformer_pallas_flag():
     from keystone_tpu.models.gmm import GaussianMixtureModel
     from keystone_tpu.ops.fisher import FisherVector
