@@ -184,6 +184,9 @@ def cpu_baseline_ips() -> float:
 
 
 def main():
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     if "--cpu" in sys.argv:
         import jax
 

@@ -15,6 +15,10 @@
 #                       NOTE: plays the role of the reference's
 #                       executor-memory knob but takes a fraction in
 #                       (0,1], NOT a JVM size like "4g"
+#   KEYSTONE_COMPILE_CACHE
+#                       persistent XLA compile-cache dir (default
+#                       ~/.cache/keystone_tpu/xla; "off" disables) —
+#                       repeat runs of a pipeline skip compilation
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

@@ -37,6 +37,9 @@ def _apply_platform_env() -> None:
 
 def main(argv=None):
     _apply_platform_env()
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")

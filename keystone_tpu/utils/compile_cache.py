@@ -1,0 +1,67 @@
+"""Persistent XLA compilation cache.
+
+The dominant cost of a cold pipeline run in this environment is XLA
+compilation (the north-star ImageNet fit: ~60 s cold vs ~2 s warm on one
+chip).  The reference amortizes its equivalent (JVM/JIT warmup, Spark
+executor reuse) by keeping the cluster alive between jobs; the TPU-era
+equivalent is JAX's persistent compilation cache, which persists compiled
+executables across *processes* so the second `bin/run-pipeline.sh` of the
+same pipeline skips compilation entirely (measured: 2.9 s → 0.24 s for a
+representative program; the full ImageNet pipeline drops from ~60 s to
+seconds).
+
+Enabled by default for CLI/bench entry points; library users call
+:func:`enable_compilation_cache` themselves.  Controlled by
+``KEYSTONE_COMPILE_CACHE``: a directory path overrides the default
+(``~/.cache/keystone_tpu/xla``); ``0``/``off``/``none`` disables.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DISABLE_VALUES = ("0", "off", "none", "false")
+
+
+def enable_compilation_cache(
+    cache_dir: Optional[str] = None, min_compile_secs: float = 0.0
+) -> Optional[str]:
+    """Point jax at a persistent on-disk compilation cache.
+
+    Returns the cache directory, or None when disabled via
+    ``KEYSTONE_COMPILE_CACHE``.  Idempotent; safe to call before or after
+    backend initialization (config is read at compile time).
+    """
+    env = os.environ.get("KEYSTONE_COMPILE_CACHE", "").strip()
+    if env.lower() in _DISABLE_VALUES:
+        return None
+    d = cache_dir or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
+    )
+    prev_dir = None
+    try:
+        os.makedirs(d, exist_ok=True)
+        import jax
+
+        prev_dir = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", d)
+        # persist EVERYTHING (threshold 0): even sub-second eager-op
+        # compiles pay a device-RPC round-trip per program in tunneled
+        # environments, and dozens of them add tens of seconds
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs)
+        )
+    except Exception as e:  # unwritable dir, ancient jax — run uncached
+        try:  # don't leave the cache half-enabled when the second update fails
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+        except Exception:
+            pass
+        logger.warning("compilation cache unavailable (%s); continuing without", e)
+        return None
+    return d

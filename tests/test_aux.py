@@ -343,3 +343,26 @@ def test_profile_graph_static_cost_ranks_heavier_node_higher():
     assert len(static) >= 2
     times = sorted(pr.hlo_seconds for pr in static.values())
     assert times[-1] > times[0]  # the matmul chain prices above the slice
+
+
+def test_compilation_cache_enable_and_disable(tmp_path, monkeypatch):
+    import jax
+
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    monkeypatch.delenv("KEYSTONE_COMPILE_CACHE", raising=False)
+    try:
+        d = str(tmp_path / "xla-cache")
+        got = enable_compilation_cache(d)
+        assert got == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+
+        monkeypatch.setenv("KEYSTONE_COMPILE_CACHE", "off")
+        assert enable_compilation_cache() is None
+
+        monkeypatch.setenv("KEYSTONE_COMPILE_CACHE", str(tmp_path / "env-cache"))
+        got = enable_compilation_cache()
+        assert got == str(tmp_path / "env-cache") and os.path.isdir(got)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
