@@ -36,10 +36,6 @@ def _apply_platform_env() -> None:
 
 
 def main(argv=None):
-    _apply_platform_env()
-    from keystone_tpu.utils.compile_cache import enable_compilation_cache
-
-    enable_compilation_cache()
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
@@ -51,6 +47,11 @@ def main(argv=None):
     if name not in _PIPELINE_MODULES:
         print(f"unknown pipeline {name!r}; use --list", file=sys.stderr)
         return 2
+    # only now touch jax: --list/--help/typos shouldn't pay the import
+    _apply_platform_env()
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     mod = importlib.import_module(_PIPELINE_MODULES[name])
     mod.main(rest)
     return 0

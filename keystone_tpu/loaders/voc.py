@@ -52,7 +52,7 @@ class VOCLoader:
             labels.append(multilabel)
             if limit is not None and len(images) >= limit:
                 break
-        x = np.stack(images) if images else np.zeros((0, *size, 3), np.float32)
+        x = np.stack(images) if images else np.zeros((0, *size, 3), np.uint8)
         y = np.stack(labels) if labels else np.zeros((0, NUM_CLASSES), np.float32)
         return LabeledData(Dataset(x), Dataset(y))
 

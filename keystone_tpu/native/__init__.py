@@ -97,7 +97,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int64,
             ctypes.c_int,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
         ]
         lib.ks_free.argtypes = [ctypes.c_void_p]
@@ -112,7 +112,11 @@ def available() -> bool:
 def _take_array(lib, ptr, shape, dtype):
     """Copy a malloc'd native buffer into numpy and free it."""
     count = int(np.prod(shape))
-    ctype = {np.float32: ctypes.c_float, np.int32: ctypes.c_int32}[dtype]
+    ctype = {
+        np.float32: ctypes.c_float,
+        np.int32: ctypes.c_int32,
+        np.uint8: ctypes.c_uint8,
+    }[dtype]
     arr = np.ctypeslib.as_array(
         ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
     ).copy()
@@ -178,8 +182,9 @@ def tar_index(path: str) -> Optional[list]:
 def decode_jpegs(
     blobs: list, target_hw: Tuple[int, int], threads: int = 0
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Decode a list of JPEG byte strings to (n, H, W, 3) float32 [0,1].
-    Returns (images, ok_mask)."""
+    """Decode a list of JPEG byte strings to (n, H, W, 3) uint8.
+    Returns (images, ok_mask).  uint8 keeps host buffers and the
+    host→device transfer at 1 byte/pixel; PixelScaler casts on device."""
     lib = get_lib()
     if lib is None:
         return None
@@ -190,7 +195,7 @@ def decode_jpegs(
     if n > 1:
         offsets[1:] = np.cumsum(sizes)[:-1]
     th, tw = target_hw
-    out = ctypes.POINTER(ctypes.c_float)()
+    out = ctypes.POINTER(ctypes.c_uint8)()
     ok = ctypes.POINTER(ctypes.c_int32)()
     blob_arr = np.frombuffer(blob, np.uint8)
     rc = lib.ks_decode_jpegs(
@@ -202,6 +207,6 @@ def decode_jpegs(
     )
     if rc != 0:
         return None
-    images = _take_array(lib, out, (n, th, tw, 3), np.float32)
+    images = _take_array(lib, out, (n, th, tw, 3), np.uint8)
     ok_mask = _take_array(lib, ok, (n,), np.int32)
     return images, ok_mask == 0

@@ -66,7 +66,7 @@ class AmazonReviewsPipeline:
             train = AmazonReviewsDataLoader.synthetic(config.synthetic_n, seed=1)
             test = AmazonReviewsDataLoader.synthetic(config.synthetic_n // 4, seed=2)
         t0 = time.time()
-        fitted = AmazonReviewsPipeline.build(config, train.data, train.labels).fit()
+        fitted = AmazonReviewsPipeline.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = BinaryClassifierEvaluator().evaluate(preds, test.labels)

@@ -45,7 +45,7 @@ class LinearPixels:
             train = CifarLoader.synthetic(config.synthetic_n, seed=1)
             test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
         t0 = time.time()
-        fitted = LinearPixels.build(config, train.data, train.labels).fit()
+        fitted = LinearPixels.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)

@@ -67,7 +67,7 @@ class MnistRandomFFT:
             test = MnistLoader.synthetic(config.synthetic_n // 4, seed=2)
         t0 = time.time()
         pipeline = MnistRandomFFT.build(config, train.data, train.labels)
-        fitted = pipeline.fit()
+        fitted = pipeline.fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         metrics = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(

@@ -81,7 +81,7 @@ class NewsgroupsPipeline:
                 config.synthetic_n // 4, config.num_classes, seed=2
             )
         t0 = time.time()
-        fitted = NewsgroupsPipeline.build(config, train.data, train.labels).fit()
+        fitted = NewsgroupsPipeline.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(config.num_classes).evaluate(

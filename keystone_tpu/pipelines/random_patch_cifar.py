@@ -101,7 +101,7 @@ class RandomPatchCifar:
             train = CifarLoader.synthetic(config.synthetic_n, seed=1)
             test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
         t0 = time.time()
-        fitted = RandomPatchCifar.build(config, train.data, train.labels).fit()
+        fitted = RandomPatchCifar.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)

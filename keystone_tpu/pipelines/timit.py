@@ -92,7 +92,7 @@ class TimitPipeline:
                 config.synthetic_n // 4, config.num_classes, seed=2
             )
         t0 = time.time()
-        fitted = TimitPipeline.build(config, train.data, train.labels).fit()
+        fitted = TimitPipeline.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(config.num_classes).evaluate(

@@ -18,6 +18,7 @@ from keystone_tpu.ops import (
     ColumnSampler,
     GMMFisherVectorEstimator,
     GrayScaler,
+    PixelScaler,
     NormalizeRows,
     SIFTExtractor,
     SignedHellingerMapper,
@@ -52,8 +53,16 @@ class VOCSIFTFisher:
     def build(config: Config, train_x: Dataset, train_multilabels: Dataset) -> Pipeline:
         from keystone_tpu.pipelines.imagenet_sift_lcs_fv import _fv_branch
 
-        sift_base = Pipeline.of(GrayScaler()).and_then(
-            SIFTExtractor(step=config.sift_step, bin_sizes=(config.sift_bin_size,))
+        # uint8 images → [0,1] floats on device (cheap transfer; see
+        # ImageNetSiftLcsFV.build)
+        sift_base = (
+            Pipeline.of(PixelScaler())
+            .and_then(GrayScaler())
+            .and_then(
+                SIFTExtractor(
+                    step=config.sift_step, bin_sizes=(config.sift_bin_size,)
+                )
+            )
         )
         branch = _fv_branch(sift_base, config, train_x, seed=config.seed)
         # multilabels are 0/1; targets are ±1
@@ -86,7 +95,7 @@ class VOCSIFTFisher:
             train = VOCLoader.synthetic(config.synthetic_n, size=sz, seed=1)
             test = VOCLoader.synthetic(max(8, config.synthetic_n // 3), size=sz, seed=2)
         t0 = time.time()
-        fitted = VOCSIFTFisher.build(config, train.data, train.labels).fit()
+        fitted = VOCSIFTFisher.build(config, train.data, train.labels).fit().block_until_ready()
         fit_time = time.time() - t0
         scores = fitted(test.data).get().numpy()
         mean_ap = MeanAveragePrecisionEvaluator(NUM_CLASSES).evaluate(

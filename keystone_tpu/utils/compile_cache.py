@@ -43,12 +43,14 @@ def enable_compilation_cache(
         os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
     )
     prev_dir = None
+    dir_updated = False
     try:
         os.makedirs(d, exist_ok=True)
         import jax
 
         prev_dir = jax.config.jax_compilation_cache_dir
         jax.config.update("jax_compilation_cache_dir", d)
+        dir_updated = True
         # persist EVERYTHING (threshold 0): even sub-second eager-op
         # compiles pay a device-RPC round-trip per program in tunneled
         # environments, and dozens of them add tens of seconds
@@ -56,12 +58,15 @@ def enable_compilation_cache(
             "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs)
         )
     except Exception as e:  # unwritable dir, ancient jax — run uncached
-        try:  # don't leave the cache half-enabled when the second update fails
-            import jax
+        if dir_updated:
+            # roll back only what THIS call changed; a pre-existing cache
+            # config (env var, prior enable) must survive our failure
+            try:
+                import jax
 
-            jax.config.update("jax_compilation_cache_dir", prev_dir)
-        except Exception:
-            pass
+                jax.config.update("jax_compilation_cache_dir", prev_dir)
+            except Exception:
+                pass
         logger.warning("compilation cache unavailable (%s); continuing without", e)
         return None
     return d

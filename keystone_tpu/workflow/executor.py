@@ -94,24 +94,49 @@ class GraphExecutor:
         raise TypeError(f"unknown operator {op!r}")
 
 
+def block_on_arrays(obj, _seen=None, _depth=0) -> None:
+    """Block until every device array reachable from ``obj`` is computed.
+
+    Transformers are plain objects, not pytrees, and solvers nest state
+    (e.g. a model holding a scaler holding mean/std arrays) — a flat
+    ``jax.tree.leaves(vars(t))`` walk stops at the nested object and
+    misses its arrays, silently under-blocking.  This walks attributes,
+    containers, and dataclass-like objects recursively (cycle-safe)."""
+    if _depth > 8:
+        return
+    if _seen is None:
+        _seen = set()
+    if id(obj) in _seen:
+        return
+    _seen.add(id(obj))
+    if hasattr(obj, "block_until_ready"):
+        obj.block_until_ready()
+        return
+    if isinstance(obj, dict):
+        children = list(obj.values())
+    elif isinstance(obj, (list, tuple, set, frozenset)):
+        children = list(obj)
+    elif hasattr(obj, "__dict__") and not isinstance(obj, type):
+        children = list(vars(obj).values())
+    else:
+        return
+    for c in children:
+        if c is not None and not isinstance(c, (str, bytes, int, float, bool)):
+            block_on_arrays(c, _seen, _depth + 1)
+
+
 def _sync_expr(result) -> None:
     """Block until a node's result is actually computed, so profile-mode
     timings charge each node its own device time.  Fit nodes return a
-    Transformer (not a pytree) — block on every array attribute it holds,
-    else the async solve would be misattributed to the next dataset node."""
+    Transformer (not a pytree) — block on every array it holds (including
+    nested model state), else the async solve would be misattributed to
+    the next dataset-producing node."""
     if isinstance(result, DatasetExpr):
         result.dataset.cache()
     elif isinstance(result, DatumExpr):
-        jax.block_until_ready(
-            [x for x in jax.tree.leaves(result.value) if hasattr(x, "block_until_ready")]
-        )
+        block_on_arrays(result.value)
     elif isinstance(result, TransformerExpr):
-        arrays = [
-            v
-            for v in jax.tree.leaves(vars(result.transformer))
-            if hasattr(v, "block_until_ready")
-        ]
-        jax.block_until_ready(arrays)
+        block_on_arrays(result.transformer)
 
 
 def _apply_transformer(t: Transformer, deps):

@@ -251,6 +251,19 @@ class FittedPipeline(Pipeline):
     def fit(self) -> "FittedPipeline":
         return self
 
+    def block_until_ready(self) -> "FittedPipeline":
+        """Wait for every fitted transformer's device arrays to finish
+        computing.  ``fit()`` dispatches solves asynchronously (XLA async
+        execution); honest fit-time measurement and safe hand-off to
+        other processes require this barrier."""
+        from keystone_tpu.workflow.executor import block_on_arrays
+
+        for op in self.graph.operators.values():
+            t = getattr(op, "transformer", None)
+            if t is not None:
+                block_on_arrays(t)
+        return self
+
     def save(self, path: str) -> None:
         with open(path, "wb") as f:
             pickle.dump(self, f)

@@ -227,10 +227,12 @@ static void ks_jpeg_error_exit(j_common_ptr cinfo) {
   longjmp(err->jb, 1);
 }
 
-// decode one JPEG into out (target_h, target_w, 3) float32 [0,1] via
-// bilinear resize. Returns 0 on success.
+// decode one JPEG into out (target_h, target_w, 3) uint8 via bilinear
+// resize (resampling in float, rounded to the nearest byte).  uint8 output
+// keeps the host buffer and the host->device transfer at 1 byte/pixel;
+// the on-device PixelScaler does the [0,1] cast.  Returns 0 on success.
 static int decode_one(const uint8_t* buf, int64_t len, int64_t th, int64_t tw,
-                      float* out) {
+                      uint8_t* out) {
   jpeg_decompress_struct cinfo;
   KsJpegErr jerr;
   // raw buffer, not std::vector: longjmp from the error handler must not
@@ -266,7 +268,6 @@ static int decode_one(const uint8_t* buf, int64_t len, int64_t th, int64_t tw,
   jpeg_destroy_decompress(&cinfo);
 
   // bilinear resize to (th, tw)
-  const float inv255 = 1.0f / 255.0f;
   for (int64_t y = 0; y < th; y++) {
     float sy = th > 1 ? (float)y * (h - 1) / (th - 1) : 0.0f;
     int64_t y0 = (int64_t)sy;
@@ -284,7 +285,7 @@ static int decode_one(const uint8_t* buf, int64_t len, int64_t th, int64_t tw,
         float v11 = img[(y1 * w + x1) * 3 + c];
         float v = (1 - fy) * ((1 - fx) * v00 + fx * v01) +
                   fy * ((1 - fx) * v10 + fx * v11);
-        out[(y * tw + x) * 3 + c] = v * inv255;
+        out[(y * tw + x) * 3 + c] = (uint8_t)(v + 0.5f);
       }
     }
   }
@@ -293,12 +294,12 @@ static int decode_one(const uint8_t* buf, int64_t len, int64_t th, int64_t tw,
 }
 
 // Batch decode with a thread pool.  buffers: concatenated JPEG bytes with
-// per-item offsets/sizes.  out: (n, th, tw, 3) float32, caller-allocated
+// per-item offsets/sizes.  out: (n, th, tw, 3) uint8, caller-allocated
 // by us.  ok[i] = 0 on success per image.
 int ks_decode_jpegs(const uint8_t* blob, const int64_t* offsets,
                     const int64_t* sizes, int64_t n, int64_t th, int64_t tw,
-                    int threads, float** out, int32_t** ok) {
-  float* buf = (float*)malloc(sizeof(float) * (size_t)n * th * tw * 3);
+                    int threads, uint8_t** out, int32_t** ok) {
+  uint8_t* buf = (uint8_t*)malloc((size_t)n * th * tw * 3);
   int32_t* st = (int32_t*)malloc(sizeof(int32_t) * (n > 0 ? n : 1));
   if (!buf || !st) { free(buf); free(st); return -4; }
   if (threads < 1) threads = (int)std::thread::hardware_concurrency();

@@ -351,6 +351,7 @@ def test_compilation_cache_enable_and_disable(tmp_path, monkeypatch):
     from keystone_tpu.utils.compile_cache import enable_compilation_cache
 
     prev = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
     monkeypatch.delenv("KEYSTONE_COMPILE_CACHE", raising=False)
     try:
         d = str(tmp_path / "xla-cache")
@@ -366,3 +367,4 @@ def test_compilation_cache_enable_and_disable(tmp_path, monkeypatch):
         assert got == str(tmp_path / "env-cache") and os.path.isdir(got)
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)

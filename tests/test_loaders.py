@@ -212,10 +212,12 @@ def test_imagenet_tar_labels_and_decode(tmp_path):
     ld = ImageNetLoader.load(str(tmp_path), size=(16, 16))
     assert ld.data.numpy().shape == (4, 16, 16, 3)
     np.testing.assert_array_equal(ld.labels.numpy(), [0, 0, 1, 1])
-    # red synset decodes red-dominant, green synset green-dominant
+    # pixels ship as uint8 (device-side PixelScaler does the [0,1] cast)
     x = ld.data.numpy()
-    assert x[0, ..., 0].mean() > 0.8 and x[0, ..., 1].mean() < 0.2
-    assert x[2, ..., 1].mean() > 0.8 and x[2, ..., 0].mean() < 0.2
+    assert x.dtype == np.uint8
+    # red synset decodes red-dominant, green synset green-dominant
+    assert x[0, ..., 0].mean() > 0.8 * 255 and x[0, ..., 1].mean() < 0.2 * 255
+    assert x[2, ..., 1].mean() > 0.8 * 255 and x[2, ..., 0].mean() < 0.2 * 255
 
 
 def test_imagenet_limit_and_label_map(tmp_path):
@@ -245,8 +247,9 @@ def test_imagenet_skips_undecodable_members(tmp_path):
 
 def test_imagenet_synthetic_class_signal():
     ld = ImageNetLoader.synthetic(n=8, num_classes=4, size=(32, 32), seed=0)
-    assert ld.data.numpy().shape == (8, 32, 32, 3)
-    assert ld.data.numpy().min() >= 0 and ld.data.numpy().max() <= 1
+    x = ld.data.numpy()
+    assert x.shape == (8, 32, 32, 3)
+    assert x.dtype == np.uint8
 
 
 # --------------------------------------------------------------------- VOC

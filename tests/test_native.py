@@ -83,12 +83,13 @@ def test_tar_index_and_jpeg_decode(tmp_path):
     images, ok = native.decode_jpegs(blobs, (32, 32))
     assert ok.all()
     assert images.shape == (3, 32, 32, 3)
+    assert images.dtype == np.uint8  # 1 byte/pixel on the wire
     # compare against PIL decode+resize of the same bytes (both bilinear-ish;
     # JPEG is lossy so tolerances are loose)
     for i, blob in enumerate(blobs):
         ref = PILImage.open(io.BytesIO(blob)).convert("RGB").resize((32, 32))
-        ref = np.asarray(ref, np.float32) / 255.0
-        assert np.abs(images[i] - ref).mean() < 0.08
+        ref = np.asarray(ref, np.float32)
+        assert np.abs(images[i].astype(np.float32) - ref).mean() < 0.08 * 255
 
 
 def test_decode_jpegs_bad_blob_flagged():
