@@ -160,19 +160,33 @@ class ImageVectorizer(Transformer):
 
 
 class PixelScaler(Transformer):
-    """uint8 pixels → [0,1] floats (nodes/images/PixelScaler.scala)."""
+    """uint8 pixels → [0,1] floats (nodes/images/PixelScaler.scala).
 
-    def __init__(self, scale: float = 255.0):
+    ``only_if_integer=True`` divides only integer inputs and passes
+    floating inputs through as f32 — for pipelines whose loaders ship
+    uint8 (cheap transfer) but that must also accept pre-normalized
+    [0,1] float arrays without silently collapsing them to ~1/255 scale.
+    (The default stays unconditional: e.g. MNIST CSV loads *floats* in
+    [0,255] that genuinely need the division.)  The dtype check is
+    static at trace time — no runtime branch under jit.
+    """
+
+    def __init__(self, scale: float = 255.0, only_if_integer: bool = False):
         self.scale = float(scale)
+        self.only_if_integer = bool(only_if_integer)
 
     def params(self):
-        return (self.scale,)
+        return (self.scale, self.only_if_integer)
 
     def apply_batch(self, xs, mask=None):
+        if self.only_if_integer and jnp.issubdtype(
+            jnp.asarray(xs).dtype, jnp.floating
+        ):
+            return jnp.asarray(xs, jnp.float32)
         return xs.astype(jnp.float32) / self.scale
 
     def apply_one(self, x):
-        return jnp.asarray(x, jnp.float32) / self.scale
+        return self.apply_batch(jnp.asarray(x)[None])[0]
 
 
 class Windower(Transformer):

@@ -102,7 +102,7 @@ class ImageNetSiftLcsFV:
         # branches start with an identical PixelScaler, so CSE merges the
         # cast into one node.
         sift_base = (
-            Pipeline.of(PixelScaler())
+            Pipeline.of(PixelScaler(only_if_integer=True))
             .and_then(GrayScaler())
             .and_then(
                 SIFTExtractor(
@@ -110,7 +110,7 @@ class ImageNetSiftLcsFV:
                 )
             )
         )
-        lcs_base = Pipeline.of(PixelScaler()).and_then(
+        lcs_base = Pipeline.of(PixelScaler(only_if_integer=True)).and_then(
             LCSExtractor(step=config.lcs_step, subpatch_size=config.lcs_subpatch)
         )
         sift_branch = _fv_branch(sift_base, config, train_x, seed=config.seed)

@@ -56,7 +56,7 @@ class VOCSIFTFisher:
         # uint8 images → [0,1] floats on device (cheap transfer; see
         # ImageNetSiftLcsFV.build)
         sift_base = (
-            Pipeline.of(PixelScaler())
+            Pipeline.of(PixelScaler(only_if_integer=True))
             .and_then(GrayScaler())
             .and_then(
                 SIFTExtractor(

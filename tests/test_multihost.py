@@ -1,0 +1,61 @@
+"""Multi-host (multi-process) integration test.
+
+SURVEY.md §2.9: the reference's distribution backend is Spark executors
+over ethernet; ours is multi-process JAX — ICI within a slice, DCN (here:
+Gloo over localhost TCP) across processes.  This launches TWO OS
+processes, each owning 4 virtual CPU devices and feeding only its own
+slice of the global batch, and asserts the sharded normal-equations
+solve matches the exact full-data solve on both — the reference's
+"distributed == exact local" golden pattern, across real process
+boundaries.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_solver_matches_exact():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        # the worker runs by path (script dir = tests/), so the repo root
+        # must come from PYTHONPATH
+        PYTHONPATH=cwd + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=cwd,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{err[-2000:]}"
+        assert "MULTIHOST_OK" in out, f"missing OK marker:\n{out}\n{err[-1000:]}"

@@ -411,3 +411,21 @@ def test_sift_matches_independent_numpy_reference():
         img[None]
     )
     np.testing.assert_allclose(np.asarray(out[0]), ref, atol=2e-5, rtol=2e-4)
+
+
+def test_pixel_scaler_only_if_integer():
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops import PixelScaler
+
+    u8 = np.full((2, 4, 4, 3), 128, np.uint8)
+    f01 = np.full((2, 4, 4, 3), 0.5, np.float32)
+    guard = PixelScaler(only_if_integer=True)
+    np.testing.assert_allclose(np.asarray(guard.apply_batch(u8)), 128 / 255.0)
+    # pre-normalized floats pass through unscaled (no silent /255 collapse)
+    np.testing.assert_allclose(np.asarray(guard.apply_batch(f01)), 0.5)
+    # the default stays unconditional: float [0,255] CSV pixels divide
+    np.testing.assert_allclose(
+        np.asarray(PixelScaler().apply_batch(f01 * 255.0)), 0.5
+    )
+    assert guard.params() != PixelScaler().params()  # distinct CSE identity
