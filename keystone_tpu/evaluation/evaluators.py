@@ -166,22 +166,31 @@ class AugmentedExamplesEvaluator:
     def __init__(self, num_classes: int):
         self.num_classes = int(num_classes)
 
-    def evaluate(self, scores, image_ids, labels) -> MulticlassMetrics:
-        """scores: (n_views_total, K); image_ids: (n_views_total,) group
-        key per view; labels: per-image true class keyed by first
-        occurrence order of image_ids."""
+    @staticmethod
+    def averaged_scores(scores, image_ids) -> tuple:
+        """Mean score per image id.  Returns ``(agg, first_idx)`` where
+        ``agg`` rows follow np.unique's sorted id order and ``first_idx``
+        is each unique id's first view index (for label realignment).
+        The single source of the view-aggregation logic — ``evaluate``
+        and top-k consumers both derive from it."""
         s = np.asarray(_maybe_numpy(scores), np.float64)
         ids = np.asarray(_maybe_numpy(image_ids))
-        labs = _as_int_array(labels)
         uniq, first_idx, inverse = np.unique(
             ids, return_index=True, return_inverse=True
         )
         agg = np.zeros((uniq.shape[0], s.shape[1]))
         np.add.at(agg, inverse, s)
         counts = np.bincount(inverse, minlength=uniq.shape[0])[:, None]
-        agg = agg / np.maximum(counts, 1)
+        return agg / np.maximum(counts, 1), first_idx
+
+    def evaluate(self, scores, image_ids, labels) -> MulticlassMetrics:
+        """scores: (n_views_total, K); image_ids: (n_views_total,) group
+        key per view; labels: per-image true class keyed by first
+        occurrence order of image_ids."""
+        labs = _as_int_array(labels)
+        agg, first_idx = self.averaged_scores(scores, image_ids)
         pred = agg.argmax(axis=1)
-        if labs.shape[0] == uniq.shape[0]:
+        if labs.shape[0] == agg.shape[0]:
             # labs are per-image in FIRST-OCCURRENCE order; np.unique's uniq
             # is sorted — realign by each unique id's occurrence rank
             occ_order = np.argsort(first_idx)

@@ -88,6 +88,28 @@ def test_imagenet_sift_lcs_fv_e2e():
     assert result["accuracy"] > 0.5, result
 
 
+def test_imagenet_augmented_view_eval():
+    """The reference's 10-view test path: CenterCornerPatcher views,
+    scores averaged per image id (AugmentedExamplesEvaluator) before
+    classification (SURVEY §3.4)."""
+    cfg = ImageNetSiftLcsFV.Config(
+        num_classes=4,
+        gmm_k=4,
+        gmm_iters=4,
+        pca_dims=16,
+        descriptor_samples_per_image=32,
+        solver_block_size=512,
+        synthetic_n=40,  # → 10 test images: non-divisible on the 4-wide
+        image_size=48,   # data axis, exercising the padded-rows crop
+        sift_step=8,
+        lcs_step=8,
+        augmented_eval=True,
+    )
+    result = ImageNetSiftLcsFV.run(cfg)
+    assert 0.0 <= result["top5_error"] <= result["top1_error"] + 1e-9, result
+    assert result["accuracy"] > 0.5, result
+
+
 def test_voc_sift_fisher_e2e():
     cfg = VOCSIFTFisher.Config(
         gmm_k=4,
