@@ -1,0 +1,74 @@
+"""Regenerate docs/api.md from the package's public exports.
+
+Usage (from the repo root):
+
+    JAX_PLATFORMS=cpu python docs/gen_api.py > docs/api.md
+"""
+
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+MODULES = [
+    ("keystone_tpu.workflow", "Workflow core"),
+    ("keystone_tpu.parallel", "Distribution"),
+    ("keystone_tpu.models", "Solvers"),
+    ("keystone_tpu.ops", "Feature ops"),
+    ("keystone_tpu.loaders", "Loaders"),
+    ("keystone_tpu.evaluation", "Evaluation"),
+    ("keystone_tpu.utils", "Utils"),
+]
+
+
+def main() -> None:
+    print("# API reference\n")
+    print(
+        "One line per public symbol of each package namespace (regenerate "
+        "with `python docs/gen_api.py > docs/api.md`).  Usage: "
+        "docs/guide.md; design rationale: docs/architecture.md; reference "
+        "mapping: PARITY.md.\n"
+    )
+    for modname, title in MODULES:
+        m = importlib.import_module(modname)
+        names = getattr(m, "__all__", None) or sorted(
+            n for n in vars(m) if not n.startswith("_")
+        )
+        print(f"## {title} — `{modname}`\n")
+        for n in names:
+            obj = getattr(m, n, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            raw = (
+                obj.__dict__.get("__doc__")
+                if isinstance(obj, type)
+                else obj.__doc__
+            )
+            first = ""
+            if raw:
+                line = inspect.cleandoc(raw).split("\n\n")[0].replace("\n", " ")
+                first = line if len(line) <= 160 else line[:157] + "…"
+            if inspect.isclass(obj):
+                kind = "class"
+            elif callable(obj):
+                try:
+                    kind = f"def{inspect.signature(obj)}"
+                    if len(kind) > 80:
+                        kind = "def(…)"
+                except (TypeError, ValueError):
+                    kind = "def"
+            else:
+                continue
+            sep = " — " if first else ""
+            print(f"- **`{n}`** `{kind}`{sep}{first}")
+        print()
+
+
+if __name__ == "__main__":
+    main()

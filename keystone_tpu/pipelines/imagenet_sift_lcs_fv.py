@@ -33,7 +33,6 @@ from keystone_tpu.ops import (
     GMMFisherVectorEstimator,
     GrayScaler,
     LCSExtractor,
-    MaxClassifier,
     NormalizeRows,
     PixelScaler,
     SIFTExtractor,

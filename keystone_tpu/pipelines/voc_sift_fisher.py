@@ -9,20 +9,10 @@ import dataclasses
 import time
 from typing import Optional
 
-import numpy as np
-
 from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
 from keystone_tpu.loaders.voc import VOCLoader, NUM_CLASSES
-from keystone_tpu.models import BlockWeightedLeastSquaresEstimator, PCAEstimator
-from keystone_tpu.ops import (
-    ColumnSampler,
-    GMMFisherVectorEstimator,
-    GrayScaler,
-    PixelScaler,
-    NormalizeRows,
-    SIFTExtractor,
-    SignedHellingerMapper,
-)
+from keystone_tpu.models import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.ops import GrayScaler, PixelScaler, SIFTExtractor
 from keystone_tpu.workflow import Dataset, Pipeline
 
 
@@ -66,8 +56,6 @@ class VOCSIFTFisher:
         )
         branch = _fv_branch(sift_base, config, train_x, seed=config.seed)
         # multilabels are 0/1; targets are ±1
-        import jax.numpy as jnp
-
         from keystone_tpu.workflow import transformer
 
         to_pm1 = transformer(

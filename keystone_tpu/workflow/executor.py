@@ -18,8 +18,6 @@ import logging
 import time
 from typing import Any, Dict, Optional
 
-import jax
-
 from keystone_tpu.workflow import graph as G
 from keystone_tpu.workflow.dataset import Dataset, as_dataset
 from keystone_tpu.workflow.estimator import Estimator, LabelEstimator
