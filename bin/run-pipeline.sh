@@ -19,6 +19,9 @@
 #                       persistent XLA compile-cache dir (default
 #                       ~/.cache/keystone_tpu/xla; "off" disables) —
 #                       repeat runs of a pipeline skip compilation
+#   KEYSTONE_STATE_DIR  saved-pipeline-state dir: materialized prefixes
+#                       persisted by save_pipeline_state are reloaded
+#                       instead of recomputed (SavedStateLoadRule)
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

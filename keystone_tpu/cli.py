@@ -52,6 +52,14 @@ def main(argv=None):
     from keystone_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    state_dir = os.environ.get("KEYSTONE_STATE_DIR")
+    if state_dir:
+        # saved-prefix reload (workflow/state.py SavedStateLoadRule):
+        # loader datasets are named, so featurized prefixes persisted by
+        # save_pipeline_state in an earlier process are reused here
+        from keystone_tpu.workflow import PipelineEnv
+
+        PipelineEnv.state_dir = state_dir
     mod = importlib.import_module(_PIPELINE_MODULES[name])
     mod.main(rest)
     return 0

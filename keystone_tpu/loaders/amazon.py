@@ -5,6 +5,7 @@ star ratings for its binary classification pipeline)."""
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
@@ -25,7 +26,11 @@ class AmazonReviewsDataLoader:
                 texts.append(rec.get("reviewText", rec.get("text", "")))
                 rating = float(rec.get("overall", rec.get("rating", 0.0)))
                 labels.append(1 if rating > threshold else 0)
-        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))
+        name = f"amazon:{os.path.abspath(path)}:t{threshold}"
+        return LabeledData(
+            Dataset(texts, name=name),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )
 
     @staticmethod
     def synthetic(n: int = 600, seed: int = 0) -> LabeledData:
@@ -43,4 +48,8 @@ class AmazonReviewsDataLoader:
             rng.shuffle(words)
             texts.append(" ".join(words))
             labels.append(lab)
-        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))
+        name = f"amazon-synth-n{n}-s{seed}"
+        return LabeledData(
+            Dataset(texts, name=name),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )

@@ -24,10 +24,13 @@ class CifarLoader:
     def load(path: str) -> LabeledData:
         from keystone_tpu import native
 
+        name = f"cifar:{os.path.abspath(path)}"
         res = native.read_cifar(path)
         if res is not None:
             pixels, labels = res
-            return LabeledData(Dataset(pixels), Dataset(labels))
+            return LabeledData(
+                Dataset(pixels, name=name), Dataset(labels, name=name + "-labels")
+            )
         raw = np.fromfile(path, dtype=np.uint8)
         if raw.size % RECORD != 0:
             raise ValueError(f"{path}: size {raw.size} not a multiple of {RECORD}")
@@ -35,7 +38,8 @@ class CifarLoader:
         labels = recs[:, 0].astype(np.int32)
         pixels = recs[:, 1:].reshape(-1, C, H, W).transpose(0, 2, 3, 1)
         return LabeledData(
-            Dataset(pixels.astype(np.float32) / 255.0), Dataset(labels)
+            Dataset(pixels.astype(np.float32) / 255.0, name=name),
+            Dataset(labels, name=name + "-labels"),
         )
 
     @staticmethod
@@ -55,4 +59,8 @@ class CifarLoader:
             idx = labels == k
             y0, x0 = 3 * (k % 3) + 4, 3 * (k // 3) + 4
             x[idx, y0 : y0 + 6, x0 : x0 + 6, :] += 0.5
-        return LabeledData(Dataset(np.clip(x, 0, 1)), Dataset(labels.astype(np.int32)))
+        name = f"cifar-synth-n{n}-s{seed}"
+        return LabeledData(
+            Dataset(np.clip(x, 0, 1), name=name),
+            Dataset(labels.astype(np.int32), name=name + "-labels"),
+        )

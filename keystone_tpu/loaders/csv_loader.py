@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from keystone_tpu.loaders.labeled import LabeledData
@@ -31,8 +33,14 @@ class CsvDataLoader:
         mat = _read_csv_matrix(path, delimiter)
         labels = mat[:, label_col].astype(np.int32)
         feats = np.delete(mat, label_col, axis=1)
-        return LabeledData(Dataset(feats), Dataset(labels))
+        name = f"csv:{os.path.abspath(path)}:l{label_col}:d{delimiter!r}"
+        return LabeledData(
+            Dataset(feats, name=name), Dataset(labels, name=name + "-labels")
+        )
 
     @staticmethod
     def load_unlabeled(path: str, delimiter: str = ",") -> Dataset:
-        return Dataset(_read_csv_matrix(path, delimiter))
+        return Dataset(
+            _read_csv_matrix(path, delimiter),
+            name=f"csv:{os.path.abspath(path)}:d{delimiter!r}",
+        )

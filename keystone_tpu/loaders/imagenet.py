@@ -96,7 +96,11 @@ class ImageNetLoader:
             if limit is not None and len(images) >= limit:
                 break
         x = np.stack(images) if images else np.zeros((0, *size, 3), np.uint8)
-        return LabeledData(Dataset(x), Dataset(np.asarray(labels, np.int32)))
+        name = f"imagenet:{os.path.abspath(path)}:{size[0]}x{size[1]}:lim{limit}"
+        return LabeledData(
+            Dataset(x, name=name),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )
 
     @staticmethod
     def synthetic(
@@ -127,4 +131,8 @@ class ImageNetLoader:
             img += 0.05 * rng.normal(size=(h, w, 3))
             imgs[i] = np.clip(img, 0, 1)
         pixels = np.rint(imgs * 255.0).astype(np.uint8)
-        return LabeledData(Dataset(pixels), Dataset(labels.astype(np.int32)))
+        name = f"imagenet-synth-n{n}-c{num_classes}-{size[0]}x{size[1]}-s{seed}"
+        return LabeledData(
+            Dataset(pixels, name=name),
+            Dataset(labels.astype(np.int32), name=name + "-labels"),
+        )

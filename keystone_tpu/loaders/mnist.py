@@ -38,4 +38,10 @@ class MnistLoader:
             np.float32
         )
         x = np.clip(x, 0, 255)
-        return LabeledData(Dataset(x), Dataset(labels.astype(np.int32)))
+        # named datasets: prefix signatures stay stable across processes,
+        # so SavedStateLoadRule can reload featurized prefixes (state.py)
+        name = f"mnist-synth-n{n}-s{seed}"
+        return LabeledData(
+            Dataset(x, name=name),
+            Dataset(labels.astype(np.int32), name=name + "-labels"),
+        )

@@ -40,7 +40,11 @@ class NewsgroupsDataLoader:
                     labels.append(gi)
                 except OSError:
                     continue
-        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))
+        name = f"newsgroups:{os.path.abspath(root)}"
+        return LabeledData(
+            Dataset(texts, name=name),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )
 
     @staticmethod
     def synthetic(
@@ -63,4 +67,8 @@ class NewsgroupsDataLoader:
             rng.shuffle(words)
             texts.append(" ".join(words))
             labels.append(c)
-        return LabeledData(Dataset(texts), Dataset(np.asarray(labels, np.int32)))
+        name = f"newsgroups-synth-n{n}-c{num_classes}-s{seed}"
+        return LabeledData(
+            Dataset(texts, name=name),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )

@@ -5,6 +5,8 @@ window in the standard prep) with per-frame labels over 147 phone states.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from keystone_tpu.loaders.labeled import LabeledData
@@ -28,9 +30,13 @@ class TimitFeaturesDataLoader:
             if labels_path.endswith(".npy")
             else np.loadtxt(labels_path, dtype=np.int64)
         )
+        name = (
+            f"timit:{os.path.abspath(features_path)}"
+            f":{os.path.abspath(labels_path)}"
+        )
         return LabeledData(
-            Dataset(feats.astype(np.float32)),
-            Dataset(labels.astype(np.int32)),
+            Dataset(feats.astype(np.float32), name=name),
+            Dataset(labels.astype(np.int32), name=name + "-labels"),
         )
 
     @staticmethod
@@ -44,4 +50,8 @@ class TimitFeaturesDataLoader:
             .astype(np.float32)
         )
         x = prototypes[labels] + 0.8 * rng.normal(size=(n, DIM)).astype(np.float32)
-        return LabeledData(Dataset(x), Dataset(labels.astype(np.int32)))
+        name = f"timit-synth-n{n}-c{num_classes}-s{seed}"
+        return LabeledData(
+            Dataset(x, name=name),
+            Dataset(labels.astype(np.int32), name=name + "-labels"),
+        )

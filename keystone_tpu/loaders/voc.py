@@ -54,7 +54,13 @@ class VOCLoader:
                 break
         x = np.stack(images) if images else np.zeros((0, *size, 3), np.uint8)
         y = np.stack(labels) if labels else np.zeros((0, NUM_CLASSES), np.float32)
-        return LabeledData(Dataset(x), Dataset(y))
+        name = (
+            f"voc:{os.path.abspath(images_dir)}:{os.path.abspath(annotations_dir)}"
+            f":{size[0]}x{size[1]}:lim{limit}"
+        )
+        return LabeledData(
+            Dataset(x, name=name), Dataset(y, name=name + "-labels")
+        )
 
     @staticmethod
     def synthetic(
@@ -71,4 +77,7 @@ class VOCLoader:
         extra = rng.integers(0, NUM_CLASSES, size=n)
         mask = rng.random(n) < 0.3
         multi[np.arange(n)[mask], extra[mask]] = 1.0
-        return LabeledData(base.data, Dataset(multi))
+        return LabeledData(
+            base.data,
+            Dataset(multi, name=f"voc-synth-multilabels-n{n}-s{seed}"),
+        )

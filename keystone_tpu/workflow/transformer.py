@@ -15,12 +15,16 @@ than the reference's per-datum formulation.
 
 from __future__ import annotations
 
+import weakref
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 from keystone_tpu.workflow.dataset import Dataset, as_dataset
+
+#: per-transformer jitted apply_batch wrappers (see _apply_batch_jitted)
+_JIT_APPLY_CACHE = weakref.WeakKeyDictionary()
 
 
 class Chainable:
@@ -69,10 +73,37 @@ class Transformer(Chainable):
                 except (TypeError, ValueError):
                     pass
             return ds.with_items(out)
-        result = self.apply_batch(ds.array, mask=ds.mask)
+        result = self._apply_batch_jitted(ds.array, ds.mask)
         if isinstance(result, tuple):  # (values, mask) for ragged producers
             return ds.with_array(result[0], mask=result[1])
         return ds.with_array(result)
+
+    def _apply_batch_jitted(self, xs, mask):
+        """Run apply_batch as ONE compiled program.
+
+        Un-fused nodes (raw-graph execution: saved-state walks, single-node
+        applies) would otherwise dispatch op-by-op eagerly — slower, and on
+        the axon TPU backend an eager FFT dispatch corrupts the device
+        stream for the rest of the process.  Untraceable apply_batch
+        implementations (host-side numpy, data-dependent Python) fall back
+        to the eager path."""
+        sentinel = object()
+        fn = _JIT_APPLY_CACHE.get(self, sentinel)
+        if fn is None:  # memoized "untraceable": straight to eager
+            return self.apply_batch(xs, mask=mask)
+        if fn is sentinel:
+            # weak cache, NOT an instance attribute: jitted callables are
+            # unpicklable and must not ride along in FittedPipeline.save.
+            # The closure holds weakref.ref(self) — closing over self
+            # would make the cache VALUE pin its own KEY alive forever.
+            self_ref = weakref.ref(self)
+            fn = jax.jit(lambda a, m: self_ref().apply_batch(a, mask=m))
+            _JIT_APPLY_CACHE[self] = fn
+        try:
+            return fn(xs, mask)
+        except (TypeError, jax.errors.JAXTypeError):
+            _JIT_APPLY_CACHE[self] = None  # don't re-pay a failed trace
+            return self.apply_batch(xs, mask=mask)
 
     def __call__(self, x):
         from keystone_tpu.workflow.pipeline import Pipeline, PipelineDataset

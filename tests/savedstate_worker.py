@@ -1,0 +1,58 @@
+"""Worker for the cross-process saved-state test (test_savedstate.py).
+
+Phase "save": featurize named loader data, persist every saveable prefix.
+Phase "load": in a NEW process, set PipelineEnv.state_dir and apply the
+same pipeline — the SavedStateLoadRule must reload the featurized prefix
+(named datasets keep prefix signatures stable across processes) instead
+of recomputing.  Prints the feature checksum either way; the parent
+asserts the checksums match and that the load phase logged a reload.
+"""
+
+import logging
+import os
+import sys
+
+
+def build(data):
+    from keystone_tpu.ops import LinearRectifier, PaddedFFT, RandomSignNode
+
+    from keystone_tpu.workflow import Pipeline
+
+    dim = data.array.shape[1]
+    pipe = (
+        Pipeline.of(RandomSignNode.init(dim, seed=7))
+        .and_then(PaddedFFT())
+        .and_then(LinearRectifier(0.0))
+    )
+    return pipe(data)
+
+
+def main() -> None:
+    phase, state_dir = sys.argv[1], sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+
+    from keystone_tpu.loaders.mnist import MnistLoader
+    from keystone_tpu.workflow import PipelineEnv
+
+    data = MnistLoader.synthetic(64, seed=3).data  # named dataset
+    if phase == "save":
+        from keystone_tpu.workflow.state import save_pipeline_state
+
+        result = build(data)
+        saved = save_pipeline_state(result, state_dir)
+        out = result.get().numpy()
+        print(f"SAVED n={saved} checksum={np.abs(out).sum():.4f}", flush=True)
+    else:
+        PipelineEnv.state_dir = state_dir
+        out = build(data).get().numpy()
+        print(f"LOADED checksum={np.abs(out).sum():.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

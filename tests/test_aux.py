@@ -11,6 +11,14 @@ from keystone_tpu.workflow import Dataset, Pipeline, Transformer
 
 
 class Expensive(Transformer):
+    """Side-effect execution counter (the reference's fake-node pattern).
+
+    Counts via ``jax.debug.callback`` so every EXECUTION of the compiled
+    program bumps the counter — node-level execution runs through a
+    jitted wrapper now, where a bare Python increment would fire once at
+    trace time regardless of how many times the program runs.  Read the
+    count through :func:`expensive_calls` (callbacks land async)."""
+
     calls = 0
 
     def __init__(self, tag: str):
@@ -19,9 +27,23 @@ class Expensive(Transformer):
     def params(self):
         return (self.tag,)
 
-    def apply_batch(self, xs, mask=None):
+    @staticmethod
+    def _bump():
         Expensive.calls += 1
+
+    def apply_batch(self, xs, mask=None):
+        import jax
+
+        jax.debug.callback(Expensive._bump)
         return xs * 2.0
+
+
+def expensive_calls() -> int:
+    """Expensive.calls after flushing pending host callbacks."""
+    import jax
+
+    jax.effects_barrier()
+    return Expensive.calls
 
 
 class AddC(Transformer):
@@ -86,7 +108,7 @@ def test_profiling_autocache_over_budget_sets_no_memoize():
     Expensive.calls = 0
     ex = GraphExecutor(g2)
     ex.execute(g2.sinks[0])
-    assert Expensive.calls == 2
+    assert expensive_calls() == 2
 
 
 def test_saved_state_roundtrip(tmp_path):
@@ -245,7 +267,7 @@ def test_pipeline_env_state_dir_roundtrip(tmp_path):
             Dataset(np.full((8, 3), 2.0, np.float32), name="env-train")
         ).get()
         np.testing.assert_allclose(out.numpy(), 5.0)
-        assert Expensive.calls == 0
+        assert expensive_calls() == 0
     finally:
         PipelineEnv.state_dir = None
 

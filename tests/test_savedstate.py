@@ -1,0 +1,54 @@
+"""Cross-process pipeline checkpoint/resume.
+
+Reference: workflow/SavedStateLoadRule.scala + ExtractSaveablePrefixes —
+a later RUN (new JVM there, new Python process here) reloads previously
+materialized pipeline prefixes from the state dir instead of recomputing
+(SURVEY.md §5 "Checkpoint/resume").  Loader datasets are named, which is
+what keeps prefix signatures stable across processes.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+WORKER = os.path.join(os.path.dirname(__file__), "savedstate_worker.py")
+
+
+def _run(phase: str, state_dir: str):
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=cwd + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    return subprocess.run(
+        [sys.executable, WORKER, phase, state_dir],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=cwd,
+    )
+
+
+def _checksum(out: str) -> str:
+    m = re.search(r"checksum=([0-9.]+)", out)
+    assert m, out
+    return m.group(1)
+
+
+def test_saved_prefixes_reload_in_new_process(tmp_path):
+    state = str(tmp_path / "state")
+    save = _run("save", state)
+    assert save.returncode == 0, save.stderr[-2000:]
+    assert "SAVED n=" in save.stdout and "SAVED n=0" not in save.stdout
+    assert os.listdir(state), "no state files written"
+
+    load = _run("load", state)
+    assert load.returncode == 0, load.stderr[-2000:]
+    # the optimizer must have RELOADED the prefix, not recomputed it
+    assert "reloaded saved prefix" in (load.stderr + load.stdout), (
+        load.stderr[-2000:]
+    )
+    assert _checksum(load.stdout) == _checksum(save.stdout)
