@@ -429,3 +429,25 @@ def test_pixel_scaler_only_if_integer():
         np.asarray(PixelScaler().apply_batch(f01 * 255.0)), 0.5
     )
     assert guard.params() != PixelScaler().params()  # distinct CSE identity
+
+
+def test_sift_multiscale_concatenates_per_scale_descriptors():
+    """Multiple bin sizes (the reference's multi-scale dense SIFT): output
+    is the per-scale descriptor sets concatenated along the keypoint axis."""
+    from keystone_tpu.ops import SIFTExtractor
+    from keystone_tpu.ops.sift import sift_output_count
+
+    rng = np.random.default_rng(3)
+    imgs = rng.normal(size=(2, 40, 40)).astype(np.float32)
+    multi, m_mask = SIFTExtractor(step=5, bin_sizes=(3, 5)).apply_batch(
+        jnp.asarray(imgs)
+    )
+    k = sift_output_count(40, 40, 5, (3, 5))
+    assert multi.shape == (2, k, 128) and m_mask.shape == (2, k)
+    s3, _ = SIFTExtractor(step=5, bin_sizes=(3,)).apply_batch(jnp.asarray(imgs))
+    s5, _ = SIFTExtractor(step=5, bin_sizes=(5,)).apply_batch(jnp.asarray(imgs))
+    np.testing.assert_allclose(
+        np.asarray(multi),
+        np.concatenate([np.asarray(s3), np.asarray(s5)], axis=1),
+        atol=1e-6,
+    )

@@ -219,3 +219,26 @@ def test_pipeline_datum_apply():
     p = AddConst(1.0) | AddConst(1.0)
     out = p.apply_datum(jnp.array([1.0, 2.0])).get()
     assert np.allclose(np.asarray(out), [3.0, 4.0])
+
+
+def test_save_load_fitted_after_apply(tmp_path):
+    """Applying a fitted pipeline populates the per-transformer jit cache;
+    save/load must still work (the cache is weak+module-level, never
+    pickled) and the loaded pipeline must predict identically."""
+    from keystone_tpu.models import LinearMapEstimator
+    from keystone_tpu.ops import ClassLabelIndicators, LinearRectifier
+    from keystone_tpu.workflow.pipeline import FittedPipeline
+
+    rng = np.random.default_rng(0)
+    x = Dataset(rng.normal(size=(64, 8)).astype(np.float32))
+    y = ClassLabelIndicators(3)(
+        Dataset(rng.integers(0, 3, size=(64,)).astype(np.int32))
+    )
+    fitted = (
+        Pipeline.of(LinearRectifier(0.0)).and_then(LinearMapEstimator(lam=0.1), x, y)
+    ).fit()
+    before = fitted(x).get().numpy()  # populates _JIT_APPLY_CACHE
+    path = str(tmp_path / "fp.pkl")
+    fitted.save(path)
+    loaded = FittedPipeline.load(path)
+    np.testing.assert_allclose(loaded(x).get().numpy(), before, atol=1e-6)
