@@ -79,6 +79,107 @@ class LinearMapEstimator(LabelEstimator):
         )
         return LinearMapper(w, b if self.fit_intercept else None)
 
+    def fit_stream(self, batches) -> LinearMapper:
+        """Out-of-core exact least squares from a stream of host batches.
+
+        ``batches``: a callable returning an iterator of ``(x, y)`` host
+        arrays (re-invoked per pass), or a re-iterable (e.g. a list).
+        The normal equations only need accumulated sufficient statistics,
+        so HBM holds one batch plus the (d, d)/(d, k) accumulators — the
+        dataset can be arbitrarily larger than device memory (the
+        reference's analogue: features as spilled RDDs, SURVEY §2.9).
+
+        Two passes when ``fit_intercept``: means first, then Gramians of
+        EXPLICITLY centered batches — the one-pass shortcut
+        ``XᵀX − n·x̄x̄ᵀ`` cancels catastrophically in f32 (see
+        _fit_normal_equations).  Accumulators are Kahan-compensated, so
+        rounding error stays O(ε) instead of growing with batch count.
+        """
+        get = batches if callable(batches) else lambda: iter(batches)
+        if not self.fit_intercept:
+            gram = None
+            n = 0
+            for bx, by in get():
+                bx, by, bn, row_ok = _stage_batch(bx, by)
+                n += bn
+                gram = _acc_gram(gram, bx, by, None, None, row_ok)
+            if n == 0:
+                raise ValueError("empty batch stream")
+            w = solve_spd(gram[0], gram[2], reg=self.lam * n)
+            return LinearMapper(w, None)
+        sums = None
+        n = 0
+        for bx, by in get():
+            bx, by, bn, row_ok = _stage_batch(bx, by)
+            n += bn
+            sums = _acc_sums(sums, bx, by)
+        if n == 0:
+            raise ValueError("empty batch stream")
+        xm, ym = sums[0] / n, sums[2] / n
+        gram = None
+        n2 = 0
+        for bx, by in get():
+            bx, by, bn, row_ok = _stage_batch(bx, by)
+            n2 += bn
+            gram = _acc_gram(gram, bx, by, xm, ym, row_ok)
+        if n2 != n:
+            raise ValueError(
+                f"batch stream is not re-iterable: first pass saw {n} rows, "
+                f"second pass {n2}. Pass a CALLABLE returning a fresh "
+                "iterator (or a re-iterable like a list), not a one-shot "
+                "generator."
+            )
+        w = solve_spd(gram[0], gram[2], reg=self.lam * n)
+        return LinearMapper(w, ym - xm @ w)
+
+
+def _stage_batch(bx, by):
+    """Host batch → mesh-sharded device arrays + true row count + pad mask."""
+    import numpy as np
+
+    from keystone_tpu.parallel import mesh as _mesh
+
+    bn = int(np.shape(bx)[0])
+    x = _mesh.shard_batch(np.asarray(bx, np.float32))
+    y = _mesh.shard_batch(np.asarray(by, np.float32))
+    row_ok = (jnp.arange(x.shape[0]) < bn).astype(jnp.float32)[:, None]
+    return x, y, bn, row_ok
+
+
+def _kahan_add(s, c, inc):
+    """One compensated-summation step: returns (new_sum, new_compensation)."""
+    y = inc - c
+    t = s + y
+    return t, (t - s) - y
+
+
+@jax.jit
+def _acc_sums(carry, x, y):
+    """carry = (s1x, c1x, s1y, c1y) Kahan-compensated column sums."""
+    bx, by = jnp.sum(x, axis=0), jnp.sum(y, axis=0)
+    if carry is None:
+        return bx, jnp.zeros_like(bx), by, jnp.zeros_like(by)
+    s1x, c1x, s1y, c1y = carry
+    s1x, c1x = _kahan_add(s1x, c1x, bx)
+    s1y, c1y = _kahan_add(s1y, c1y, by)
+    return s1x, c1x, s1y, c1y
+
+
+@jax.jit
+def _acc_gram(carry, x, y, xm, ym, row_ok):
+    """carry = (sxx, cxx, sxy, cxy) Kahan-compensated Gramian sums."""
+    if xm is not None:
+        # center with the GLOBAL means; mask keeps shard-padding rows at 0
+        x = (x - xm) * row_ok
+        y = (y - ym) * row_ok
+    gxx, gxy = xtx_xty(x, y)
+    if carry is None:
+        return gxx, jnp.zeros_like(gxx), gxy, jnp.zeros_like(gxy)
+    sxx, cxx, sxy, cxy = carry
+    sxx, cxx = _kahan_add(sxx, cxx, gxx)
+    sxy, cxy = _kahan_add(sxy, cxy, gxy)
+    return sxx, cxx, sxy, cxy
+
 
 #: Alias matching common usage in reference pipelines.
 LeastSquaresEstimator = LinearMapEstimator

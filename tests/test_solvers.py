@@ -283,3 +283,37 @@ def test_solvers_in_pipeline_with_sharded_padding():
     )
     pred = pipe(Dataset(x)).get().numpy()
     np.testing.assert_allclose(pred, y, atol=2e-2)
+
+
+def test_linear_map_fit_stream_matches_in_memory(regression_data):
+    """Out-of-core normal equations: streaming odd-sized host batches
+    (forcing shard padding per batch) must reproduce the in-memory fit."""
+    x, y = regression_data
+    lam = 0.1
+    full = LinearMapEstimator(lam=lam).fit_arrays(x, y)
+
+    def batches():
+        for i in range(0, x.shape[0], 37):  # 37 ∤ 4: every batch pads
+            yield x[i : i + 37], y[i : i + 37]
+
+    streamed = LinearMapEstimator(lam=lam).fit_stream(batches)
+    np.testing.assert_allclose(
+        np.asarray(streamed.weights), np.asarray(full.weights), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed.intercept), np.asarray(full.intercept), atol=2e-4
+    )
+    # no-intercept variant, re-iterable list source
+    full0 = LinearMapEstimator(lam=lam, fit_intercept=False).fit_arrays(x, y)
+    lst = [(x[:50], y[:50]), (x[50:], y[50:])]
+    s0 = LinearMapEstimator(lam=lam, fit_intercept=False).fit_stream(lst)
+    np.testing.assert_allclose(
+        np.asarray(s0.weights), np.asarray(full0.weights), atol=2e-4
+    )
+
+
+def test_linear_map_fit_stream_rejects_one_shot_generator(regression_data):
+    x, y = regression_data
+    gen = ((x[i : i + 32], y[i : i + 32]) for i in range(0, x.shape[0], 32))
+    with pytest.raises(ValueError, match="not re-iterable"):
+        LinearMapEstimator(lam=0.1).fit_stream(gen)
