@@ -34,3 +34,35 @@ def xtx_xty(x: jnp.ndarray, y: jnp.ndarray):
     from keystone_tpu.parallel.collectives import sharded_gram, sharded_matmul
 
     return sharded_gram(x), sharded_matmul(x, y)
+
+
+def kahan_add(s, c, inc):
+    """One compensated-summation step: returns (new_sum, new_compensation).
+    Used by the streaming (out-of-core) fits so accumulator rounding error
+    stays O(ε) instead of growing with batch count.  XLA does not
+    reassociate floats by default, so the compensation survives jit."""
+    y = inc - c
+    t = s + y
+    return t, (t - s) - y
+
+
+def stage_stream_batch(*host_arrays):
+    """Host batch arrays → mesh-sharded device arrays, true row count, and
+    a pad-row mask, with the row capacity bucketed to the next power of
+    two.  Bucketing bounds jit recompiles for variable-size streams to
+    O(log max_batch) shapes instead of one per distinct size; zero pad
+    rows are masked by ``row_ok`` wherever sums would see them."""
+    import numpy as np
+
+    from keystone_tpu.parallel import mesh as _mesh
+
+    bn = int(np.shape(host_arrays[0])[0])
+    cap = 1 << max(0, (bn - 1)).bit_length()  # next pow2 >= bn
+    staged = []
+    for a in host_arrays:
+        a = np.asarray(a, np.float32)
+        if cap != a.shape[0]:
+            a = np.pad(a, [(0, cap - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+        staged.append(_mesh.shard_batch(a))
+    row_ok = (jnp.arange(staged[0].shape[0]) < bn).astype(jnp.float32)[:, None]
+    return (*staged, bn, row_ok)

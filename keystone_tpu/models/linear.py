@@ -18,7 +18,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from keystone_tpu.models.common import solve_spd, xtx_xty
+from keystone_tpu.models.common import (
+    kahan_add,
+    solve_spd,
+    stage_stream_batch,
+    xtx_xty,
+)
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import LabelEstimator
 from keystone_tpu.workflow.transformer import Transformer
@@ -134,23 +139,9 @@ class LinearMapEstimator(LabelEstimator):
 
 
 def _stage_batch(bx, by):
-    """Host batch → mesh-sharded device arrays + true row count + pad mask."""
-    import numpy as np
-
-    from keystone_tpu.parallel import mesh as _mesh
-
-    bn = int(np.shape(bx)[0])
-    x = _mesh.shard_batch(np.asarray(bx, np.float32))
-    y = _mesh.shard_batch(np.asarray(by, np.float32))
-    row_ok = (jnp.arange(x.shape[0]) < bn).astype(jnp.float32)[:, None]
-    return x, y, bn, row_ok
-
-
-def _kahan_add(s, c, inc):
-    """One compensated-summation step: returns (new_sum, new_compensation)."""
-    y = inc - c
-    t = s + y
-    return t, (t - s) - y
+    """Host batch → sharded device arrays + true row count + pad mask
+    (pow2-bucketed capacity: bounds recompiles for variable-size streams)."""
+    return stage_stream_batch(bx, by)
 
 
 @jax.jit
@@ -160,8 +151,8 @@ def _acc_sums(carry, x, y):
     if carry is None:
         return bx, jnp.zeros_like(bx), by, jnp.zeros_like(by)
     s1x, c1x, s1y, c1y = carry
-    s1x, c1x = _kahan_add(s1x, c1x, bx)
-    s1y, c1y = _kahan_add(s1y, c1y, by)
+    s1x, c1x = kahan_add(s1x, c1x, bx)
+    s1y, c1y = kahan_add(s1y, c1y, by)
     return s1x, c1x, s1y, c1y
 
 
@@ -176,8 +167,8 @@ def _acc_gram(carry, x, y, xm, ym, row_ok):
     if carry is None:
         return gxx, jnp.zeros_like(gxx), gxy, jnp.zeros_like(gxy)
     sxx, cxx, sxy, cxy = carry
-    sxx, cxx = _kahan_add(sxx, cxx, gxx)
-    sxy, cxy = _kahan_add(sxy, cxy, gxy)
+    sxx, cxx = kahan_add(sxx, cxx, gxx)
+    sxy, cxy = kahan_add(sxy, cxy, gxy)
     return sxx, cxx, sxy, cxy
 
 

@@ -203,6 +203,71 @@ class StandardScaler(Estimator):
             return StandardScalerModel(mean, None)
         return StandardScalerModel(mean, jnp.maximum(std, self.eps))
 
+    def fit_stream(self, batches) -> StandardScalerModel:
+        """Out-of-core moments from a stream of (n_i, d) host batches
+        (companion of LinearMapEstimator.fit_stream; same contract:
+        a callable returning a fresh iterator, or a re-iterable).
+
+        Two passes: means, then Σ(x − mean)² of EXPLICITLY centered
+        batches — the one-pass ``Σx² − n·mean²`` shortcut cancels
+        catastrophically in f32 for large-mean/small-spread columns
+        (std collapses to eps and scaled features explode).  Sums are
+        Kahan-compensated across batches."""
+        from keystone_tpu.models.common import stage_stream_batch
+
+        get = batches if callable(batches) else lambda: iter(batches)
+        sums = None
+        n = 0
+        for b in get():
+            x, bn, row_ok = stage_stream_batch(b)
+            n += bn
+            sums = _acc_col_sums(sums, x)
+        if n == 0:
+            raise ValueError("empty batch stream")
+        mean = sums[0] / n
+        sq = None
+        n2 = 0
+        for b in get():
+            x, bn, row_ok = stage_stream_batch(b)
+            n2 += bn
+            sq = _acc_centered_sq(sq, x, mean, row_ok)
+        if n2 != n:
+            raise ValueError(
+                f"batch stream is not re-iterable: first pass saw {n} rows, "
+                f"second pass {n2}. Pass a CALLABLE returning a fresh "
+                "iterator (or a re-iterable like a list)."
+            )
+        var = sq[0] / max(n - 1.0, 1.0)  # unbiased, like _moments
+        if not self.normalize_std:
+            return StandardScalerModel(mean, None)
+        return StandardScalerModel(mean, jnp.maximum(jnp.sqrt(var), self.eps))
+
+
+@jax.jit
+def _acc_col_sums(carry, x):
+    """carry = (s1, c1): Kahan-compensated Σx columns."""
+    from keystone_tpu.models.common import kahan_add
+
+    b1 = jnp.sum(x, axis=0)
+    if carry is None:
+        return b1, jnp.zeros_like(b1)
+    s1, c1 = carry
+    return kahan_add(s1, c1, b1)
+
+
+@jax.jit
+def _acc_centered_sq(carry, x, mean, row_ok):
+    """carry = (s2, c2): Kahan-compensated Σ(x − mean)² columns; the mask
+    keeps shard-padding rows (which would center to −mean) at zero."""
+    from keystone_tpu.models.common import kahan_add
+
+    xc = (x - mean) * row_ok
+    b2 = jnp.sum(xc * xc, axis=0)
+    if carry is None:
+        return b2, jnp.zeros_like(b2)
+    s2, c2 = carry
+    return kahan_add(s2, c2, b2)
+
 
 @jax.jit
 def _moments(x, n):

@@ -317,3 +317,37 @@ def test_linear_map_fit_stream_rejects_one_shot_generator(regression_data):
     gen = ((x[i : i + 32], y[i : i + 32]) for i in range(0, x.shape[0], 32))
     with pytest.raises(ValueError, match="not re-iterable"):
         LinearMapEstimator(lam=0.1).fit_stream(gen)
+
+
+def test_standard_scaler_fit_stream_matches_in_memory():
+    from keystone_tpu.ops import StandardScaler
+
+    rng = np.random.default_rng(5)
+    x = (100.0 + 3.0 * rng.normal(size=(301, 7))).astype(np.float32)
+    full = StandardScaler().fit_arrays(x)
+    streamed = StandardScaler().fit_stream(
+        [x[i : i + 53] for i in range(0, 301, 53)]  # odd sizes force padding
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed.mean), np.asarray(full.mean), rtol=1e-5
+    )
+    # the streaming path centers explicitly (more accurate than the
+    # in-memory Σx²−n·mean² shortcut), so they agree only to f32 level
+    np.testing.assert_allclose(
+        np.asarray(streamed.std), np.asarray(full.std), rtol=5e-4
+    )
+
+
+def test_standard_scaler_fit_stream_survives_large_mean_small_spread():
+    """The two-pass centered variance must not cancel: mean ~1e3 with
+    std ~0.01 collapses to 0 under the one-pass f32 shortcut."""
+    from keystone_tpu.ops import StandardScaler
+
+    rng = np.random.default_rng(6)
+    x64 = 1000.0 + 0.01 * rng.standard_normal((512, 5))
+    x = x64.astype(np.float32)
+    streamed = StandardScaler().fit_stream(
+        lambda: (x[i : i + 128] for i in range(0, 512, 128))
+    )
+    ref_std = x64.std(axis=0, ddof=1)
+    np.testing.assert_allclose(np.asarray(streamed.std), ref_std, rtol=0.05)
