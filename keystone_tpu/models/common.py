@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from keystone_tpu.parallel import mesh as _mesh
@@ -52,10 +53,6 @@ def stage_stream_batch(*host_arrays):
     two.  Bucketing bounds jit recompiles for variable-size streams to
     O(log max_batch) shapes instead of one per distinct size; zero pad
     rows are masked by ``row_ok`` wherever sums would see them."""
-    import numpy as np
-
-    from keystone_tpu.parallel import mesh as _mesh
-
     bn = int(np.shape(host_arrays[0])[0])
     cap = 1 << max(0, (bn - 1)).bit_length()  # next pow2 >= bn
     staged = []

@@ -105,7 +105,7 @@ class LinearMapEstimator(LabelEstimator):
             gram = None
             n = 0
             for bx, by in get():
-                bx, by, bn, row_ok = _stage_batch(bx, by)
+                bx, by, bn, row_ok = stage_stream_batch(bx, by)
                 n += bn
                 gram = _acc_gram(gram, bx, by, None, None, row_ok)
             if n == 0:
@@ -115,7 +115,7 @@ class LinearMapEstimator(LabelEstimator):
         sums = None
         n = 0
         for bx, by in get():
-            bx, by, bn, row_ok = _stage_batch(bx, by)
+            bx, by, bn, row_ok = stage_stream_batch(bx, by)
             n += bn
             sums = _acc_sums(sums, bx, by)
         if n == 0:
@@ -124,7 +124,7 @@ class LinearMapEstimator(LabelEstimator):
         gram = None
         n2 = 0
         for bx, by in get():
-            bx, by, bn, row_ok = _stage_batch(bx, by)
+            bx, by, bn, row_ok = stage_stream_batch(bx, by)
             n2 += bn
             gram = _acc_gram(gram, bx, by, xm, ym, row_ok)
         if n2 != n:
@@ -136,12 +136,6 @@ class LinearMapEstimator(LabelEstimator):
             )
         w = solve_spd(gram[0], gram[2], reg=self.lam * n)
         return LinearMapper(w, ym - xm @ w)
-
-
-def _stage_batch(bx, by):
-    """Host batch → sharded device arrays + true row count + pad mask
-    (pow2-bucketed capacity: bounds recompiles for variable-size streams)."""
-    return stage_stream_batch(bx, by)
 
 
 @jax.jit

@@ -74,12 +74,22 @@ class SavedStateLoadRule(Rule):
             if key is None:
                 continue
             path = os.path.join(self.state_dir, key + ".npz")
-            if not os.path.exists(path):
-                continue
-            try:
-                loaded = load_dataset(path)
-            except Exception as e:
-                logger.warning("state reload failed for %s: %s", path, e)
+            orbax_path = os.path.join(self.state_dir, key + ".orbax")
+            loaded = None
+            # newest save wins: save_pipeline_state removes the sibling
+            # format, so at most one exists; if a corrupt one remains,
+            # fall through to the other rather than giving up
+            if os.path.isdir(orbax_path):
+                try:
+                    loaded = load_dataset_orbax(orbax_path)
+                except Exception as e:
+                    logger.warning("orbax reload failed for %s: %s", key, e)
+            if loaded is None and os.path.exists(path):
+                try:
+                    loaded = load_dataset(path)
+                except Exception as e:
+                    logger.warning("state reload failed for %s: %s", key, e)
+            if loaded is None:
                 continue
             logger.info("reloaded saved prefix %s for %s", key, op.label())
             graph, new_node = graph.add_node(G.DatasetOperator(loaded), ())
@@ -109,12 +119,44 @@ def load_dataset(path: str) -> Dataset:
     return d
 
 
-def save_pipeline_state(pipeline_dataset, state_dir: str) -> int:
+def save_dataset_orbax(ds: Dataset, path: str) -> None:
+    """Tensorstore-backed save via orbax (SURVEY §5 "stage-output
+    checkpointing (tensorstore)"): sharded device arrays write per-shard
+    without a host gather — the multi-host-scale path; npz is the
+    single-host default."""
+    import orbax.checkpoint as ocp
+
+    payload = {"array": ds.array, "n": np.asarray(ds.n)}
+    if ds.mask is not None:
+        payload["mask"] = ds.mask
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), payload, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_dataset_orbax(path: str) -> Dataset:
+    import orbax.checkpoint as ocp
+
+    restored = ocp.StandardCheckpointer().restore(os.path.abspath(path))
+    d = Dataset(np.asarray(restored["array"]), n=int(restored["n"]), shard=True)
+    if "mask" in restored and restored["mask"] is not None:
+        import jax.numpy as jnp
+
+        d.mask = jnp.asarray(restored["mask"])
+    return d
+
+
+def save_pipeline_state(
+    pipeline_dataset, state_dir: str, backend: str = "npz"
+) -> int:
     """Materialize and save every saveable (stable-signature, device-array)
     node output of a lazy result — ExtractSaveablePrefixes.  Returns the
-    number of saved prefixes."""
+    number of saved prefixes.  ``backend="orbax"`` writes tensorstore
+    checkpoints (per-shard, no host gather — use at multi-host scale)."""
     from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
 
+    if backend not in ("npz", "orbax"):
+        raise ValueError(f"unknown state backend {backend!r}: npz | orbax")
     os.makedirs(state_dir, exist_ok=True)
     g = pipeline_dataset.graph
     ex = GraphExecutor(g)
@@ -129,7 +171,18 @@ def save_pipeline_state(pipeline_dataset, state_dir: str) -> int:
             continue
         expr = ex.execute(n)
         if isinstance(expr, DatasetExpr) and not expr.dataset.is_host:
-            save_dataset(expr.dataset, os.path.join(state_dir, key + ".npz"))
+            npz_path = os.path.join(state_dir, key + ".npz")
+            orbax_path = os.path.join(state_dir, key + ".orbax")
+            if backend == "orbax":
+                save_dataset_orbax(expr.dataset, orbax_path)
+                if os.path.exists(npz_path):  # newest save must win reload
+                    os.remove(npz_path)
+            else:
+                save_dataset(expr.dataset, npz_path)
+                if os.path.isdir(orbax_path):
+                    import shutil
+
+                    shutil.rmtree(orbax_path)
             saved += 1
     return saved
 
