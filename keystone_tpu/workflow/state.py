@@ -119,30 +119,63 @@ def load_dataset(path: str) -> Dataset:
     return d
 
 
+_ORBAX_CKPTR = None
+
+
+def _orbax_checkpointer():
+    """Process-wide StandardCheckpointer: one async checkpointer reused
+    for every node save/restore (per-call instances leak their background
+    resources across a multi-node pipeline)."""
+    global _ORBAX_CKPTR
+    if _ORBAX_CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _ORBAX_CKPTR = ocp.StandardCheckpointer()
+    return _ORBAX_CKPTR
+
+
 def save_dataset_orbax(ds: Dataset, path: str) -> None:
     """Tensorstore-backed save via orbax (SURVEY §5 "stage-output
     checkpointing (tensorstore)"): sharded device arrays write per-shard
     without a host gather — the multi-host-scale path; npz is the
     single-host default."""
-    import orbax.checkpoint as ocp
-
     payload = {"array": ds.array, "n": np.asarray(ds.n)}
     if ds.mask is not None:
         payload["mask"] = ds.mask
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _orbax_checkpointer()
     ckptr.save(os.path.abspath(path), payload, force=True)
     ckptr.wait_until_finished()
 
 
 def load_dataset_orbax(path: str) -> Dataset:
-    import orbax.checkpoint as ocp
+    """Restore DIRECTLY to the mesh's data sharding: the abstract target
+    carries NamedShardings, so each host/device reads only its shards —
+    no full-array host materialization on restore (matching the save
+    path's no-gather property)."""
+    import jax
 
-    restored = ocp.StandardCheckpointer().restore(os.path.abspath(path))
-    d = Dataset(np.asarray(restored["array"]), n=int(restored["n"]), shard=True)
-    if "mask" in restored and restored["mask"] is not None:
-        import jax.numpy as jnp
+    from keystone_tpu.parallel.mesh import current_mesh, data_sharding
 
-        d.mask = jnp.asarray(restored["mask"])
+    ckptr = _orbax_checkpointer()
+    path = os.path.abspath(path)
+    meta = ckptr.metadata(path).item_metadata
+    mesh = current_mesh()
+    target = {}
+    for key, m in meta.items():
+        shape, dtype = tuple(m.shape), m.dtype
+        if key == "n":
+            target[key] = np.zeros(shape, dtype)  # scalar, host
+        else:  # 'array' / 'mask': leading axis over 'data'
+            target[key] = jax.ShapeDtypeStruct(
+                shape, dtype, sharding=data_sharding(mesh, max(1, len(shape)))
+            )
+    restored = ckptr.restore(path, target)
+    d = Dataset.__new__(Dataset)
+    d._host = None
+    d._array = restored["array"]
+    d.n = int(restored["n"])
+    d.mask = restored.get("mask")
+    d.name = None
     return d
 
 

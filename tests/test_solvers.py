@@ -351,3 +351,33 @@ def test_standard_scaler_fit_stream_survives_large_mean_small_spread():
     )
     ref_std = x64.std(axis=0, ddof=1)
     np.testing.assert_allclose(np.asarray(streamed.std), ref_std, rtol=0.05)
+
+
+def test_out_of_core_featurize_then_fit_stream():
+    """Full out-of-core training path: stream raw batches through a
+    FITTED featurizer, feed featurized batches to the streaming solver,
+    and match the in-memory fit of the same featurized data."""
+    from keystone_tpu.ops import LinearRectifier, RandomSignNode
+
+    from keystone_tpu.workflow import Pipeline
+
+    rng = np.random.default_rng(9)
+    n, d, k = 192, 16, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+    featurizer = Pipeline.of(RandomSignNode.init(d, seed=1)).and_then(
+        LinearRectifier(0.0)
+    )
+
+    def feat_batches():
+        for i in range(0, n, 50):  # odd size: padding + pow2 bucketing
+            bx, by = x[i : i + 50], y[i : i + 50]
+            yield featurizer(Dataset(bx)).get().numpy(), by
+
+    streamed = LinearMapEstimator(lam=1e-3).fit_stream(feat_batches)
+    full_feats = featurizer(Dataset(x)).get().numpy()
+    full = LinearMapEstimator(lam=1e-3).fit_arrays(full_feats, y)
+    np.testing.assert_allclose(
+        np.asarray(streamed.weights), np.asarray(full.weights), atol=2e-4
+    )
