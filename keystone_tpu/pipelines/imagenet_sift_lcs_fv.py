@@ -67,6 +67,9 @@ class Config:
     # AugmentedExamplesEvaluator); view_patch=0 → ⅞ of image_size
     augmented_eval: bool = False
     view_patch: int = 0
+    # persist/reuse the fitted pipeline (standard and augmented paths;
+    # the config is saved alongside and checked on load)
+    model_path: Optional[str] = None
 
 
 def _fv_branch(base: Pipeline, config: Config, train_x: Dataset, seed: int) -> Pipeline:
@@ -143,17 +146,24 @@ class ImageNetSiftLcsFV:
 
     @staticmethod
     def run(config: Config) -> dict:
+        sz = (config.image_size, config.image_size)
         if config.train_path:
-            train = ImageNetLoader.load(config.train_path)
             test = ImageNetLoader.load(config.test_path or config.train_path)
         else:
-            sz = (config.image_size, config.image_size)
-            train = ImageNetLoader.synthetic(
-                config.synthetic_n, config.num_classes, size=sz, seed=1
-            )
             test = ImageNetLoader.synthetic(
                 max(8, config.synthetic_n // 4), config.num_classes, size=sz, seed=2
             )
+
+        def _train():
+            # loaded ONLY when a fit is needed (saved-model runs skip it)
+            if config.train_path:
+                return ImageNetLoader.load(config.train_path)
+            return ImageNetLoader.synthetic(
+                config.synthetic_n, config.num_classes, size=sz, seed=1
+            )
+
+        from keystone_tpu.workflow.pipeline import FittedPipeline
+
         labs = test.labels.numpy()
         if config.augmented_eval:
             # reference path: score 10 views per test image, average
@@ -161,11 +171,15 @@ class ImageNetSiftLcsFV:
             from keystone_tpu.evaluation import AugmentedExamplesEvaluator
             from keystone_tpu.ops import CenterCornerPatcher
 
+            def build_scorer():
+                train = _train()
+                return ImageNetSiftLcsFV.build_scorer(
+                    config, train.data, train.labels
+                )
+
             t0 = time.time()
-            scorer = (
-                ImageNetSiftLcsFV.build_scorer(config, train.data, train.labels)
-                .fit()
-                .block_until_ready()
+            scorer, loaded = FittedPipeline.fit_or_load(
+                config.model_path, build_scorer, config=config
             )
             fit_time = time.time() - t0
             # crop to the true count — Dataset.array carries mesh-padding
@@ -188,11 +202,14 @@ class ImageNetSiftLcsFV:
             order = np.argsort(-agg, axis=1)[:, : config.top_k]
             topk_hit = (order == labs[:, None]).any(axis=1)
         else:
+
+            def build():
+                train = _train()
+                return ImageNetSiftLcsFV.build(config, train.data, train.labels)
+
             t0 = time.time()
-            fitted = (
-                ImageNetSiftLcsFV.build(config, train.data, train.labels)
-                .fit()
-                .block_until_ready()
+            fitted, loaded = FittedPipeline.fit_or_load(
+                config.model_path, build, config=config
             )
             fit_time = time.time() - t0
             topk = fitted(test.data).get().numpy()  # (n, top_k) class ids
@@ -204,6 +221,7 @@ class ImageNetSiftLcsFV:
         return {
             "pipeline": ImageNetSiftLcsFV.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "top1_error": m.total_error,
             "top5_error": float(1.0 - topk_hit.mean()),
             "accuracy": m.accuracy,
@@ -221,6 +239,7 @@ def main(argv=None):
     p.add_argument("--synthetic-n", type=int, default=64)
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--augmented-eval", action="store_true")
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
     cfg = Config(
         train_path=a.train_path,
@@ -232,6 +251,7 @@ def main(argv=None):
         synthetic_n=a.synthetic_n,
         image_size=a.image_size,
         augmented_eval=a.augmented_eval,
+        model_path=a.model_path,
     )
     print(ImageNetSiftLcsFV.run(cfg))
 

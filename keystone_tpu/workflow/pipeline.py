@@ -272,9 +272,49 @@ class FittedPipeline(Pipeline):
     def load(path: str) -> "FittedPipeline":
         with open(path, "rb") as f:
             obj = pickle.load(f)
+        if isinstance(obj, dict) and "pipeline" in obj:  # fit_or_load wrapper
+            obj = obj["pipeline"]
         if not isinstance(obj, FittedPipeline):
             raise TypeError(f"{path} does not contain a FittedPipeline")
         return obj
+
+    @staticmethod
+    def fit_or_load(path, build_fn, config=None):
+        """Load the fitted pipeline saved at ``path``, or build+fit+save.
+
+        ``build_fn`` is called ONLY when fitting is needed — training-data
+        loading belongs inside it, so scoring runs with a saved model skip
+        it entirely.  ``config`` (any ==-comparable value, e.g. the app's
+        Config dataclass) is persisted alongside the pipeline; loading
+        with a config that doesn't match what the model was fitted with
+        raises instead of silently reporting stale results.
+
+        Returns ``(fitted, loaded)`` — ``loaded`` is True when the model
+        came from disk.
+        """
+        import os
+
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                obj = pickle.load(f)
+            saved_cfg = None
+            if isinstance(obj, dict) and "pipeline" in obj:
+                saved_cfg, obj = obj.get("config"), obj["pipeline"]
+            if not isinstance(obj, FittedPipeline):
+                raise TypeError(f"{path} does not contain a FittedPipeline")
+            if config is not None and saved_cfg is not None and saved_cfg != config:
+                raise ValueError(
+                    f"saved model at {path} was fitted with a different "
+                    f"config ({saved_cfg!r}); refusing to score with "
+                    "mismatched parameters — delete the file or pass a "
+                    "matching config"
+                )
+            return obj, True
+        fitted = build_fn().fit().block_until_ready()
+        if path:
+            with open(path, "wb") as f:
+                pickle.dump({"config": config, "pipeline": fitted}, f)
+        return fitted, False
 
 
 class PipelineDataset:

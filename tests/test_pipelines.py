@@ -1,6 +1,8 @@
 """End-to-end pipeline integration tests on synthetic data (SURVEY.md §4:
 mini pipelines in local mode asserting accuracy above a threshold)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -137,3 +139,19 @@ def test_cli_list(capsys):
     assert main(["--list"]) == 0
     out = capsys.readouterr().out
     assert "MnistRandomFFT" in out and "ImageNetSiftLcsFV" in out
+
+
+def test_mnist_model_path_roundtrip(tmp_path):
+    """--model-path: first run fits and saves; second run loads the
+    fitted pipeline and only scores; a changed config refuses to reuse
+    the stale model instead of silently reporting its metrics."""
+    mp = str(tmp_path / "mnist-model.pkl")
+    cfg = MnistRandomFFT.Config(num_ffts=2, synthetic_n=256, model_path=mp)
+    r1 = MnistRandomFFT.run(cfg)
+    assert os.path.exists(mp) and r1["model_loaded"] is False
+    r2 = MnistRandomFFT.run(cfg)
+    assert r2["model_loaded"] is True  # load, not refit
+    assert r2["accuracy"] == r1["accuracy"]
+    stale = MnistRandomFFT.Config(num_ffts=4, synthetic_n=256, model_path=mp)
+    with pytest.raises(ValueError, match="different\n?.*config|different config"):
+        MnistRandomFFT.run(stale)
