@@ -48,6 +48,7 @@ from keystone_tpu.ops.nlp import (  # noqa: F401
     NGramsFeaturizer,
     StupidBackoffLM,
     TermFrequency,
+    log_tf,
     Tokenizer,
     Trimmer,
 )

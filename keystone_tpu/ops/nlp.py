@@ -83,6 +83,15 @@ class NGramsFeaturizer(Transformer):
         return out
 
 
+def log_tf(v: float) -> float:
+    """log(1 + count) — the reference pipelines' log-tf weighting.
+    Module-level (not a lambda) so fitted pipelines embedding
+    ``TermFrequency(log_tf)`` stay picklable (--model-path)."""
+    import math
+
+    return math.log(v + 1.0)
+
+
 class TermFrequency(Transformer):
     """n-gram list → {ngram: weighted count}
     (nodes/misc/TermFrequency.scala; ``fn`` e.g. log1p for log-tf)."""
@@ -148,10 +157,24 @@ class CommonSparseFeatures(Estimator):
         return CommonSparseFeaturesModel(vocab, self.num_features)
 
 
+def stable_term_hash(term) -> int:
+    """Process-independent term hash.  Python's built-in ``hash(str)`` is
+    salted per process (PYTHONHASHSEED), which silently scrambles every
+    HashingTF feature when a fitted model crosses a process boundary
+    (--model-path scoring runs were reduced to chance accuracy).  blake2b
+    of the term's repr is stable everywhere."""
+    import hashlib
+
+    digest = hashlib.blake2b(repr(term).encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "little")
+
+
 class HashingTF(Transformer):
     """Feature hashing to a fixed dimension — the scale-friendly
     alternative to CommonSparseFeatures (no fitted vocabulary; same role
-    as Spark's HashingTF, which the reference text pipelines predate)."""
+    as Spark's HashingTF, which the reference text pipelines predate).
+    Hashing is process-independent (see stable_term_hash), so fitted
+    models score identically after save/load into another process."""
 
     is_host = True
     fusable = False
@@ -165,7 +188,7 @@ class HashingTF(Transformer):
     def apply_one(self, term_dict: Dict) -> np.ndarray:
         row = np.zeros((self.num_features,), np.float32)
         for term, val in term_dict.items():
-            row[hash(term) % self.num_features] += val
+            row[stable_term_hash(term) % self.num_features] += val
         return row
 
 

@@ -273,10 +273,16 @@ def _acc_centered_sq(carry, x, mean, row_ok):
 def _moments(x, n):
     x = constrain(x.astype(jnp.float32), DATA_AXIS)
     s1 = constrain(jnp.sum(x, axis=0))
-    s2 = constrain(jnp.sum(x * x, axis=0))
     mean = s1 / n
+    # EXPLICIT centering before the square: the Σx² − n·mean² shortcut
+    # cancels catastrophically in f32 for large-mean/small-spread columns
+    # (hypothesis found 2% std error at mean≈30; worse cases collapse to
+    # 0).  Padding rows are zero, so they must be masked after centering.
+    row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)[:, None]
+    xc = (x - mean) * row_ok
+    s2c = constrain(jnp.sum(xc * xc, axis=0))
     # unbiased, like Breeze's stddev (n-1 denominator)
-    var = jnp.maximum(s2 - n * mean * mean, 0.0) / jnp.maximum(n - 1.0, 1.0)
+    var = s2c / jnp.maximum(n - 1.0, 1.0)
     return mean, jnp.sqrt(var)
 
 

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import math
 import time
 from typing import Optional
 
@@ -21,8 +20,10 @@ from keystone_tpu.ops import (
     TermFrequency,
     Tokenizer,
     Trimmer,
+    log_tf,
 )
 from keystone_tpu.workflow import Dataset, Pipeline
+
 
 
 @dataclasses.dataclass
@@ -33,6 +34,7 @@ class Config:
     lam: float = 1e-4
     num_iters: int = 40
     synthetic_n: int = 600
+    model_path: Optional[str] = None
 
 
 class AmazonReviewsPipeline:
@@ -46,7 +48,7 @@ class AmazonReviewsPipeline:
             .and_then(LowerCase())
             .and_then(Tokenizer())
             .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
-            .and_then(TermFrequency(lambda v: math.log(v + 1.0)))
+            .and_then(TermFrequency(log_tf))
             .and_then(HashingTF(config.num_features))
         )
         return featurizer.and_then(
@@ -59,20 +61,32 @@ class AmazonReviewsPipeline:
 
     @staticmethod
     def run(config: Config) -> dict:
+        # train/test come from ONE load+split, so the load stays eager
+        # (the test half is always needed, even for saved-model runs)
         if config.data_path:
             data = AmazonReviewsDataLoader.load(config.data_path)
             train, test = data.split(0.8, seed=0)
         else:
             train = AmazonReviewsDataLoader.synthetic(config.synthetic_n, seed=1)
             test = AmazonReviewsDataLoader.synthetic(config.synthetic_n // 4, seed=2)
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
+
         t0 = time.time()
-        fitted = AmazonReviewsPipeline.build(config, train.data, train.labels).fit().block_until_ready()
+        fitted, loaded = FittedPipeline.fit_or_load(
+            config.model_path,
+            lambda: AmazonReviewsPipeline.build(config, train.data, train.labels),
+            config=fit_relevant_config(config),
+        )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = BinaryClassifierEvaluator().evaluate(preds, test.labels)
         return {
             "pipeline": AmazonReviewsPipeline.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "accuracy": m.accuracy,
             "f1": m.f1,
         }
@@ -83,6 +97,7 @@ def main(argv=None):
     p.add_argument("--data-path")
     p.add_argument("--num-features", type=int, default=16384)
     p.add_argument("--synthetic-n", type=int, default=600)
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
     print(
         AmazonReviewsPipeline.run(
@@ -90,6 +105,7 @@ def main(argv=None):
                 data_path=a.data_path,
                 num_features=a.num_features,
                 synthetic_n=a.synthetic_n,
+                model_path=a.model_path,
             )
         )
     )

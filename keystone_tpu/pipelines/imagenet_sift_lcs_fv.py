@@ -162,7 +162,10 @@ class ImageNetSiftLcsFV:
                 config.synthetic_n, config.num_classes, size=sz, seed=1
             )
 
-        from keystone_tpu.workflow.pipeline import FittedPipeline
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
 
         labs = test.labels.numpy()
         if config.augmented_eval:
@@ -179,7 +182,7 @@ class ImageNetSiftLcsFV:
 
             t0 = time.time()
             scorer, loaded = FittedPipeline.fit_or_load(
-                config.model_path, build_scorer, config=config
+                config.model_path, build_scorer, config=fit_relevant_config(config)
             )
             fit_time = time.time() - t0
             # crop to the true count — Dataset.array carries mesh-padding
@@ -209,7 +212,7 @@ class ImageNetSiftLcsFV:
 
             t0 = time.time()
             fitted, loaded = FittedPipeline.fit_or_load(
-                config.model_path, build, config=config
+                config.model_path, build, config=fit_relevant_config(config)
             )
             fit_time = time.time() - t0
             topk = fitted(test.data).get().numpy()  # (n, top_k) class ids

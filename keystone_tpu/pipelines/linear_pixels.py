@@ -21,6 +21,7 @@ class Config:
     test_path: Optional[str] = None
     lam: float = 1e-3
     synthetic_n: int = 1024
+    model_path: Optional[str] = None
 
 
 class LinearPixels:
@@ -39,19 +40,35 @@ class LinearPixels:
     @staticmethod
     def run(config: Config) -> dict:
         if config.train_path:
-            train = CifarLoader.load(config.train_path)
             test = CifarLoader.load(config.test_path or config.train_path)
         else:
-            train = CifarLoader.synthetic(config.synthetic_n, seed=1)
             test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
+
+        def build():
+            # train loads ONLY when a fit is needed (saved-model runs skip it)
+            train = (
+                CifarLoader.load(config.train_path)
+                if config.train_path
+                else CifarLoader.synthetic(config.synthetic_n, seed=1)
+            )
+            return LinearPixels.build(config, train.data, train.labels)
+
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
+
         t0 = time.time()
-        fitted = LinearPixels.build(config, train.data, train.labels).fit().block_until_ready()
+        fitted, loaded = FittedPipeline.fit_or_load(
+            config.model_path, build, config=fit_relevant_config(config)
+        )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)
         return {
             "pipeline": LinearPixels.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
         }
@@ -63,8 +80,12 @@ def main(argv=None):
     p.add_argument("--test-path")
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--synthetic-n", type=int, default=1024)
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
-    print(LinearPixels.run(Config(a.train_path, a.test_path, a.lam, a.synthetic_n)))
+    print(LinearPixels.run(Config(
+        a.train_path, a.test_path, a.lam, a.synthetic_n,
+        model_path=a.model_path,
+    )))
 
 
 if __name__ == "__main__":

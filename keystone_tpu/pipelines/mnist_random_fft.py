@@ -76,11 +76,14 @@ class MnistRandomFFT:
                 train = MnistLoader.synthetic(config.synthetic_n, seed=1)
             return MnistRandomFFT.build(config, train.data, train.labels)
 
-        from keystone_tpu.workflow.pipeline import FittedPipeline
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
 
         t0 = time.time()
         fitted, loaded = FittedPipeline.fit_or_load(
-            config.model_path, build, config=config
+            config.model_path, build, config=fit_relevant_config(config)
         )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()

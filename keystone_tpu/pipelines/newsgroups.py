@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import math
 import time
 from typing import Optional
 
@@ -22,8 +21,10 @@ from keystone_tpu.ops import (
     TermFrequency,
     Tokenizer,
     Trimmer,
+    log_tf,
 )
 from keystone_tpu.workflow import Dataset, Pipeline
+
 
 
 @dataclasses.dataclass
@@ -37,6 +38,7 @@ class Config:
     ls_lam: float = 1e-2
     num_classes: int = 4
     synthetic_n: int = 400
+    model_path: Optional[str] = None
 
 
 class NewsgroupsPipeline:
@@ -50,7 +52,7 @@ class NewsgroupsPipeline:
             .and_then(LowerCase())
             .and_then(Tokenizer())
             .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
-            .and_then(TermFrequency(lambda v: math.log(v + 1.0)))
+            .and_then(TermFrequency(log_tf))
             .and_then(CommonSparseFeatures(config.num_features), train_x)
         )
         if config.head == "nb":
@@ -68,6 +70,8 @@ class NewsgroupsPipeline:
 
     @staticmethod
     def run(config: Config) -> dict:
+        # train/test come from ONE load+split, so the load stays eager
+        # (the test half is always needed, even for saved-model runs)
         if config.data_path:
             data = NewsgroupsDataLoader.load(config.data_path)
             num_classes = int(data.labels.numpy().max()) + 1
@@ -80,8 +84,17 @@ class NewsgroupsPipeline:
             test = NewsgroupsDataLoader.synthetic(
                 config.synthetic_n // 4, config.num_classes, seed=2
             )
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
+
         t0 = time.time()
-        fitted = NewsgroupsPipeline.build(config, train.data, train.labels).fit().block_until_ready()
+        fitted, loaded = FittedPipeline.fit_or_load(
+            config.model_path,
+            lambda: NewsgroupsPipeline.build(config, train.data, train.labels),
+            config=fit_relevant_config(config),
+        )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(config.num_classes).evaluate(
@@ -90,6 +103,7 @@ class NewsgroupsPipeline:
         return {
             "pipeline": NewsgroupsPipeline.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
         }
@@ -101,12 +115,14 @@ def main(argv=None):
     p.add_argument("--num-features", type=int, default=100000)
     p.add_argument("--head", choices=["nb", "ls"], default="nb")
     p.add_argument("--synthetic-n", type=int, default=400)
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
     cfg = Config(
         data_path=a.data_path,
         num_features=a.num_features,
         head=a.head,
         synthetic_n=a.synthetic_n,
+        model_path=a.model_path,
     )
     print(NewsgroupsPipeline.run(cfg))
 

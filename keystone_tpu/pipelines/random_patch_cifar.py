@@ -51,6 +51,7 @@ class Config:
     zca_eps: float = 0.1
     seed: int = 0
     synthetic_n: int = 512
+    model_path: Optional[str] = None
 
 
 class RandomPatchCifar:
@@ -95,19 +96,35 @@ class RandomPatchCifar:
     @staticmethod
     def run(config: Config) -> dict:
         if config.train_path:
-            train = CifarLoader.load(config.train_path)
             test = CifarLoader.load(config.test_path or config.train_path)
         else:
-            train = CifarLoader.synthetic(config.synthetic_n, seed=1)
             test = CifarLoader.synthetic(config.synthetic_n // 4, seed=2)
+
+        def build():
+            # train loads ONLY when a fit is needed (saved-model runs skip it)
+            train = (
+                CifarLoader.load(config.train_path)
+                if config.train_path
+                else CifarLoader.synthetic(config.synthetic_n, seed=1)
+            )
+            return RandomPatchCifar.build(config, train.data, train.labels)
+
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
+
         t0 = time.time()
-        fitted = RandomPatchCifar.build(config, train.data, train.labels).fit().block_until_ready()
+        fitted, loaded = FittedPipeline.fit_or_load(
+            config.model_path, build, config=fit_relevant_config(config)
+        )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(NUM_CLASSES).evaluate(preds, test.labels)
         return {
             "pipeline": RandomPatchCifar.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
         }
@@ -120,6 +137,7 @@ def main(argv=None):
     p.add_argument("--num-filters", type=int, default=256)
     p.add_argument("--lam", type=float, default=1e-2)
     p.add_argument("--synthetic-n", type=int, default=512)
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
     cfg = Config(
         train_path=a.train_path,
@@ -127,6 +145,7 @@ def main(argv=None):
         num_filters=a.num_filters,
         lam=a.lam,
         synthetic_n=a.synthetic_n,
+        model_path=a.model_path,
     )
     print(RandomPatchCifar.run(cfg))
 

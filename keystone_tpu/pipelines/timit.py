@@ -37,6 +37,7 @@ class Config:
     num_classes: int = NUM_CLASSES
     seed: int = 0
     synthetic_n: int = 4096
+    model_path: Optional[str] = None
 
 
 class TimitPipeline:
@@ -75,24 +76,54 @@ class TimitPipeline:
 
     @staticmethod
     def run(config: Config) -> dict:
+        _train_cache = []
+
+        def _train():
+            # cached: the no-test-set path uses train as test AND build()
+            # needs it — one parse, not two
+            if not _train_cache:
+                if config.features_path:
+                    _train_cache.append(
+                        TimitFeaturesDataLoader.load(
+                            config.features_path, config.labels_path
+                        )
+                    )
+                else:
+                    _train_cache.append(
+                        TimitFeaturesDataLoader.synthetic(
+                            config.synthetic_n, config.num_classes, seed=1
+                        )
+                    )
+            return _train_cache[0]
+
         if config.features_path:
-            train = TimitFeaturesDataLoader.load(config.features_path, config.labels_path)
             test = (
                 TimitFeaturesDataLoader.load(
                     config.test_features_path, config.test_labels_path
                 )
                 if config.test_features_path
-                else train
+                else _train()
             )
         else:
-            train = TimitFeaturesDataLoader.synthetic(
-                config.synthetic_n, config.num_classes, seed=1
-            )
             test = TimitFeaturesDataLoader.synthetic(
                 config.synthetic_n // 4, config.num_classes, seed=2
             )
+
+        def build():
+            # train loads ONLY when a fit is needed (saved-model runs with
+            # a separate test set skip it)
+            train = _train()
+            return TimitPipeline.build(config, train.data, train.labels)
+
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            fit_relevant_config,
+        )
+
         t0 = time.time()
-        fitted = TimitPipeline.build(config, train.data, train.labels).fit().block_until_ready()
+        fitted, loaded = FittedPipeline.fit_or_load(
+            config.model_path, build, config=fit_relevant_config(config)
+        )
         fit_time = time.time() - t0
         preds = fitted(test.data).get()
         m = MulticlassClassifierEvaluator(config.num_classes).evaluate(
@@ -101,6 +132,7 @@ class TimitPipeline:
         return {
             "pipeline": TimitPipeline.name,
             "fit_seconds": fit_time,
+            "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
         }
@@ -115,6 +147,7 @@ def main(argv=None):
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--num-classes", type=int, default=NUM_CLASSES)
     p.add_argument("--synthetic-n", type=int, default=4096)
+    p.add_argument("--model-path")
     a = p.parse_args(argv)
     cfg = Config(
         features_path=a.features_path,
@@ -124,6 +157,7 @@ def main(argv=None):
         lam=a.lam,
         num_classes=a.num_classes,
         synthetic_n=a.synthetic_n,
+        model_path=a.model_path,
     )
     print(TimitPipeline.run(cfg))
 

@@ -269,14 +269,21 @@ class FittedPipeline(Pipeline):
             pickle.dump(self, f)
 
     @staticmethod
-    def load(path: str) -> "FittedPipeline":
+    def _load_raw(path: str):
+        """Unpickle ``path`` → (fitted, saved_config_or_None); accepts the
+        bare-pipeline and the fit_or_load {config, pipeline} formats."""
         with open(path, "rb") as f:
             obj = pickle.load(f)
-        if isinstance(obj, dict) and "pipeline" in obj:  # fit_or_load wrapper
-            obj = obj["pipeline"]
+        saved_cfg = None
+        if isinstance(obj, dict) and "pipeline" in obj:
+            saved_cfg, obj = obj.get("config"), obj["pipeline"]
         if not isinstance(obj, FittedPipeline):
             raise TypeError(f"{path} does not contain a FittedPipeline")
-        return obj
+        return obj, saved_cfg
+
+    @staticmethod
+    def load(path: str) -> "FittedPipeline":
+        return FittedPipeline._load_raw(path)[0]
 
     @staticmethod
     def fit_or_load(path, build_fn, config=None):
@@ -295,13 +302,7 @@ class FittedPipeline(Pipeline):
         import os
 
         if path and os.path.exists(path):
-            with open(path, "rb") as f:
-                obj = pickle.load(f)
-            saved_cfg = None
-            if isinstance(obj, dict) and "pipeline" in obj:
-                saved_cfg, obj = obj.get("config"), obj["pipeline"]
-            if not isinstance(obj, FittedPipeline):
-                raise TypeError(f"{path} does not contain a FittedPipeline")
+            obj, saved_cfg = FittedPipeline._load_raw(path)
             if config is not None and saved_cfg is not None and saved_cfg != config:
                 raise ValueError(
                     f"saved model at {path} was fitted with a different "
@@ -315,6 +316,33 @@ class FittedPipeline(Pipeline):
             with open(path, "wb") as f:
                 pickle.dump({"config": config, "pipeline": fitted}, f)
         return fitted, False
+
+
+def fit_relevant_config(config, exclude=()):
+    """App Config dataclass → dict of FIT-relevant fields for
+    ``fit_or_load``'s staleness check.
+
+    Eval-only knobs must not invalidate a saved model — fitting once and
+    scoring new test sets later is the feature's purpose — so fields that
+    only affect evaluation inputs are dropped: the model path itself,
+    test-set paths, and view-patch size.  Anything that changes the
+    FITTED ARTIFACT (featurizer params, solver params, train paths,
+    ImageNet's augmented_eval — which persists a scorer instead of a
+    classifier) stays.  ``exclude`` adds app-specific eval-only fields.
+    """
+    import dataclasses
+
+    d = dataclasses.asdict(config)
+    eval_only = {
+        "model_path",
+        "test_path",
+        "test_features_path",
+        "test_labels_path",
+        "view_patch",
+    } | set(exclude)
+    for k in eval_only:
+        d.pop(k, None)
+    return d
 
 
 class PipelineDataset:

@@ -451,3 +451,37 @@ def test_sift_multiscale_concatenates_per_scale_descriptors():
         np.concatenate([np.asarray(s3), np.asarray(s5)], axis=1),
         atol=1e-6,
     )
+
+
+def test_hashing_tf_stable_across_process_hash_seeds():
+    """Python's hash(str) is salted per process; HashingTF must not be,
+    or saved models score garbage in any other process (--model-path)."""
+    import os
+    import subprocess
+    import sys
+
+    from keystone_tpu.ops import HashingTF
+
+    tf = HashingTF(64)
+    here = np.asarray(tf.apply_one({"alpha": 1.0, ("bi", "gram"): 2.0}))
+    code = (
+        "import numpy as np\n"
+        "from keystone_tpu.ops import HashingTF\n"
+        "row = HashingTF(64).apply_one({'alpha': 1.0, ('bi', 'gram'): 2.0})\n"
+        "print(','.join(str(int(i)) for i in np.nonzero(np.asarray(row))[0]))\n"
+    )
+    env = dict(
+        os.environ,
+        PYTHONHASHSEED="12345",  # force a DIFFERENT salt
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    other = [int(i) for i in out.stdout.strip().split(",")]
+    assert sorted(np.nonzero(here)[0].tolist()) == sorted(other)

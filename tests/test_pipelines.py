@@ -141,6 +141,42 @@ def test_cli_list(capsys):
     assert "MnistRandomFFT" in out and "ImageNetSiftLcsFV" in out
 
 
+@pytest.mark.parametrize(
+    "app_cfg",
+    [
+        lambda mp: (MnistRandomFFT, MnistRandomFFT.Config(
+            num_ffts=2, synthetic_n=256, model_path=mp)),
+        lambda mp: (LinearPixels, LinearPixels.Config(
+            synthetic_n=256, model_path=mp)),
+        lambda mp: (TimitPipeline, TimitPipeline.Config(
+            synthetic_n=256, num_classes=8, num_cosine_features=512,
+            model_path=mp)),
+        lambda mp: (AmazonReviewsPipeline, AmazonReviewsPipeline.Config(
+            synthetic_n=200, model_path=mp)),
+        lambda mp: (NewsgroupsPipeline, NewsgroupsPipeline.Config(
+            synthetic_n=160, num_classes=3, model_path=mp)),
+        lambda mp: (RandomPatchCifar, RandomPatchCifar.Config(
+            synthetic_n=128, num_filters=32, block_size=256, model_path=mp)),
+        lambda mp: (VOCSIFTFisher, VOCSIFTFisher.Config(
+            synthetic_n=24, gmm_k=4, gmm_iters=3, pca_dims=8,
+            descriptor_samples_per_image=16, solver_block_size=128,
+            image_size=48, model_path=mp)),
+    ],
+)
+def test_model_path_roundtrip_across_apps(app_cfg, tmp_path):
+    """Every converted app: fit+save, then load-not-refit with equal
+    metrics (compared generically — apps report different metric keys)."""
+    app, cfg = app_cfg(str(tmp_path / "model.pkl"))
+    r1 = app.run(cfg)
+    assert r1["model_loaded"] is False
+    r2 = app.run(cfg)
+    assert r2["model_loaded"] is True
+    skip = ("fit_seconds", "model_loaded")
+    assert {k: v for k, v in r2.items() if k not in skip} == {
+        k: v for k, v in r1.items() if k not in skip
+    }
+
+
 def test_mnist_model_path_roundtrip(tmp_path):
     """--model-path: first run fits and saves; second run loads the
     fitted pipeline and only scores; a changed config refuses to reuse
