@@ -6,6 +6,7 @@ algebraic invariant is checked over randomized inputs.  Shapes are fixed
 per test (values vary) so each property compiles one XLA program.
 """
 
+import jax.numpy as jnp
 import numpy as np
 from hypothesis import assume, given, settings
 from hypothesis import strategies as st
@@ -13,13 +14,17 @@ from hypothesis.extra.numpy import arrays
 
 from keystone_tpu.ops import (
     ClassLabelIndicators,
+    Convolver,
+    GrayScaler,
     LinearRectifier,
     MaxClassifier,
     NormalizeRows,
     PaddedFFT,
+    Pooler,
     RandomSignNode,
     SignedHellingerMapper,
     StandardScaler,
+    SymmetricRectifier,
     TopKClassifier,
     VectorCombiner,
     VectorSplitter,
@@ -148,3 +153,60 @@ def test_rows_to_matrix_roundtrip(x):
     m = rows_to_matrix(rows)
     back = matrix_to_rows(m)
     np.testing.assert_array_equal(np.stack([np.asarray(r) for r in back]), x)
+
+
+images = arrays(np.float32, (3, 12, 12, 2), elements=floats)
+
+
+@given(images)
+@settings(**SETTINGS)
+def test_sum_pooler_conserves_total_when_tiling(x):
+    """Non-overlapping sum pooling that tiles the image exactly preserves
+    the total sum per image/channel."""
+    out = np.asarray(Pooler(stride=4, pool_size=4).apply_batch(x))
+    assert out.shape == (3, 3, 3, 2)
+    np.testing.assert_allclose(
+        out.sum(axis=(1, 2)), x.sum(axis=(1, 2)), rtol=1e-4, atol=1e-3
+    )
+
+
+@given(images)
+@settings(**SETTINGS)
+def test_max_pooler_bounded_by_extremes(x):
+    out = np.asarray(
+        Pooler(stride=4, pool_size=4, pool_mode="max").apply_batch(x)
+    )
+    assert (out <= x.max() + 1e-6).all() and (out >= x.min() - 1e-6).all()
+
+
+@given(images, st.floats(0, 2, width=32))
+@settings(**SETTINGS)
+def test_symmetric_rectifier_doubles_channels_nonnegative(x, alpha):
+    out = np.asarray(SymmetricRectifier(alpha=alpha).apply_batch(x))
+    assert out.shape == (3, 12, 12, 4)  # channel doubling
+    assert (out >= 0).all()
+    # pos and neg halves never both active past alpha at the same pixel
+    pos, neg = out[..., :2], out[..., 2:]
+    assert not np.logical_and(pos > alpha + 1e-6, neg > alpha + 1e-6).any()
+
+
+@given(images)
+@settings(**SETTINGS)
+def test_gray_scaler_is_channel_mean_within_range(x):
+    g = np.asarray(GrayScaler().apply_batch(x))
+    np.testing.assert_allclose(g, x.mean(axis=-1), rtol=1e-5, atol=1e-5)
+
+
+@given(
+    arrays(np.float32, (2, 10, 10, 1), elements=floats),
+    arrays(np.float32, (2, 10, 10, 1), elements=floats),
+    st.floats(-2, 2, width=32),
+)
+@settings(**SETTINGS)
+def test_convolver_is_linear(x, y, a):
+    rng = np.random.default_rng(0)
+    filters = rng.normal(size=(4, 3, 3, 1)).astype(np.float32)
+    conv = Convolver(jnp.asarray(filters))
+    lhs = np.asarray(conv.apply_batch(a * x + y))
+    rhs = a * np.asarray(conv.apply_batch(x)) + np.asarray(conv.apply_batch(y))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=1e-2)
