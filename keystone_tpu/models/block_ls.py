@@ -145,7 +145,56 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("BlockLeastSquaresEstimator requires labels")
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(data, StreamDataset):
+            return self.fit_stream_dataset(data, labels)
         return self._fit(data.array, labels.array, data.n)
+
+    def fit_stream_dataset(
+        self, data, labels, spill_dir=None, checkpoint_dir=None
+    ) -> BlockLinearMapper:
+        """Out-of-core fit: spill the streamed features to a block store
+        once, then sweep blocks from disk (the default path when a
+        StreamDataset reaches this estimator through the DAG).
+
+        The spill directory is deleted after a successful fit; on failure
+        it is left behind for inspection (a later retry re-spills, and
+        checkpoint fingerprints are content-based so resume still works)."""
+        import shutil
+
+        from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+        store = FeatureBlockStore.from_batches(
+            _spill_dir(spill_dir), data.batches(), data.n, self.block_size
+        )
+        fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
+        shutil.rmtree(store.directory, ignore_errors=True)
+        return fitted
+
+    def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
+        """Fit from an existing FeatureBlockStore (features never fully
+        resident in HBM; see _oc_bcd_fit)."""
+        from keystone_tpu.workflow.dataset import as_dataset
+
+        labels = as_dataset(labels)
+        if labels.n != store.n:
+            raise ValueError(f"labels n={labels.n} != store n={store.n}")
+        y = labels.array.astype(jnp.float32)
+        alpha = (jnp.arange(y.shape[0]) < store.n).astype(jnp.float32)
+        weights, xm, ym = _oc_bcd_fit(
+            store,
+            y,
+            alpha,
+            float(store.n),
+            self.lam,
+            self.num_iter,
+            self.fit_intercept,
+            checkpoint_dir=checkpoint_dir,
+        )
+        return finish_block_model(
+            weights, xm, ym, store.d, self.block_size, self.fit_intercept
+        )
 
     def fit_arrays(self, x, y=None):
         x = jnp.asarray(x)
@@ -168,18 +217,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         weights = _bcd_fit(
             blockify(xc, self.block_size), yc, nf, self.lam, self.num_iter
         )
-        if self.fit_intercept:
-            nb, bs, k = weights.shape
-            d = x.shape[1]
-            wflat = weights.reshape(nb * bs, k)[:d]
-            intercept = ym - xm @ wflat
-            pad = nb * bs - d
-            return BlockLinearMapper(
-                jnp.pad(wflat, ((0, pad), (0, 0))).reshape(nb, bs, k),
-                self.block_size,
-                intercept=intercept,
-            )
-        return BlockLinearMapper(weights, self.block_size)
+        return finish_block_model(
+            weights, xm, ym, x.shape[1], self.block_size, self.fit_intercept
+        )
 
     def fit_checkpointed(self, data, labels, checkpoint_dir: str):
         """Fit with per-epoch state checkpointing and resume.
@@ -245,17 +285,187 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             tmp = path + ".tmp.npz"  # np.savez appends .npz to bare names
             np.savez(tmp, epoch=e, w=np.asarray(w), p=np.asarray(p), problem=problem)
             os.replace(tmp, path)
-        if self.fit_intercept:
-            d = x.shape[1]
-            wflat = w.reshape(nb * bs, k)[:d]
-            intercept = ym - xm @ wflat
-            pad = nb * bs - d
-            return BlockLinearMapper(
-                jnp.pad(wflat, ((0, pad), (0, 0))).reshape(nb, bs, k),
-                self.block_size,
-                intercept=intercept,
+        return finish_block_model(
+            w, xm, ym, x.shape[1], self.block_size, self.fit_intercept
+        )
+
+
+def finish_block_model(weights, xm, ym, d, block_size, fit_intercept):
+    """Wrap fitted block weights into a BlockLinearMapper, computing the
+    intercept from the (weighted) means when centering was used."""
+    nb, bs, k = weights.shape
+    if not fit_intercept:
+        return BlockLinearMapper(weights, block_size)
+    wflat = weights.reshape(nb * bs, k)[:d]
+    intercept = ym - xm[:d] @ wflat
+    pad = nb * bs - d
+    return BlockLinearMapper(
+        jnp.pad(wflat, ((0, pad), (0, 0))).reshape(nb, bs, k),
+        block_size,
+        intercept=intercept,
+    )
+
+
+# --------------------------------------------------------------------------
+# Out-of-core block coordinate descent (features streamed from disk).
+#
+# The reference fits d≈200k-dim models by re-reading cached feature-block
+# RDDs per (epoch, block) (nodes/learning/BlockLeastSquares.scala,
+# SURVEY.md §3.2).  TPU analogue: blocks live in a FeatureBlockStore on
+# host disk; HBM holds ONE (n × bs) staged block, the (n × k) residual P,
+# labels, and the per-block weights — so the feature matrix can exceed
+# device memory arbitrarily.  Disk reads prefetch on a worker thread and
+# overlap the async-dispatched device step.
+#
+# One implementation serves both solvers: the unweighted case is the
+# weighted case with α_i = 1 on valid rows (class_weights with
+# mixture_weight=0), so `_oc_bcd_fit` is shared and the weighted math is
+# exactly block_weighted_ls._weighted_bcd_fit's.
+# --------------------------------------------------------------------------
+
+
+@jax.jit
+def _oc_wmean(alpha, a, wsum):
+    return (alpha @ a) / wsum
+
+
+@jax.jit
+def _oc_block_step(a_raw, xm_b, yc, sa, row_ok, p, wb, lam_n):
+    """One out-of-core BCD block update (compiled once, reused for every
+    (epoch, block) step — all blocks share one shape by construction)."""
+    a0 = (a_raw - xm_b) * row_ok[:, None]  # centered, padding re-zeroed
+    a0 = constrain(a0, DATA_AXIS, None)
+    a = a0 * sa[:, None]
+    target = (yc - p) * sa[:, None] + a @ wb
+    ata = sharded_gram(a)
+    atr = sharded_matmul(a, target, out_spec=P(None, MODEL_AXIS))
+    wb_new = solve_spd(ata, atr, reg=lam_n)
+    p_new = constrain(p + a0 @ (wb_new - wb), DATA_AXIS, MODEL_AXIS)
+    return wb_new, p_new
+
+
+def _oc_bcd_fit(
+    store,
+    y,
+    alpha,
+    n,
+    lam,
+    num_iter,
+    fit_intercept,
+    checkpoint_dir=None,
+    prefetch: int = 2,
+):
+    """Stream feature blocks from ``store`` through BCD sweeps.
+
+    ``y``: (n_rows, k) device labels, row-sharded; ``alpha``: (n_rows,)
+    per-example weights with zeros on padding rows.  Returns
+    ``(weights (nb, bs, k), xm (nb*bs,), ym (k,))``.
+
+    With ``checkpoint_dir``, each completed epoch saves (epoch, W, P) and
+    an interrupted fit resumes from the last epoch (fault-tolerance
+    analogue of Spark lineage recompute, SURVEY.md §5).
+    """
+    import os
+
+    import numpy as np
+
+    from keystone_tpu.parallel import mesh as _pmesh
+
+    nb, bs = store.num_blocks, store.block_size
+    n_rows, k = y.shape
+    wsum = jnp.sum(alpha)
+    sa = jnp.sqrt(alpha)
+    row_ok = (alpha > 0).astype(jnp.float32)
+
+    def stage(blk):
+        a = _pmesh.shard_batch(blk)
+        if a.shape[0] != n_rows:
+            raise ValueError(
+                f"store rows pad to {a.shape[0]} but labels have {n_rows}: "
+                "store.n must equal the label Dataset's n"
             )
-        return BlockLinearMapper(w, self.block_size)
+        return a
+
+    if fit_intercept:
+        xm_rows = [
+            _oc_wmean(alpha, stage(blk), wsum)
+            for _, blk in store.iter_blocks(range(nb), prefetch=prefetch)
+        ]
+        xm = jnp.stack(xm_rows)  # (nb, bs)
+        ym = _oc_wmean(alpha, y, wsum)
+    else:
+        xm = jnp.zeros((nb, bs), jnp.float32)
+        ym = jnp.zeros((k,), jnp.float32)
+    yc = (y - ym) * row_ok[:, None]
+
+    w = [jnp.zeros((bs, k), jnp.float32) for _ in range(nb)]
+    p = jnp.zeros_like(yc)
+    start = 0
+
+    ckpt_path = problem = None
+    if checkpoint_dir is not None:
+        import hashlib
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(checkpoint_dir, "oc_bcd_epoch.npz")
+        # Content-based problem fingerprint: resuming with different data,
+        # labels, weights (mixture), λ, or intercept setting must restart,
+        # while a re-spill of IDENTICAL data to a new temp dir must still
+        # resume — so hash content proxies, never the directory path.
+        fp = hashlib.sha256()
+        fp.update(
+            repr(
+                (store.n, store.d, bs, (n_rows, k), float(lam), n, bool(fit_intercept))
+            ).encode()
+        )
+        fp.update(np.asarray(store.read_block(0)[0]).tobytes())
+        fp.update(np.asarray(y[0]).tobytes())
+        fp.update(np.asarray(alpha[: min(n_rows, 64)]).tobytes())
+        problem = fp.hexdigest()
+        if os.path.exists(ckpt_path):
+            try:
+                with np.load(ckpt_path) as z:
+                    if str(z["problem"]) == problem:
+                        start = int(z["epoch"]) + 1
+                        w = [jnp.asarray(z["w"][b]) for b in range(nb)]
+                        p = _pmesh.shard_batch(np.asarray(z["p"]))[:n_rows]
+            except Exception:
+                start = 0  # unreadable checkpoint: fit from scratch
+
+    lam_n = jnp.float32(lam * n)
+    order = [b for _ in range(start, num_iter) for b in range(nb)]
+    epoch = start
+    for i, (b, blk) in enumerate(store.iter_blocks(order, prefetch=prefetch)):
+        w[b], p = _oc_block_step(stage(blk), xm[b], yc, sa, row_ok, p, w[b], lam_n)
+        if (i + 1) % nb == 0:
+            if ckpt_path is not None:
+                jax.block_until_ready(p)
+                tmp = ckpt_path + ".tmp.npz"
+                np.savez(
+                    tmp,
+                    epoch=epoch,
+                    w=np.stack([np.asarray(x) for x in w]),
+                    p=np.asarray(p),
+                    problem=problem,
+                )
+                os.replace(tmp, ckpt_path)
+            epoch += 1
+    weights = jnp.stack(w)
+    return weights, xm.reshape(-1), ym
+
+
+def _spill_dir(hint=None):
+    """A fresh directory for spilled feature blocks: the explicit hint,
+    else the PipelineEnv state dir, else the system temp dir."""
+    import os
+    import tempfile
+
+    from keystone_tpu.workflow.pipeline import PipelineEnv
+
+    base = hint or PipelineEnv.state_dir
+    if base is not None:
+        os.makedirs(base, exist_ok=True)
+    return tempfile.mkdtemp(prefix="kst_spill_", dir=base)
 
 
 def _bcd_epoch_body(xb, y, n, lam, carry):

@@ -78,7 +78,52 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("BlockWeightedLeastSquaresEstimator requires labels")
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(data, StreamDataset):
+            return self.fit_stream_dataset(data, labels)
         return self._fit(data.array, labels.array, data.n)
+
+    def fit_stream_dataset(
+        self, data, labels, spill_dir=None, checkpoint_dir=None
+    ) -> BlockLinearMapper:
+        """Out-of-core weighted fit: spill streamed features to a block
+        store, then sweep blocks from disk (see block_ls._oc_bcd_fit).
+        The spill directory is deleted after a successful fit."""
+        import shutil
+
+        from keystone_tpu.models.block_ls import _spill_dir
+        from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+        store = FeatureBlockStore.from_batches(
+            _spill_dir(spill_dir), data.batches(), data.n, self.block_size
+        )
+        fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
+        shutil.rmtree(store.directory, ignore_errors=True)
+        return fitted
+
+    def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
+        from keystone_tpu.models.block_ls import _oc_bcd_fit, finish_block_model
+        from keystone_tpu.workflow.dataset import as_dataset
+
+        labels = as_dataset(labels)
+        if labels.n != store.n:
+            raise ValueError(f"labels n={labels.n} != store n={store.n}")
+        y = labels.array.astype(jnp.float32)
+        alpha = class_weights(y, jnp.float32(store.n), self.mixture_weight)
+        weights, xm, ym = _oc_bcd_fit(
+            store,
+            y,
+            alpha,
+            float(store.n),
+            self.lam,
+            self.num_iter,
+            self.fit_intercept,
+            checkpoint_dir=checkpoint_dir,
+        )
+        return finish_block_model(
+            weights, xm, ym, store.d, self.block_size, self.fit_intercept
+        )
 
     def fit_arrays(self, x, y=None):
         x = jnp.asarray(x)
@@ -93,20 +138,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             x, y, alpha, nf, self.lam, self.num_iter, self.block_size,
             self.fit_intercept,
         )
-        nb = weights.shape[0]
-        bs = weights.shape[1]
-        k = weights.shape[2]
-        if self.fit_intercept:
-            d = x.shape[1]
-            wflat = weights.reshape(nb * bs, k)[:d]
-            intercept = ym - xm @ wflat
-            pad = nb * bs - d
-            return BlockLinearMapper(
-                jnp.pad(wflat, ((0, pad), (0, 0))).reshape(nb, bs, k),
-                self.block_size,
-                intercept=intercept,
-            )
-        return BlockLinearMapper(weights, self.block_size)
+        from keystone_tpu.models.block_ls import finish_block_model
+
+        return finish_block_model(
+            weights, xm, ym, x.shape[1], self.block_size, self.fit_intercept
+        )
 
 
 @partial(jax.jit, static_argnames=("num_iter", "block_size", "fit_intercept"))

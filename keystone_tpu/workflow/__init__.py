@@ -1,7 +1,12 @@
 """Workflow core: Transformer/Estimator/Pipeline DSL, DAG, executor,
 whole-pipeline optimizer (reference src/main/scala/workflow/, SURVEY.md §2.1)."""
 
-from keystone_tpu.workflow.dataset import Dataset, as_dataset  # noqa: F401
+from keystone_tpu.workflow.dataset import (  # noqa: F401
+    Dataset,
+    StreamDataset,
+    as_dataset,
+)
+from keystone_tpu.workflow.blockstore import FeatureBlockStore  # noqa: F401
 from keystone_tpu.workflow.transformer import (  # noqa: F401
     Cacher,
     Identity,

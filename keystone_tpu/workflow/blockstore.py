@@ -1,0 +1,178 @@
+"""Disk-backed feature-block store for out-of-core block solvers.
+
+The reference fits d≈200k-dim Fisher-vector models by caching feature
+blocks as RDDs (spilled to executor disk/memory) and re-reading them per
+(epoch, block) during block coordinate descent
+(nodes/learning/BlockLeastSquares.scala per SURVEY.md §3.2).  On TPU the
+analogue is this store: features are written once, blockified on disk as
+one ``.npy`` memmap per feature block, and re-streamed per sweep so HBM
+only ever holds ONE (n × block_size) block plus the (n × k) residual —
+the feature matrix itself can exceed device memory by an arbitrary
+factor.
+
+Layout of a store directory::
+
+    meta.json                {"n": ..., "d": ..., "block_size": ..., "nb": ...}
+    block_0000.npy           float32 (n, block_size)
+    block_0001.npy           ...
+
+The final block is zero-padded on columns to ``block_size`` (the
+VectorSplitter convention, nodes/util/VectorSplitter.scala), which keeps
+every device transfer and every compiled block-step identical in shape —
+one XLA program serves all (epoch, block) steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+_META = "meta.json"
+
+
+class FeatureBlockStore:
+    """Blockified (n, d) float32 feature matrix on disk.
+
+    Create with :meth:`create` + :meth:`append_rows` (streaming writes),
+    or the :meth:`from_array` / :meth:`from_batches` conveniences.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        with open(os.path.join(directory, _META)) as f:
+            meta = json.load(f)
+        self.n = int(meta["n"])
+        self.d = int(meta["d"])
+        self.block_size = int(meta["block_size"])
+        self.num_blocks = int(meta["nb"])
+
+    # ------------------------------------------------------------ create
+    @classmethod
+    def create(cls, directory: str, n: int, d: int, block_size: int):
+        """Allocate an empty store; fill it with :meth:`append_rows`."""
+        os.makedirs(directory, exist_ok=True)
+        nb = -(-d // block_size)
+        meta = {"n": int(n), "d": int(d), "block_size": int(block_size), "nb": nb}
+        with open(os.path.join(directory, _META), "w") as f:
+            json.dump(meta, f)
+        for b in range(nb):
+            mm = np.lib.format.open_memmap(
+                cls._block_path(directory, b),
+                mode="w+",
+                dtype=np.float32,
+                shape=(n, block_size),
+            )
+            del mm  # flushed zero-initialized file
+        store = cls(directory)
+        store._cursor = 0
+        return store
+
+    @staticmethod
+    def _block_path(directory: str, b: int) -> str:
+        return os.path.join(directory, f"block_{b:04d}.npy")
+
+    def append_rows(self, x: np.ndarray) -> None:
+        """Write the next ``x.shape[0]`` rows of the (n, d) matrix."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.d:
+            raise ValueError(f"expected (m, {self.d}) rows, got {x.shape}")
+        start = getattr(self, "_cursor", 0)
+        stop = start + x.shape[0]
+        if stop > self.n:
+            raise ValueError(f"store holds {self.n} rows; write would reach {stop}")
+        bs = self.block_size
+        for b in range(self.num_blocks):
+            mm = np.lib.format.open_memmap(
+                self._block_path(self.directory, b), mode="r+"
+            )
+            chunk = x[:, b * bs : (b + 1) * bs]
+            if chunk.shape[1] < bs:  # final ragged block: zero-pad columns
+                chunk = np.pad(chunk, ((0, 0), (0, bs - chunk.shape[1])))
+            mm[start:stop] = chunk
+            del mm
+        self._cursor = stop
+
+    @classmethod
+    def from_array(cls, directory: str, x, block_size: int):
+        x = np.asarray(x, np.float32)
+        store = cls.create(directory, x.shape[0], x.shape[1], block_size)
+        store.append_rows(x)
+        return store
+
+    @classmethod
+    def from_batches(
+        cls, directory: str, batches: Iterable[np.ndarray], n: int, block_size: int
+    ):
+        """Build from a stream of (m_i, d) host batches (Σ m_i == n)."""
+        store = None
+        for batch in batches:
+            batch = np.asarray(batch, np.float32)
+            if store is None:
+                store = cls.create(directory, n, batch.shape[1], block_size)
+            store.append_rows(batch)
+        if store is None:
+            raise ValueError("empty batch stream")
+        if store._cursor != n:
+            raise ValueError(
+                f"batch stream produced {store._cursor} rows, expected {n}"
+            )
+        return store
+
+    # -------------------------------------------------------------- read
+    def read_block(self, b: int) -> np.ndarray:
+        """One (n, block_size) block, as an in-memory host array."""
+        return np.array(np.load(self._block_path(self.directory, b), mmap_mode="r"))
+
+    def iter_blocks(
+        self, order: Sequence[int], prefetch: int = 2
+    ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield ``(b, block)`` for each index in ``order``, reading ahead
+        on a worker thread so disk IO overlaps the consumer's device work
+        (the role the reference delegates to Spark's block manager)."""
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, int(prefetch)))
+        sentinel = object()
+        stop = threading.Event()
+        err: list = []
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer abandoned the
+            # generator — otherwise the thread would park forever on a
+            # full queue, pinning GB-scale host blocks
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for b in order:
+                    if stop.is_set() or not put((b, self.read_block(b))):
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                put(sentinel)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+
+    def nbytes(self) -> int:
+        return self.n * self.num_blocks * self.block_size * 4

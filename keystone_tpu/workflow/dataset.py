@@ -116,6 +116,143 @@ class Dataset:
         return f"Dataset(shape={tuple(self.array.shape)}, n={self.n})"
 
 
+class StreamDataset(Dataset):
+    """A lazily-evaluated, re-iterable stream of host batches — the
+    out-of-core path through the Pipeline DAG.
+
+    The reference streams data through RDD partition iterators so no
+    executor ever holds the full dataset (SURVEY.md §2.9); this is the
+    TPU analogue: transformers map over the stream batch-by-batch
+    (upload → one compiled apply → stay on device for the next map), and
+    the block solvers spill the resulting features to a
+    :class:`~keystone_tpu.workflow.blockstore.FeatureBlockStore` and fit
+    out-of-core, so the feature matrix never needs to fit in HBM.
+
+    ``source``: a callable returning an iterator of host batches (or a
+    re-iterable).  Each batch is a ``(m_i, ...)`` array or an
+    ``(array, mask)`` pair for ragged payloads.  ``n`` — total rows.
+
+    Estimators without a streaming fit path fall back to
+    :attr:`array`, which materializes the whole stream into device
+    memory (with a warning) — correctness is preserved everywhere, the
+    out-of-core guarantee only where implemented.
+    """
+
+    def __init__(self, source, n: int, name: Optional[str] = None):
+        self.name = name
+        self.n = int(n)
+        self._host = None
+        self._array = None
+        self.mask = None
+        if not callable(source) and iter(source) is source:
+            # A one-shot iterator would be shared (and interleaved!) by
+            # fan-out consumers — e.g. the two branches of a Gather.
+            raise ValueError(
+                "StreamDataset source must be re-iterable: pass a callable "
+                "returning a fresh iterator (or a list of batches), not a "
+                "one-shot generator/iterator"
+            )
+
+        def gen():
+            src = source() if callable(source) else iter(source)
+            for batch in src:
+                arr, mask = batch if isinstance(batch, tuple) else (batch, None)
+                yield jnp.asarray(arr), (None if mask is None else jnp.asarray(mask))
+
+        self._gen = gen
+
+    @classmethod
+    def _wrap(cls, gen, n: int, name: Optional[str] = None) -> "StreamDataset":
+        d = cls.__new__(cls)
+        d.name = name
+        d.n = int(n)
+        d._host = None
+        d._array = None
+        d.mask = None
+        d._gen = gen
+        return d
+
+    # --------------------------------------------------------- streaming
+    def device_batches(self):
+        """Iterate ``(array, mask_or_None)`` device batches."""
+        return self._gen()
+
+    def batches(self):
+        """Iterate host (numpy) batches of the mapped values."""
+        for arr, _ in self._gen():
+            yield np.asarray(arr)
+
+    def map_batches(self, fn) -> "StreamDataset":
+        """Lazily compose a per-batch device function ``fn(arr, mask)``
+        (returning an array or an (array, mask) pair) over the stream."""
+        parent = self._gen
+
+        def gen():
+            for arr, mask in parent():
+                out = fn(arr, mask)
+                if isinstance(out, tuple):
+                    yield out
+                else:
+                    yield out, None
+
+        return StreamDataset._wrap(gen, self.n)
+
+    @staticmethod
+    def zip_concat(streams: Sequence["StreamDataset"]) -> "StreamDataset":
+        """Gather analogue for streams: zip batches, concat on the last
+        axis.  All streams must share batch structure (in pipelines they
+        are branches mapped over ONE source, so they do by construction)."""
+        ns = {s.n for s in streams}
+        if len(ns) != 1:
+            raise ValueError(f"gathered streams disagree on n: {sorted(ns)}")
+        gens = [s._gen for s in streams]
+
+        def gen():
+            for parts in zip(*(g() for g in gens), strict=True):
+                arrs = [a for a, _ in parts]
+                yield jnp.concatenate(arrs, axis=-1), None
+
+        return StreamDataset._wrap(gen, streams[0].n)
+
+    # -------------------------------------------------- Dataset protocol
+    @property
+    def array(self) -> jnp.ndarray:
+        """Materialize the stream into one sharded device array (escape
+        hatch for consumers without a streaming path; defeats out-of-core)."""
+        if self._array is None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "materializing StreamDataset (n=%d) into device memory; "
+                "this consumer has no out-of-core path",
+                self.n,
+            )
+            parts = []
+            masks = []
+            for arr, mask in self._gen():
+                parts.append(np.asarray(arr))
+                if mask is not None:
+                    masks.append(np.asarray(mask))
+            arr = np.concatenate(parts, axis=0)
+            self._array = _mesh.shard_batch(arr)
+            if masks:
+                self.mask = _mesh.shard_batch(np.concatenate(masks, axis=0))
+        return self._array
+
+    @property
+    def items(self) -> list:
+        self.array
+        return [np.asarray(self._array[i]) for i in range(self.n)]
+
+    def cache(self) -> "StreamDataset":
+        # A Cacher inserted by the optimizer must NOT collapse the stream
+        # into memory — out-of-core is the point.  No-op.
+        return self
+
+    def __repr__(self):
+        return f"StreamDataset(n={self.n})"
+
+
 def _all_arrays(seq) -> bool:
     return len(seq) > 0 and all(
         isinstance(x, (np.ndarray, jnp.ndarray)) and hasattr(x, "shape") for x in seq

@@ -151,7 +151,16 @@ def _apply_transformer(t: Transformer, deps):
 def _gather(deps):
     import jax.numpy as jnp
 
+    from keystone_tpu.workflow.dataset import StreamDataset
+
     if all(isinstance(d, DatasetExpr) for d in deps):
+        if any(isinstance(d.dataset, StreamDataset) for d in deps):
+            if not all(isinstance(d.dataset, StreamDataset) for d in deps):
+                raise TypeError(
+                    "Gather mixes streaming and materialized branches; "
+                    "the branches of one source are either all streams or none"
+                )
+            return DatasetExpr(StreamDataset.zip_concat([d.dataset for d in deps]))
         base = deps[0].dataset
         arrs = [d.dataset.array for d in deps]
         return DatasetExpr(base.with_array(jnp.concatenate(arrs, axis=-1)))

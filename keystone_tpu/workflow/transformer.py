@@ -65,6 +65,15 @@ class Transformer(Chainable):
         return jax.vmap(self.apply_one)(xs)
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(ds, StreamDataset):
+            if self.is_host:
+                raise TypeError(
+                    f"{self.label} is a host transformer; streams carry device "
+                    "batches. Featurize to arrays before streaming."
+                )
+            return ds.map_batches(self._apply_batch_jitted)
         if ds.is_host or self.is_host:
             out = [self.apply_one(x) for x in ds.items]
             if out and isinstance(out[0], (jnp.ndarray,)) or _stackable(out):

@@ -1,0 +1,218 @@
+"""Out-of-core block solvers: FeatureBlockStore + StreamDataset + OC BCD.
+
+The correctness pattern is the reference's own (SURVEY.md §4): the
+out-of-core solver must match the in-memory solve on the same data to
+tight tolerance — the disk tier changes WHERE blocks live, not the math.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.models import (
+    BlockLeastSquaresEstimator,
+    BlockWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.workflow import Dataset, FeatureBlockStore, StreamDataset
+from keystone_tpu.workflow import Pipeline, transformer
+
+
+def _problem(n=96, d=37, k=5, seed=0, skew=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    if skew:  # imbalanced classes so the weighted path is non-trivial
+        probs = np.array([0.6, 0.2, 0.1, 0.06, 0.04])[:k]
+        probs = probs / probs.sum()
+        lbl = rng.choice(k, size=n, p=probs)
+    else:
+        lbl = rng.integers(0, k, size=n)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lbl] = 1.0
+    return x, y, lbl
+
+
+# ------------------------------------------------------------------ store
+
+
+def test_store_roundtrip(tmp_path):
+    x = np.arange(60, dtype=np.float32).reshape(10, 6)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=4)
+    assert store.num_blocks == 2 and store.n == 10 and store.d == 6
+    b0 = store.read_block(0)
+    b1 = store.read_block(1)
+    np.testing.assert_array_equal(b0, x[:, :4])
+    np.testing.assert_array_equal(b1[:, :2], x[:, 4:])
+    np.testing.assert_array_equal(b1[:, 2:], 0)  # column padding
+
+
+def test_store_from_batches_matches_from_array(tmp_path):
+    x = np.random.default_rng(1).normal(size=(23, 9)).astype(np.float32)
+    s1 = FeatureBlockStore.from_array(str(tmp_path / "a"), x, block_size=4)
+    batches = [x[:7], x[7:15], x[15:]]
+    s2 = FeatureBlockStore.from_batches(str(tmp_path / "b"), batches, 23, 4)
+    for b in range(s1.num_blocks):
+        np.testing.assert_array_equal(s1.read_block(b), s2.read_block(b))
+
+
+def test_store_row_count_mismatch(tmp_path):
+    with pytest.raises(ValueError, match="produced"):
+        FeatureBlockStore.from_batches(
+            str(tmp_path / "c"), [np.zeros((3, 4), np.float32)], 5, 2
+        )
+
+
+def test_store_prefetch_order(tmp_path):
+    x = np.random.default_rng(2).normal(size=(8, 12)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "d"), x, block_size=4)
+    order = [0, 1, 2, 0, 1, 2]
+    seen = [(b, blk.copy()) for b, blk in store.iter_blocks(order)]
+    assert [b for b, _ in seen] == order
+    for b, blk in seen:
+        np.testing.assert_array_equal(blk, store.read_block(b))
+
+
+# ------------------------------------------------- OC solver == in-memory
+
+
+@pytest.mark.parametrize("fit_intercept", [True, False])
+def test_oc_unweighted_matches_inmemory(tmp_path, fit_intercept):
+    x, y, _ = _problem()
+    est = BlockLeastSquaresEstimator(
+        block_size=16, num_iter=3, lam=1e-2, fit_intercept=fit_intercept
+    )
+    ref = est.fit_arrays(x, y)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    oc = est.fit_store(store, Dataset(y, n=y.shape[0]))
+    np.testing.assert_allclose(
+        np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-4
+    )
+    if fit_intercept:
+        np.testing.assert_allclose(
+            np.asarray(oc.intercept), np.asarray(ref.intercept), atol=2e-4
+        )
+
+
+def test_oc_weighted_matches_inmemory(tmp_path):
+    x, y, _ = _problem(skew=True)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=16, num_iter=3, lam=1e-2, mixture_weight=0.5
+    )
+    ref = est.fit_arrays(x, y)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    oc = est.fit_store(store, Dataset(y, n=y.shape[0]))
+    np.testing.assert_allclose(
+        np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(oc.intercept), np.asarray(ref.intercept), atol=2e-4
+    )
+
+
+def test_oc_checkpoint_resume(tmp_path):
+    """A fit interrupted between epochs resumes and matches the straight
+    run — the coarse fault-tolerance story (SURVEY.md §5)."""
+    x, y, _ = _problem(seed=3)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    ckpt = str(tmp_path / "ckpt")
+    labels = Dataset(y, n=y.shape[0])
+    # run only 2 of 4 epochs (simulated interruption), then resume to 4
+    partial = BlockWeightedLeastSquaresEstimator(block_size=16, num_iter=2, lam=1e-2)
+    partial.fit_store(store, labels, checkpoint_dir=ckpt)
+    full = BlockWeightedLeastSquaresEstimator(block_size=16, num_iter=4, lam=1e-2)
+    resumed = full.fit_store(store, labels, checkpoint_dir=ckpt)
+    straight = full.fit_store(store, labels)  # no checkpoint
+    np.testing.assert_allclose(
+        np.asarray(resumed.flat_weights),
+        np.asarray(straight.flat_weights),
+        atol=2e-4,
+    )
+
+
+# --------------------------------------------------- StreamDataset in DAG
+
+
+def test_stream_through_pipeline_dag(tmp_path):
+    """A StreamDataset flows through transformers and the block solver
+    fits out-of-core — the DEFAULT path, not a side API."""
+    x, y, lbl = _problem(n=128, d=40, k=4)
+    batches = lambda: iter([x[i : i + 32] for i in range(0, 128, 32)])
+    stream = StreamDataset(batches, n=128)
+    scale = transformer(lambda v: v * 0.5, name="Half")
+    est = BlockLeastSquaresEstimator(block_size=16, num_iter=3, lam=1e-3)
+    pipe = Pipeline.of(scale).and_then(est, stream, Dataset(y, n=128))
+    fitted = pipe.fit()
+    pred = fitted(Dataset(x, n=128)).get().numpy()
+    # reference: in-memory fit on the same (scaled) features
+    ref = est.fit_arrays(x * 0.5, y)
+    ref_pred = np.asarray(ref.apply_batch(jnp.asarray(x * 0.5)))
+    np.testing.assert_allclose(pred, ref_pred[:128], atol=5e-4)
+
+
+def test_stream_gather_two_branches():
+    """Gather over stream branches zips and concats per batch."""
+    x = np.random.default_rng(5).normal(size=(20, 6)).astype(np.float32)
+    stream = StreamDataset(lambda: iter([x[:8], x[8:20]]), n=20)
+    a = stream.map_batches(lambda v, m: v * 2.0)
+    b = stream.map_batches(lambda v, m: v + 1.0)
+    gathered = StreamDataset.zip_concat([a, b])
+    out = np.concatenate(list(gathered.batches()), axis=0)
+    np.testing.assert_allclose(out, np.concatenate([x * 2, x + 1], axis=-1), rtol=1e-6)
+
+
+def test_stream_materialize_fallback():
+    """Consumers without a streaming path still work via .array."""
+    x = np.random.default_rng(6).normal(size=(10, 4)).astype(np.float32)
+    stream = StreamDataset(lambda: iter([x[:4], x[4:]]), n=10)
+    np.testing.assert_allclose(stream.numpy(), x, rtol=1e-6)
+
+
+def test_oc_checkpoint_fingerprint_sensitive(tmp_path):
+    """A checkpoint from different hyperparameters must not be resumed:
+    changing mixture_weight (or labels, λ, ...) restarts the fit."""
+    x, y, _ = _problem(seed=7, skew=True)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    labels = Dataset(y, n=y.shape[0])
+    ckpt = str(tmp_path / "ckpt")
+    a = BlockWeightedLeastSquaresEstimator(
+        block_size=16, num_iter=2, lam=1e-2, mixture_weight=0.5
+    )
+    a.fit_store(store, labels, checkpoint_dir=ckpt)  # leaves epoch-1 state
+    b = BlockWeightedLeastSquaresEstimator(
+        block_size=16, num_iter=2, lam=1e-2, mixture_weight=0.9
+    )
+    stale_aware = b.fit_store(store, labels, checkpoint_dir=ckpt)
+    fresh = b.fit_store(store, labels)
+    np.testing.assert_allclose(
+        np.asarray(stale_aware.flat_weights),
+        np.asarray(fresh.flat_weights),
+        atol=2e-4,
+    )
+
+
+def test_stream_fit_cleans_spill(tmp_path):
+    x, y, _ = _problem(n=64, d=24, k=3)
+    stream = StreamDataset(lambda: iter([x[:32], x[32:]]), n=64)
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=2, lam=1e-3)
+    est.fit_stream_dataset(stream, Dataset(y, n=64), spill_dir=str(tmp_path / "sp"))
+    import os
+
+    leftovers = [
+        p for p in os.listdir(tmp_path / "sp") if p.startswith("kst_spill_")
+    ]
+    assert leftovers == []
+
+
+def test_stream_rejects_one_shot_iterator():
+    gen = (np.zeros((2, 3), np.float32) for _ in range(2))
+    with pytest.raises(ValueError, match="re-iterable"):
+        StreamDataset(gen, n=4)
+
+
+def test_stream_host_transformer_rejected():
+    from keystone_tpu.workflow.transformer import LambdaTransformer
+
+    stream = StreamDataset(lambda: iter([np.zeros((2, 3), np.float32)]), n=2)
+    host_t = LambdaTransformer(lambda s: s, name="HostOp", host=True)
+    with pytest.raises(TypeError, match="host transformer"):
+        host_t.apply_dataset(stream)
