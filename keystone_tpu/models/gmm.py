@@ -27,6 +27,7 @@ from keystone_tpu.parallel.mesh import DATA_AXIS
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import Estimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils.precision import sdot
 
 _LOG2PI = 1.8378770664093453
 
@@ -136,8 +137,8 @@ def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var):
         r = jnp.exp(lr) * row_ok[:, None]  # (n, K)
         nk = constrain(jnp.sum(r, axis=0))  # psum over 'data'
         nk = jnp.maximum(nk, 1e-10)
-        mu_new = constrain(r.T @ x) / nk[:, None]
-        ex2 = constrain(r.T @ (x * x)) / nk[:, None]
+        mu_new = constrain(sdot(r.T, x)) / nk[:, None]
+        ex2 = constrain(sdot(r.T, x * x)) / nk[:, None]
         var_new = jnp.maximum(ex2 - mu_new * mu_new, min_var)
         w_new = nk / n
         return (w_new, mu_new, var_new), None

@@ -29,6 +29,7 @@ from keystone_tpu.parallel.mesh import DATA_AXIS
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import LabelEstimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils.precision import sdot
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,11 +38,20 @@ class GaussianKernelGenerator:
     (KernelGenerator.scala § GaussianKernelGenerator)."""
 
     gamma: float
+    #: solver-grade (true f32) MXU passes for the distance gemm.  True
+    #: during fits — the kernel values enter the block solves — but
+    #: predict-time generators use default precision: inference has no
+    #: downstream solve and the full-precision passes cost ~2×.
+    solver_grade: bool = True
 
     def __call__(self, x: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
         xn = jnp.sum(x * x, axis=1, keepdims=True)
         zn = jnp.sum(z * z, axis=1)
-        sq = jnp.maximum(xn - 2.0 * (x @ z.T) + zn, 0.0)
+        if self.solver_grade:
+            cross = sdot(x, z.T)
+        else:
+            cross = jnp.matmul(x, z.T, preferred_element_type=jnp.float32)
+        sq = jnp.maximum(xn - 2.0 * cross + zn, 0.0)
         return jnp.exp(-self.gamma * sq)
 
 
@@ -146,7 +156,7 @@ def _krr_fit(x, y, n, gamma, lam, bs, num_epochs):
 
 @partial(jax.jit, static_argnames=("bs",))
 def _krr_predict(xs, train_x, alpha, gamma, bs):
-    kern = GaussianKernelGenerator(gamma)
+    kern = GaussianKernelGenerator(gamma, solver_grade=False)
     n_rows = train_x.shape[0]
     nb = n_rows // bs
     out0 = jnp.zeros((xs.shape[0], alpha.shape[1]), jnp.float32)

@@ -27,6 +27,7 @@ from keystone_tpu.parallel.mesh import DATA_AXIS
 from keystone_tpu.models.common import constrain
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import LabelEstimator
+from keystone_tpu.utils.precision import sdot
 
 
 def lbfgs_minimize(
@@ -207,7 +208,7 @@ def _lbfgs_least_squares(x, y, n, lam, num_iterations, history, fit_intercept):
     def value_and_grad(w):
         r = x @ w - y  # (n_rows, k), row-sharded; pad rows are zero
         f = 0.5 * jnp.vdot(r, r) / n + 0.5 * lam * jnp.vdot(w, w)
-        g = constrain(x.T @ r) / n + lam * w
+        g = constrain(sdot(x.T, r)) / n + lam * w
         return f, g
 
     w0 = jnp.zeros((x.shape[1], y.shape[1]), jnp.float32)

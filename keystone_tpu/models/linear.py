@@ -27,6 +27,7 @@ from keystone_tpu.models.common import (
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import LabelEstimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils.precision import sdot
 
 
 class LinearMapper(Transformer):
@@ -197,7 +198,7 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         ym = jnp.mean(y, axis=0)
         xc, yc = x - xm, y - ym
         if self.lam > 0.0:
-            w = solve_spd(xc.T @ xc, xc.T @ yc, reg=self.lam * x.shape[0])
+            w = solve_spd(sdot(xc.T, xc), sdot(xc.T, yc), reg=self.lam * x.shape[0])
         else:
             w = jnp.linalg.lstsq(xc, yc)[0]
         return LinearMapper(w, ym - xm @ w)

@@ -23,6 +23,7 @@ from keystone_tpu.parallel.mesh import DATA_AXIS
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import Estimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils.precision import fcast, sdot
 
 
 class PCATransformer(Transformer):
@@ -35,7 +36,8 @@ class PCATransformer(Transformer):
     def apply_batch(self, xs, mask=None):
         if self.mean is not None:
             xs = xs - self.mean
-        out = xs @ self.components
+        xs_c, comp_c = fcast(xs, self.components)
+        out = jnp.matmul(xs_c, comp_c, preferred_element_type=jnp.float32)
         return (out, mask) if mask is not None else out
 
     def apply_one(self, x):
@@ -106,7 +108,7 @@ def _pca_cov_fit(x, n, dims, center):
     if center:
         row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)[:, None]
         x = (x - mean) * row_ok
-    cov = constrain(x.T @ x) / n  # treeReduce analogue
+    cov = constrain(sdot(x.T, x)) / n  # treeReduce analogue
     evals, evecs = jnp.linalg.eigh(cov)
     comp = evecs[:, ::-1][:, :dims]  # descending eigenvalue order
     return comp, mean
@@ -118,6 +120,6 @@ def _pca_masked(x, valid, dims, center):
     n = jnp.maximum(jnp.sum(w), 1.0)
     mean = (w @ x) / n
     xc = (x - mean) * w[:, None] if center else x * w[:, None]
-    cov = (xc.T @ xc) / n
+    cov = sdot(xc.T, xc) / n
     evals, evecs = jnp.linalg.eigh(cov)
     return evecs[:, ::-1][:, :dims], mean

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import Estimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils.precision import sdot
 
 
 class ZCAWhitener(Transformer):
@@ -52,7 +53,7 @@ def _zca_fit(x, n, eps):
     mean = jnp.sum(x, axis=0) / n
     row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)[:, None]
     xc = (x - mean) * row_ok
-    cov = xc.T @ xc / n
+    cov = sdot(xc.T, xc) / n
     evals, evecs = jnp.linalg.eigh(cov)
     inv_sqrt = 1.0 / jnp.sqrt(jnp.maximum(evals, 0.0) + eps)
     whitener = (evecs * inv_sqrt) @ evecs.T

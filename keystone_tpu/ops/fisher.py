@@ -28,6 +28,7 @@ from keystone_tpu.models.gmm import GaussianMixtureModel, GaussianMixtureModelEs
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.estimator import Estimator
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils import precision
 
 
 class FisherVector(Transformer):
@@ -82,7 +83,12 @@ class FisherVector(Transformer):
             from keystone_tpu.ops.fisher_pallas import fisher_encode_pallas
 
             out = fisher_encode_pallas(
-                xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
+                xs,
+                mask,
+                self.gmm.weights,
+                self.gmm.means,
+                self.gmm.variances,
+                mxu=precision.matmul_mode(),
             )
         else:
             out = _fisher_encode(
@@ -121,7 +127,15 @@ class GMMFisherVectorEstimator(Estimator):
 
 @jax.jit
 def _fisher_encode(xs, mask, w, mu, var):
-    """xs: (n, T, d); mask: (n, T); w: (K,); mu, var: (K, d)."""
+    """xs: (n, T, d); mask: (n, T); w: (K,); mu, var: (K, d).
+
+    Deliberately NOT under the bf16 matmul policy: the sufficient-statistic
+    einsums contract only over T and are OUTPUT-bound ((n, K, d) stays f32
+    either way), so bf16 input casts add materialization traffic without
+    shrinking the dominant stream — measured 0.64× at K=256, T=512 on
+    v5 lite.  The Pallas path gets its bf16 win at the HBM boundary
+    instead (ops/fisher_pallas.py).
+    """
     sigma = jnp.sqrt(var)  # (K, d)
     # responsibilities, batched over images
     from keystone_tpu.models.gmm import _log_gaussians
@@ -137,8 +151,10 @@ def _fisher_encode(xs, mask, w, mu, var):
     # standardized descriptors per component: (x − μ_k)/σ_k
     # Σ_t γ_tk x_t  and  Σ_t γ_tk x_t²  via einsum (MXU), then recombine
     s0 = jnp.einsum("ntk->nk", gamma)  # (n, K)
-    s1 = jnp.einsum("ntk,ntd->nkd", gamma, xs)
-    s2 = jnp.einsum("ntk,ntd->nkd", gamma, xs * xs)
+    s1 = jnp.einsum("ntk,ntd->nkd", gamma, xs, preferred_element_type=jnp.float32)
+    s2 = jnp.einsum(
+        "ntk,ntd->nkd", gamma, xs * xs, preferred_element_type=jnp.float32
+    )
 
     # Φ¹ = (s1 − s0·μ)/σ;  Φ² = (s2 − 2μ·s1 + s0·μ²)/σ² − s0
     phi1 = (s1 - s0[..., None] * mu) / sigma

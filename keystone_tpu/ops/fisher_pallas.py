@@ -46,7 +46,9 @@ def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
         s2_ref[:] = jnp.zeros_like(s2_ref)
         cnt_ref[0] = 0.0
 
-    x = x_ref[0]  # (TILE_T, d)
+    # descriptors may arrive bf16 (halved HBM traffic — the kernel is
+    # bandwidth bound); compute stays f32 in VMEM
+    x = x_ref[0].astype(jnp.float32)  # (TILE_T, d)
     m = mask_ref[0]  # (TILE_T, 1)
     mu_inv = mu_ref[:] * inv_ref[:]  # (K, d)
 
@@ -89,11 +91,15 @@ def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
         out_ref[0, k:, :] = phi2
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def fisher_encode_pallas(xs, mask, w, mu, var, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret", "mxu"))
+def fisher_encode_pallas(
+    xs, mask, w, mu, var, interpret: bool = False, mxu: str = "f32"
+):
     """xs: (n, T, d); mask: (n, T); GMM (w (K,), mu/var (K, d)) → (n, 2KD).
 
-    Matches ops/fisher.py § _fisher_encode up to f32 rounding.
+    Matches ops/fisher.py § _fisher_encode up to f32 rounding.  With
+    ``mxu='bf16'`` descriptors stream from HBM as bf16 (half the read
+    traffic of the bandwidth-bound kernel); all VMEM compute stays f32.
     """
     n, t, d = xs.shape
     k = mu.shape[0]
@@ -128,7 +134,7 @@ def fisher_encode_pallas(xs, mask, w, mu, var, interpret: bool = False):
         ],
         interpret=interpret,
     )(
-        xs.astype(jnp.float32),
+        xs.astype(jnp.bfloat16 if mxu == "bf16" else jnp.float32),
         mask.astype(jnp.float32)[..., None],
         logw.astype(jnp.float32),
         mu.astype(jnp.float32),

@@ -58,6 +58,10 @@ class Convolver(Transformer):
         return (self.filters.shape, fp, self.stride, self.offset is None)
 
     def apply_batch(self, xs, mask=None):
+        # Not under the bf16 matmul policy: XLA's default precision already
+        # runs f32 convs as bf16-grade MXU passes, so explicit bf16 casts
+        # only add materialization (measured 0.94× at CIFAR shapes on
+        # v5 lite) while costing input accuracy.  See utils/precision.py.
         if xs.ndim == 3:
             xs = xs[..., None]
         rhs = jnp.transpose(self.filters, (1, 2, 3, 0))  # HWIO

@@ -24,6 +24,7 @@ import numpy as np
 
 from keystone_tpu.workflow.dataset import Dataset
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils import precision
 
 _NUM_ORIENTATIONS = 8
 _GRID = 4  # 4x4 spatial bins -> 128-d descriptors
@@ -51,7 +52,7 @@ class SIFTExtractor(Transformer):
             xs = xs[..., 0]
         descs = []
         for b in self.bin_sizes:
-            descs.append(_dsift(xs, self.step, b))
+            descs.append(_dsift(xs, self.step, b, mxu=precision.matmul_mode()))
         out = jnp.concatenate(descs, axis=1)
         return out, jnp.ones(out.shape[:2], jnp.float32)
 
@@ -80,8 +81,8 @@ def _keypoint_grid(extent: int, step: int, bin_size: int) -> np.ndarray:
     return np.arange(lo, hi, step, dtype=np.int32)
 
 
-@partial(jax.jit, static_argnames=("step", "bin_size"))
-def _dsift(imgs, step, bin_size):
+@partial(jax.jit, static_argnames=("step", "bin_size", "mxu"))
+def _dsift(imgs, step, bin_size, mxu: str = "f32"):
     n, h, w = imgs.shape
 
     # --- gradients (central differences, like vl_dsift's gradient) ---
@@ -107,11 +108,27 @@ def _dsift(imgs, step, bin_size):
     k1 = jnp.asarray(_triangular_kernel(bin_size))
     kh = k1.reshape(-1, 1, 1, 1) * jnp.eye(o)[None, None]  # (kh, 1, 8, 8)
     kw = k1.reshape(1, -1, 1, 1) * jnp.eye(o)[None, None]
+    # bf16 windowing with f32 accumulation under the bf16 policy: the
+    # window is a smooth positive kernel and descriptors are L2-normalized
+    # and clamped downstream, so bf16 input rounding is within the
+    # tolerance the parity tests assert (tests/test_precision.py)
+    omap_c, kh_c, kw_c = precision.fcast(omap, kh, kw, mode=mxu)
     smoothed = lax.conv_general_dilated(
-        omap, kh, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        omap_c,
+        kh_c,
+        (1, 1),
+        "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
     )
+    smoothed_c = precision.fcast(smoothed, mode=mxu)
     smoothed = lax.conv_general_dilated(
-        smoothed, kw, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        smoothed_c,
+        kw_c,
+        (1, 1),
+        "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
     )
 
     # --- gather 4x4 bin responses around each keypoint ---

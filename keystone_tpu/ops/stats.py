@@ -58,6 +58,11 @@ class CosineRandomFeatures(Transformer):
         return (self.w.shape, cached_fingerprint(self, "_fp", self.w, self.b))
 
     def apply_batch(self, xs, mask=None):
+        # Deliberately NOT under the bf16 matmul policy: the phase xWᵀ is
+        # unbounded, so bf16's ~0.4% relative rounding becomes an absolute
+        # phase error that wraps through cos with O(1) feature error
+        # (measured: 0.4 rad at |phase|≈100).  Random-feature quality
+        # depends on phase fidelity; keep f32.
         return jnp.cos(xs @ self.w.T + self.b)
 
     def apply_one(self, x):

@@ -46,9 +46,20 @@ def sharded_matmul(a, b, out_spec: Optional[P] = None, mesh=None):
     nodes/learning/LinearMapper.scala § LinearMapEstimator): contraction
     over the sharded row axis; XLA inserts the all-reduce.  The result is
     constrained replicated (or ``out_spec``) — the broadcast analogue.
+
+    Solver contractions request TRUE f32 MXU passes: XLA:TPU's *default*
+    matmul precision truncates f32 inputs to bf16-grade passes (measured
+    on v5 lite: default ≈ 2× the throughput of precision='float32'),
+    which is fine for the featurize path but silently degrades normal
+    equations — the reference computes these in f64 (netlib BLAS).  See
+    utils/precision.py § solver_precision.
     """
+    from keystone_tpu.utils.precision import solver_precision
+
     mesh = mesh or _mesh.current_mesh()
-    out = jnp.matmul(a.T, b, preferred_element_type=jnp.float32)
+    out = jnp.matmul(
+        a.T, b, precision=solver_precision(), preferred_element_type=jnp.float32
+    )
     return lax.with_sharding_constraint(
         out, NamedSharding(mesh, out_spec if out_spec is not None else P())
     )

@@ -1,0 +1,162 @@
+"""Matmul precision policy — what bf16 actually buys on this hardware.
+
+The reference computes everything in f64 on CPU BLAS (netlib-java,
+SURVEY.md §2.8).  On TPU the naive expectation is "bf16 inputs ≈ 4× MXU
+throughput", but measurement on v5 lite (chained in-jit matmuls, real
+device sync) shows XLA's DEFAULT precision already runs f32 matmuls as
+bf16-grade MXU passes:
+
+    f32 inputs, precision=default : 2.0× the throughput of true f32
+    f32 inputs, precision=float32 : baseline (full-precision passes)
+    bf16 inputs                   : ≈ default-f32 (no additional compute win)
+
+Two consequences shape this module:
+
+1. **bf16 is a BANDWIDTH/capacity lever, not a compute lever.**  Explicit
+   bf16 pays off only where an op is HBM-bound on its inputs: the SIFT
+   windowing convs (+17% measured) and the Pallas FV kernel's descriptor
+   stream (+11%).  Output-bound contractions (FV sufficient-statistic
+   einsums: 0.64×) and compute-bound convs (Convolver: 0.94×) get only
+   cast overhead and are deliberately NOT under the policy, as is the
+   phase-sensitive CosineRandomFeatures (unbounded error through cos).
+
+2. **Solvers must opt OUT of XLA's default.**  Default precision quietly
+   degrades Gramians/normal equations to bf16-grade passes on TPU — the
+   one place the reference used f64.  :func:`sdot` /
+   :func:`solver_precision` pin solver contractions to true-f32 passes
+   (2× slower on those matmuls, correctness first; env-overridable).
+
+Modes for the featurize policy:
+  - ``auto`` (default): bf16 when the default backend is a TPU, f32
+    otherwise (CPU test meshes keep full precision).
+  - ``bf16`` / ``f32``: forced, e.g. for parity tests.
+
+Set via env ``KEYSTONE_MATMUL``, :func:`set_matmul`, or the
+:func:`matmul` context manager.  Compiled functions key their caches on
+the resolved mode (transformer jit wrappers include it in their cache
+signature; module-level kernels take it as a static argument), so
+flipping the policy retraces rather than silently reusing stale
+executables.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+_MODES = ("auto", "bf16", "f32")
+_MODE = os.environ.get("KEYSTONE_MATMUL", "auto")
+if _MODE not in _MODES:
+    raise ValueError(f"KEYSTONE_MATMUL must be one of {_MODES}, got {_MODE!r}")
+
+_TPU_PLATFORMS = ("tpu", "axon")
+_DEFAULT_IS_TPU: bool | None = None
+
+
+def _on_tpu() -> bool:
+    """Whether computation currently targets a TPU.
+
+    Resolution order mirrors ops/fisher_pallas.py § pallas_supported: the
+    active framework mesh first (so a CPU mesh on a TPU host — e.g. the
+    multichip dryrun — keeps full precision and validates what it claims
+    to), then the default backend (cached: it cannot change)."""
+    global _DEFAULT_IS_TPU
+    try:
+        from keystone_tpu.parallel.mesh import active_mesh
+
+        m = active_mesh()
+        if m is not None and m.devices.size:
+            return m.devices.flat[0].platform in _TPU_PLATFORMS
+    except Exception:
+        pass
+    if _DEFAULT_IS_TPU is None:
+        try:
+            dev = jax.devices()[0]
+            kind = getattr(dev, "device_kind", "") or ""
+            _DEFAULT_IS_TPU = dev.platform in _TPU_PLATFORMS or "TPU" in kind
+        except Exception:
+            _DEFAULT_IS_TPU = False
+    return _DEFAULT_IS_TPU
+
+
+def set_matmul(mode: str) -> None:
+    global _MODE
+    if mode not in _MODES:
+        raise ValueError(f"matmul mode must be one of {_MODES}, got {mode!r}")
+    _MODE = mode
+
+
+def matmul_mode() -> str:
+    """The resolved mode: 'bf16' or 'f32' (never 'auto')."""
+    if _MODE == "auto":
+        return "bf16" if _on_tpu() else "f32"
+    return _MODE
+
+
+@contextmanager
+def matmul(mode: str):
+    prev = _MODE
+    set_matmul(mode)
+    try:
+        yield
+    finally:
+        set_matmul(prev)
+
+
+_SOLVER_PRECISIONS = ("default", "float32", "highest")
+_SOLVER_PRECISION = os.environ.get("KEYSTONE_SOLVER_PRECISION", "float32")
+if _SOLVER_PRECISION not in _SOLVER_PRECISIONS:
+    raise ValueError(
+        f"KEYSTONE_SOLVER_PRECISION must be one of {_SOLVER_PRECISIONS}, "
+        f"got {_SOLVER_PRECISION!r}"
+    )
+
+
+def solver_precision():
+    """lax.Precision for solver contractions (Gramians, normal equations,
+    LBFGS gradients, covariances).
+
+    Measured on TPU v5 lite: XLA's DEFAULT matmul precision runs f32
+    inputs as bf16-grade MXU passes (~2× the throughput of true f32) —
+    acceptable for forward features, but normal equations square the
+    condition number and the reference solves them in f64, so solvers
+    default to 'float32' (full-precision passes).  Override with
+    ``KEYSTONE_SOLVER_PRECISION=default`` to trade accuracy for the 2×.
+    """
+    from jax import lax
+
+    return {
+        "default": lax.Precision.DEFAULT,
+        "float32": lax.Precision.HIGHEST,
+        "highest": lax.Precision.HIGHEST,
+    }[_SOLVER_PRECISION]
+
+
+def sdot(a, b):
+    """Solver-grade matmul: true-f32 MXU passes, f32 accumulation.  Use
+    for every contraction whose result enters a linear solve (Gramians,
+    AᵀB right-hand sides, covariances, EM sufficient statistics,
+    LBFGS gradients)."""
+    import jax.numpy as jnp
+
+    return jnp.matmul(
+        a, b, precision=solver_precision(), preferred_element_type=jnp.float32
+    )
+
+
+def fdtype(mode: str | None = None):
+    """The featurize-matmul input dtype for ``mode`` (default: current)."""
+    m = matmul_mode() if mode is None else mode
+    return jnp.bfloat16 if m == "bf16" else jnp.float32
+
+
+def fcast(*xs, mode: str | None = None):
+    """Cast featurize-matmul inputs to the policy dtype.  Pair every use
+    with ``preferred_element_type=jnp.float32`` so accumulation (and the
+    result) stays f32."""
+    dt = fdtype(mode)
+    out = tuple(jnp.asarray(x).astype(dt) for x in xs)
+    return out if len(out) > 1 else out[0]

@@ -95,10 +95,33 @@ class Transformer(Chainable):
         the axon TPU backend an eager FFT dispatch corrupts the device
         stream for the rest of the process.  Untraceable apply_batch
         implementations (host-side numpy, data-dependent Python) fall back
-        to the eager path."""
+        to the eager path.
+
+        The per-instance cache is keyed by (matmul mode, traced signature):
+        the mode key makes precision-policy flips retrace instead of
+        reusing a stale executable, and the signature key confines a trace
+        failure to the one input signature that caused it — one odd
+        mask/dtype combination must not pin every later call of this
+        instance to the eager path."""
+        from keystone_tpu.utils import precision
+
+        # Keyed by (mode, dtype, rank, mask-presence) — NOT concrete shapes:
+        # jit itself retraces per shape under one wrapper, and traceability
+        # failures are dtype/mask/structure-driven, so a shape-keyed memo
+        # would re-pay a doomed trace (and re-warn) for every ragged batch.
+        sig = (
+            precision.matmul_mode(),
+            str(getattr(xs, "dtype", "")),
+            getattr(xs, "ndim", None),
+            None if mask is None else str(getattr(mask, "dtype", "")),
+        )
+        entry = _JIT_APPLY_CACHE.get(self)
+        if entry is None:
+            entry = {}
+            _JIT_APPLY_CACHE[self] = entry
         sentinel = object()
-        fn = _JIT_APPLY_CACHE.get(self, sentinel)
-        if fn is None:  # memoized "untraceable": straight to eager
+        fn = entry.get(sig, sentinel)
+        if fn is None:  # memoized "untraceable" FOR THIS SIGNATURE
             return self.apply_batch(xs, mask=mask)
         if fn is sentinel:
             # weak cache, NOT an instance attribute: jitted callables are
@@ -107,11 +130,19 @@ class Transformer(Chainable):
             # would make the cache VALUE pin its own KEY alive forever.
             self_ref = weakref.ref(self)
             fn = jax.jit(lambda a, m: self_ref().apply_batch(a, mask=m))
-            _JIT_APPLY_CACHE[self] = fn
+            entry[sig] = fn
         try:
             return fn(xs, mask)
         except (TypeError, jax.errors.JAXTypeError):
-            _JIT_APPLY_CACHE[self] = None  # don't re-pay a failed trace
+            entry[sig] = None  # don't re-pay a failed trace for this sig
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s.apply_batch is untraceable for signature %s; using the "
+                "eager path (hazardous on the axon backend for FFT ops)",
+                self.label,
+                sig,
+            )
             return self.apply_batch(xs, mask=mask)
 
     def __call__(self, x):

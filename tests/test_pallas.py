@@ -43,7 +43,7 @@ def test_fisher_vector_auto_mode_selects_by_gamma_size(monkeypatch):
 
     calls = []
 
-    def fake_pallas(xs, mask, w, mu, var, interpret=False):
+    def fake_pallas(xs, mask, w, mu, var, interpret=False, mxu="f32"):
         calls.append("pallas")
         return fisher_mod._fisher_encode(xs, mask, w, mu, var)
 

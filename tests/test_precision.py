@@ -1,0 +1,227 @@
+"""Mixed-precision (bf16 matmul + f32 accumulation) parity tests.
+
+Policy: utils/precision.py — bf16 applies ONLY where it is a measured
+bandwidth win: the SIFT windowing convs, the Pallas FV kernel's HBM
+descriptor stream, and the PCA projection.  Ops where bf16 lost on TPU
+(FV einsums, Convolver) or is numerically unsafe (CosineRandomFeatures)
+are excluded and must be bit-identical under both modes.  Solvers pin
+true-f32 MXU passes regardless of policy (sdot/solver_precision).
+
+Documented tolerances vs the f32 path (bf16 has an 8-bit mantissa,
+~0.4% relative rounding per input; f32 accumulation keeps reduction
+error from growing with contraction length):
+
+  - SIFT descriptors (L2-normalized, clamped 0.2): atol 2e-2
+  - Pallas FV (bf16 descriptor stream):             atol 2e-2 · scale
+  - PCA projection:                                 rtol 2e-2 + atol 2e-2·scale
+  - End-to-end accuracy on the test problems:       unchanged
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.utils import precision
+
+
+@pytest.fixture(autouse=True)
+def _restore_policy():
+    yield
+    precision.set_matmul("auto")
+
+
+def _tol(ref, atol_frac=2e-2):
+    return float(atol_frac * np.abs(np.asarray(ref)).max() + 1e-7)
+
+
+def test_policy_modes():
+    assert precision.matmul_mode() in ("bf16", "f32")
+    with precision.matmul("bf16"):
+        assert precision.matmul_mode() == "bf16"
+        assert precision.fdtype() == jnp.bfloat16
+        with precision.matmul("f32"):
+            assert precision.matmul_mode() == "f32"
+        assert precision.matmul_mode() == "bf16"
+    with pytest.raises(ValueError):
+        precision.set_matmul("fp8")
+
+
+def test_sift_bf16_parity():
+    from keystone_tpu.ops import SIFTExtractor
+
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(0, 1, (2, 48, 48)).astype(np.float32)
+    sift = SIFTExtractor(step=6, bin_sizes=(4,))
+    with precision.matmul("f32"):
+        d32, _ = sift.apply_batch(imgs)
+    with precision.matmul("bf16"):
+        d16, _ = sift.apply_batch(imgs)
+    np.testing.assert_allclose(np.asarray(d16), np.asarray(d32), atol=2e-2)
+
+
+def test_fisher_einsum_excluded_from_policy():
+    """The FV einsum path is output-bound — bf16 casts measured 0.64× on
+    TPU — so it must be bit-identical under both modes."""
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.fisher import FisherVector
+
+    rng = np.random.default_rng(1)
+    k, d, t, n = 8, 16, 64, 4
+    gmm = GaussianMixtureModel(
+        jnp.full((k,), 1.0 / k),
+        jnp.asarray(rng.normal(size=(k, d)), jnp.float32),
+        jnp.ones((k, d), jnp.float32),
+    )
+    xs = rng.normal(size=(n, t, d)).astype(np.float32)
+    fv = FisherVector(gmm, use_pallas=False)
+    with precision.matmul("f32"):
+        f32_out = np.asarray(fv.apply_batch(jnp.asarray(xs)))
+    with precision.matmul("bf16"):
+        bf16_out = np.asarray(fv.apply_batch(jnp.asarray(xs)))
+    np.testing.assert_array_equal(bf16_out, f32_out)
+
+
+def test_fisher_pallas_bf16_parity():
+    """Interpret-mode kernel: bf16 descriptor stream vs f32."""
+    from keystone_tpu.ops.fisher_pallas import fisher_encode_pallas
+
+    rng = np.random.default_rng(2)
+    k, d, t, n = 8, 16, 128, 2
+    xs = jnp.asarray(rng.normal(size=(n, t, d)), jnp.float32)
+    mask = jnp.ones((n, t), jnp.float32)
+    w = jnp.full((k,), 1.0 / k)
+    mu = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    var = jnp.ones((k, d), jnp.float32)
+    f32_out = np.asarray(
+        fisher_encode_pallas(xs, mask, w, mu, var, interpret=True, mxu="f32")
+    )
+    bf16_out = np.asarray(
+        fisher_encode_pallas(xs, mask, w, mu, var, interpret=True, mxu="bf16")
+    )
+    np.testing.assert_allclose(bf16_out, f32_out, atol=_tol(f32_out))
+
+
+def test_convolver_excluded_from_policy():
+    """Convolver is compute-bound (bf16 measured 0.94× on TPU): excluded,
+    bit-identical under both modes."""
+    from keystone_tpu.ops import Convolver
+
+    rng = np.random.default_rng(3)
+    imgs = rng.uniform(0, 1, (2, 16, 16, 3)).astype(np.float32)
+    filt = rng.normal(size=(8, 5, 5, 3)).astype(np.float32)
+    conv = Convolver(jnp.asarray(filt))
+    with precision.matmul("f32"):
+        o32 = np.asarray(conv.apply_batch(jnp.asarray(imgs)))
+    with precision.matmul("bf16"):
+        o16 = np.asarray(conv.apply_batch(jnp.asarray(imgs)))
+    np.testing.assert_array_equal(o16, o32)
+
+
+def test_cosine_features_excluded_from_policy():
+    """CosineRandomFeatures is phase-sensitive (unbounded xWᵀ wraps
+    through cos), so it must stay f32 under the bf16 policy."""
+    from keystone_tpu.ops import CosineRandomFeatures
+
+    rng = np.random.default_rng(4)
+    xs = rng.normal(size=(16, 32)).astype(np.float32) * 4.0
+    crf = CosineRandomFeatures.init(32, 64, gamma=1.0, seed=0)
+    with precision.matmul("f32"):
+        o32 = np.asarray(crf.apply_batch(jnp.asarray(xs)))
+    with precision.matmul("bf16"):
+        o16 = np.asarray(crf.apply_batch(jnp.asarray(xs)))
+    np.testing.assert_array_equal(o16, o32)
+
+
+def test_block_predict_excluded_from_policy():
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(128, 40)).astype(np.float32)
+    w = rng.normal(size=(40, 4)).astype(np.float32)
+    lbl = (x @ w).argmax(1)
+    y = -np.ones((128, 4), np.float32)
+    y[np.arange(128), lbl] = 1.0
+    est = BlockLeastSquaresEstimator(block_size=16, num_iter=3, lam=1e-3)
+    model = est.fit_arrays(x, y)
+    with precision.matmul("f32"):
+        s32 = np.asarray(model.apply_batch(jnp.asarray(x)))
+    with precision.matmul("bf16"):
+        s16 = np.asarray(model.apply_batch(jnp.asarray(x)))
+    np.testing.assert_array_equal(s16, s32)
+
+
+def test_solver_fit_unaffected_by_policy():
+    """Gramians/Cholesky never downcast: fitted weights are identical
+    under both policies (fit consumes raw arrays, no featurize matmuls)."""
+    from keystone_tpu.models import BlockWeightedLeastSquaresEstimator
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(96, 24)).astype(np.float32)
+    lbl = rng.integers(0, 3, size=96)
+    y = -np.ones((96, 3), np.float32)
+    y[np.arange(96), lbl] = 1.0
+    est = BlockWeightedLeastSquaresEstimator(block_size=8, num_iter=2, lam=1e-2)
+    with precision.matmul("bf16"):
+        w16 = np.asarray(est.fit_arrays(x, y).flat_weights)
+    with precision.matmul("f32"):
+        w32 = np.asarray(est.fit_arrays(x, y).flat_weights)
+    np.testing.assert_allclose(w16, w32, atol=1e-6)
+
+
+def test_jit_cache_retraces_on_policy_flip():
+    """The per-transformer jit cache keys on the policy mode: flipping it
+    must produce the (slightly) different bf16 result, not a stale f32
+    executable's output."""
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.workflow import Dataset
+
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(32, 64)).astype(np.float32)
+    pca = PCATransformer(jnp.asarray(rng.normal(size=(64, 16)), jnp.float32))
+    ds = Dataset(xs)
+    with precision.matmul("f32"):
+        o32 = pca.apply_dataset(ds).numpy()
+    with precision.matmul("bf16"):
+        o16 = pca.apply_dataset(ds).numpy()
+    assert not np.array_equal(o16, o32), "policy flip reused a stale executable"
+    np.testing.assert_allclose(o16, o32, rtol=2e-2, atol=_tol(o32))
+
+
+def test_end_to_end_accuracy_unchanged_bf16():
+    """The CIFAR-style conv pipeline reaches the same test accuracy under
+    bf16 featurize as under f32."""
+    from keystone_tpu.ops import Convolver, Pooler, SymmetricRectifier
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Dataset, Pipeline, transformer
+
+    rng = np.random.default_rng(8)
+    n, hw, c, k = 96, 12, 3, 3
+    imgs = rng.uniform(0, 1, (n, hw, hw, c)).astype(np.float32)
+    lbl = rng.integers(0, k, size=n)
+    for i in range(n):  # class-dependent planted pattern
+        imgs[i, :4, :4, lbl[i] % c] += 1.5
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lbl] = 1.0
+    filt = rng.normal(size=(8, 4, 4, c)).astype(np.float32)
+
+    def build():
+        return (
+            Pipeline.of(Convolver(jnp.asarray(filt)))
+            .and_then(SymmetricRectifier())
+            .and_then(Pooler(3, 3))
+            .and_then(transformer(lambda v: v.reshape(-1), name="Flatten"))
+        )
+
+    accs = {}
+    for mode in ("f32", "bf16"):
+        with precision.matmul(mode):
+            pipe = build().and_then(
+                BlockLeastSquaresEstimator(block_size=32, num_iter=3, lam=1e-3),
+                Dataset(imgs),
+                Dataset(y),
+            )
+            fitted = pipe.fit()
+            pred = fitted(Dataset(imgs)).get().numpy()
+            accs[mode] = (pred.argmax(1) == lbl).mean()
+    assert accs["bf16"] == accs["f32"] == 1.0, accs
