@@ -21,6 +21,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "libkeystone_native.so")
+_ABI_VERSION = 2  # must match ks_version() in native/keystone_native.cpp
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -65,6 +66,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         lib = build_and_load(_SO_PATH)
         if lib is None:
+            return None
+        # ABI check: build_and_load loads a pre-existing .so even when make
+        # is unavailable, so a stale binary with the old float-pixel
+        # ks_decode_jpegs ABI would otherwise yield garbage uint8 data.
+        try:
+            lib.ks_version.restype = ctypes.c_int
+            version = lib.ks_version()
+        except AttributeError:
+            version = 0
+        if version != _ABI_VERSION:
+            logger.warning(
+                "native library %s has ABI version %d (want %d); ignoring "
+                "it — pure-Python fallbacks will be used. Rebuild with "
+                "`make -C native`.",
+                _SO_PATH,
+                version,
+                _ABI_VERSION,
+            )
             return None
         lib.ks_read_csv.restype = ctypes.c_int
         lib.ks_read_csv.argtypes = [

@@ -229,7 +229,12 @@ class FusedTransformer(Transformer):
 
     def __init__(self, stages: Sequence[Transformer]):
         self.stages = list(stages)
-        self._jitted = None
+        self._jitted = {}  # matmul mode -> jitted fn; never pickled
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_jitted"] = {}
+        return state
 
     @property
     def label(self):
@@ -245,7 +250,13 @@ class FusedTransformer(Transformer):
         return x
 
     def apply_batch(self, xs, mask=None):
-        if self._jitted is None:
+        # Keyed by the resolved matmul mode (utils/precision.py invariant):
+        # a policy flip must retrace, not reuse a stale-precision executable.
+        from keystone_tpu.utils import precision
+
+        mode = precision.matmul_mode()
+        fn = self._jitted.get(mode)
+        if fn is None:
             stages = list(self.stages)
 
             def run(arr):
@@ -253,8 +264,8 @@ class FusedTransformer(Transformer):
                     arr = s.apply_batch(arr)
                 return arr
 
-            self._jitted = jax.jit(run)
-        return self._jitted(xs)
+            fn = self._jitted[mode] = jax.jit(run)
+        return fn(xs)
 
 
 class StageFusionRule(Rule):

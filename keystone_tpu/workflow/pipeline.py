@@ -303,6 +303,19 @@ class FittedPipeline(Pipeline):
 
         if path and os.path.exists(path):
             obj, saved_cfg = FittedPipeline._load_raw(path)
+            if config is not None and saved_cfg is None:
+                # Legacy bare-pickle save() format: no config was persisted,
+                # so the staleness check cannot run — exactly the mismatch
+                # it exists to catch. Warn instead of silently accepting.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "saved model at %s has no persisted config (legacy "
+                    "save() format); cannot verify it matches the current "
+                    "config — re-fit (delete the file) to enable the "
+                    "staleness check",
+                    path,
+                )
             if config is not None and saved_cfg is not None and saved_cfg != config:
                 raise ValueError(
                     f"saved model at {path} was fitted with a different "

@@ -154,22 +154,48 @@ def load_dataset_orbax(path: str) -> Dataset:
     path's no-gather property)."""
     import jax
 
-    from keystone_tpu.parallel.mesh import current_mesh, data_sharding
+    from keystone_tpu.parallel.mesh import DATA_AXIS, current_mesh, data_sharding
 
     ckptr = _orbax_checkpointer()
     path = os.path.abspath(path)
     meta = ckptr.metadata(path).item_metadata
     mesh = current_mesh()
+    # The saved arrays were padded for the SAVING mesh; if the current
+    # 'data' axis doesn't divide that padded leading dim (saved on 8
+    # devices, restored on 16), a sharded restore would raise.  Restore to
+    # host instead and re-shard through Dataset (which re-pads) — the
+    # saved prefix stays usable across mesh-shape changes.
+    dsize = int(mesh.shape[DATA_AXIS])
+    sharded = all(
+        key == "n" or (len(m.shape) > 0 and m.shape[0] % dsize == 0)
+        for key, m in meta.items()
+    )
     target = {}
     for key, m in meta.items():
         shape, dtype = tuple(m.shape), m.dtype
-        if key == "n":
-            target[key] = np.zeros(shape, dtype)  # scalar, host
+        if key == "n" or not sharded:
+            target[key] = np.zeros(shape, dtype)  # host
         else:  # 'array' / 'mask': leading axis over 'data'
             target[key] = jax.ShapeDtypeStruct(
                 shape, dtype, sharding=data_sharding(mesh, max(1, len(shape)))
             )
     restored = ckptr.restore(path, target)
+    if not sharded:
+        logger.warning(
+            "saved prefix %s was padded for a different mesh (leading dim "
+            "%s vs data axis %d); restoring replicated and re-sharding",
+            path,
+            {k: m.shape for k, m in meta.items() if k != "n"},
+            dsize,
+        )
+        d = Dataset(restored["array"], n=int(restored["n"]), shard=True)
+        if restored.get("mask") is not None:
+            # shard_batch re-pads the mask's leading dim exactly as it did
+            # the array's, keeping ragged (array, mask) rows aligned
+            from keystone_tpu.parallel import shard_batch
+
+            d.mask = shard_batch(restored["mask"])
+        return d
     d = Dataset.__new__(Dataset)
     d._host = None
     d._array = restored["array"]

@@ -324,6 +324,9 @@ int ks_decode_jpegs(const uint8_t* blob, const int64_t* offsets,
 
 void ks_free(void* p) { free(p); }
 
-int ks_version() { return 1; }
+// ABI version: bump whenever an exported signature changes (v2 =
+// ks_decode_jpegs emits uint8 pixels; v1 emitted float).  The ctypes
+// loader refuses mismatched binaries instead of reading garbage.
+int ks_version() { return 2; }
 
 }  // extern "C"

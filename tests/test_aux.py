@@ -392,6 +392,49 @@ def test_compilation_cache_enable_and_disable(tmp_path, monkeypatch):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
 
 
+def test_saved_state_orbax_mesh_mismatch_restores_replicated(tmp_path):
+    """A prefix saved (mesh-padded) on one mesh must still restore under a
+    mesh whose 'data' axis doesn't divide the saved leading dim — via the
+    host-restore + re-shard fallback, not silent recompute (ADVICE r1)."""
+    import jax
+
+    from keystone_tpu.parallel import default_mesh, use_mesh
+    from keystone_tpu.workflow.state import (
+        load_dataset_orbax,
+        save_dataset_orbax,
+    )
+
+    path = str(tmp_path / "mismatch.orbax")
+    # n=6 padded for the session mesh (data=4) -> 8 rows
+    ds = Dataset(np.arange(6 * 3, dtype=np.float32).reshape(6, 3), n=6)
+    save_dataset_orbax(ds, path)
+    saved_rows = ds.array.shape[0]
+
+    # ragged dataset: the mask must be re-padded in lockstep with the array
+    ragged_path = str(tmp_path / "mismatch-ragged.orbax")
+    base = Dataset(np.ones((6, 5, 2), np.float32), n=6)  # padded to 8 rows
+    rag = base.with_array(
+        base.array, mask=jnp.ones((base.array.shape[0], 5), bool)
+    )
+    save_dataset_orbax(rag, ragged_path)
+
+    three = default_mesh(jax.devices("cpu")[:3], model_parallelism=1)
+    assert saved_rows % 3 != 0  # the mismatch this test is about
+    with use_mesh(three):
+        restored = load_dataset_orbax(path)
+        assert restored.n == 6
+        np.testing.assert_allclose(
+            restored.numpy(), np.arange(6 * 3, dtype=np.float32).reshape(6, 3)
+        )
+        # re-sharded for the CURRENT mesh: leading dim divisible by 3
+        assert restored.array.shape[0] % 3 == 0
+
+        rrag = load_dataset_orbax(ragged_path)
+        assert rrag.mask is not None
+        assert rrag.mask.shape[0] == rrag.array.shape[0]  # aligned padding
+        assert rrag.array.shape[0] % 3 == 0
+
+
 def test_saved_state_orbax_backend_roundtrip(tmp_path):
     """Tensorstore-backed stage checkpoints (SURVEY §5): save with
     backend="orbax", reload via the same SavedStateLoadRule."""

@@ -27,8 +27,9 @@ from keystone_tpu.utils import precision
 
 @pytest.fixture(autouse=True)
 def _restore_policy():
+    before = precision._MODE  # preserve an env-pinned KEYSTONE_MATMUL
     yield
-    precision.set_matmul("auto")
+    precision.set_matmul(before)
 
 
 def _tol(ref, atol_frac=2e-2):
