@@ -255,6 +255,8 @@ class FusedTransformer(Transformer):
         from keystone_tpu.utils import precision
 
         mode = precision.matmul_mode()
+        if not isinstance(self._jitted, dict):  # pre-dict pickles stored None
+            self._jitted = {}
         fn = self._jitted.get(mode)
         if fn is None:
             stages = list(self.stages)
