@@ -74,7 +74,7 @@ class BlockLinearMapper(Transformer):
 
     def apply_batch(self, xs, mask=None):
         return _block_predict(
-            xs, self.weights, self.block_size, self.intercept, self.feature_mean
+            xs, self.weights, self.intercept, self.feature_mean
         )
 
     def apply_one(self, x):
@@ -109,8 +109,8 @@ def _offset(weights, feature_mean, intercept):
     return off
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def _block_predict(xs, weights, block_size, intercept, feature_mean):
+@jax.jit
+def _block_predict(xs, weights, intercept, feature_mean):
     # Blocks are contiguous column ranges (blockify), so summing per-block
     # partials equals ONE flat matmul against the concatenated weights.
     # The blocked einsum compiled to a scan of dynamic-sliced weight reads

@@ -236,6 +236,11 @@ class FusedTransformer(Transformer):
         state["_jitted"] = {}
         return state
 
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        if not isinstance(self._jitted, dict):  # pre-dict pickles stored None
+            self._jitted = {}
+
     @property
     def label(self):
         return "Fused[" + " > ".join(s.label for s in self.stages) + "]"
@@ -255,8 +260,6 @@ class FusedTransformer(Transformer):
         from keystone_tpu.utils import precision
 
         mode = precision.matmul_mode()
-        if not isinstance(self._jitted, dict):  # pre-dict pickles stored None
-            self._jitted = {}
         fn = self._jitted.get(mode)
         if fn is None:
             stages = list(self.stages)

@@ -28,10 +28,28 @@ def test_pallas_fv_matches_xla_path():
 
 
 def test_pallas_fv_nondivisible_t_padding():
-    xs, mask, w, mu, var = _setup(t=137)  # pads to 2 tiles of 128
+    xs, mask, w, mu, var = _setup(t=137)  # one tile of 144 (pad 137→144)
     ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))
     got = np.asarray(fisher_encode_pallas(xs, mask, w, mu, var, interpret=True))
     np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+def test_pallas_fv_multi_tile_accumulation():
+    """T > TILE_T_MAX forces tiles>1: exercises the revolving-accumulator
+    t-loop, the 128-multiple _tile_t branch, and the (1, 1, tile_t) mask
+    index map (none of which the single-tile tests touch)."""
+    from keystone_tpu.ops.fisher_pallas import TILE_T_MAX, _tile_t
+
+    for t in (TILE_T_MAX + 476, 2 * TILE_T_MAX + 1):
+        tile = _tile_t(t)
+        assert tile <= TILE_T_MAX and tile % 128 == 0
+        assert -(-t // tile) >= 2
+        xs, mask, w, mu, var = _setup(t=t)
+        ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))
+        got = np.asarray(
+            fisher_encode_pallas(xs, mask, w, mu, var, interpret=True)
+        )
+        np.testing.assert_allclose(got, ref, atol=2e-5)
 
 
 def test_fisher_vector_auto_mode_selects_by_gamma_size(monkeypatch):
