@@ -184,14 +184,92 @@ class DenseLBFGSwithL2(LabelEstimator):
 
 
 class SparseLBFGSwithL2(DenseLBFGSwithL2):
-    """Sparse-gradient variant (LBFGS.scala § SparseLBFGSwithL2).
+    """Sparse-gradient variant (LBFGS.scala § SparseLBFGSwithL2 /
+    LeastSquaresSparseGradient).
 
-    The reference keeps CSR features on executors; on TPU the MXU wants
-    dense tiles, so sparse inputs are densified blockwise at ingest
-    (ops/util Densify) and this class is the same solver.  It exists so
-    the optimizer's physical-choice rule has both names to select between
-    (dense vs sparse input representations).
+    Features stay in padded-COO form (ops/sparse.PaddedSparseRows —
+    n·nnz (index, value) pairs, never the dense n×d matrix): the forward
+    pass gathers weight rows, the gradient scatter-adds into (d, k).
+    At 100k+ vocabulary this is ~3 orders of magnitude less memory than
+    densifying, which is exactly how the reference ran text at scale.
+
+    Accepts: a host Dataset of scipy sparse rows (what ``Sparsify``
+    emits), a ``PaddedSparseRows`` directly via :meth:`fit_sparse`, or —
+    fallback — any dense input, which routes to the dense solver so the
+    optimizer's physical-choice rule can still select either class name.
+    ``fit_intercept`` is not supported on the sparse path (centering
+    would densify); construct with ``fit_intercept=False``.
     """
+
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
+        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+
+        if labels is None:
+            raise ValueError("SparseLBFGSwithL2 requires labels")
+        if data.is_host and is_scipy_sparse_rows(data.items):
+            sp = PaddedSparseRows.from_scipy_rows(data.items)
+            return self.fit_sparse(sp, labels.array, n=data.n)
+        return super().fit_dataset(data, labels)
+
+    def fit_sparse(self, sp, y, n: Optional[int] = None):
+        """Fit from a PaddedSparseRows feature matrix."""
+        if self.fit_intercept:
+            raise ValueError(
+                "SparseLBFGSwithL2 does not support fit_intercept: "
+                "centering would densify the features"
+            )
+        n = sp.n if n is None else int(n)
+        y = jnp.asarray(y, jnp.float32)
+        if y.shape[0] < n:
+            raise ValueError(
+                f"labels have {y.shape[0]} rows but the sparse matrix has "
+                f"{n} true rows"
+            )
+        rows = int(sp.indices.shape[0])  # rows >= n (mesh padding)
+        # keep the n true label rows, re-pad to the sparse rows' padding
+        # (label and feature padding may come from different meshes; rows
+        # beyond n are padding on both sides, so this drops no real data)
+        y = y[:rows]
+        if y.shape[0] < rows:
+            y = jnp.pad(y, ((0, rows - y.shape[0]), (0, 0)))
+        w = _lbfgs_sparse_least_squares(
+            sp.indices,
+            sp.values,
+            y,
+            jnp.float32(n),
+            sp.num_features,
+            self.lam,
+            self.num_iterations,
+            self.history,
+        )
+        return LinearMapper(w, None)
+
+
+@partial(jax.jit, static_argnames=("d", "num_iterations", "history"))
+def _lbfgs_sparse_least_squares(idx, vals, y, n, d, lam, num_iterations, history):
+    """L-BFGS least squares on padded-COO features: the model (d, k) is
+    replicated; per-iteration work is a row-sharded gather-matvec forward
+    and a scatter-add gradient, all-reduced over the mesh — the sparse
+    analogue of the dense path's einsum + psum."""
+    from keystone_tpu.ops.sparse import sparse_grad, sparse_matmul
+
+    idx = constrain(idx, DATA_AXIS)
+    vals = constrain(vals, DATA_AXIS)
+    y = constrain(y, DATA_AXIS)
+    row_ok = (jnp.arange(y.shape[0]) < n).astype(jnp.float32)[:, None]
+    y = y * row_ok
+    vals = vals * row_ok  # padding rows contribute nothing anywhere
+
+    def value_and_grad(w):
+        r = sparse_matmul(idx, vals, w) - y  # (rows, k), row-sharded
+        f = 0.5 * jnp.vdot(r, r) / n + 0.5 * lam * jnp.vdot(w, w)
+        g = constrain(sparse_grad(idx, vals, r, d)) / n + lam * w
+        return f, g
+
+    w0 = jnp.zeros((d, y.shape[1]), jnp.float32)
+    return lbfgs_minimize(
+        value_and_grad, w0, max_iter=num_iterations, history=history
+    )
 
 
 @partial(jax.jit, static_argnames=("num_iterations", "history", "fit_intercept"))

@@ -49,6 +49,18 @@ class LinearMapper(Transformer):
             out = out + self.intercept
         return out
 
+    def apply_dataset(self, ds):
+        # sparse scoring (LBFGS.scala sparse path): score scipy rows by
+        # gathering weight rows — never densify n×d at huge vocab
+        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+
+        if ds.is_host and is_scipy_sparse_rows(ds.items):
+            sp = PaddedSparseRows.from_scipy_rows(
+                ds.items, num_features=self.weights.shape[0]
+            )
+            return ds.with_array(sp.matmul(self.weights, self.intercept))
+        return super().apply_dataset(ds)
+
 
 class LinearMapEstimator(LabelEstimator):
     """Exact ridge least squares via normal equations

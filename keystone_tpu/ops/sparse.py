@@ -1,0 +1,136 @@
+"""TPU-native sparse feature representation: padded COO rows.
+
+Reference: nodes/learning/LBFGS.scala § LeastSquaresSparseGradient — the
+reference keeps CSR feature rows on executors and computes least-squares
+gradients without ever densifying the n×d matrix (SURVEY.md §2.2).
+
+The TPU analogue is pad-and-mask, the same strategy the framework uses
+for ragged descriptor sets: each row carries up to ``nnz_max``
+(index, value) pairs, padding entries have value 0.0 (index 0), so they
+contribute nothing to either the forward gather-matvec or the gradient
+scatter-add — no separate mask array is needed.  Memory is n·nnz·8 bytes
+instead of n·d·4: at a 100k+ vocabulary and ~10² nonzeros per document
+this is ~3 orders of magnitude smaller, which is what lets the text
+pipelines run at realistic vocab sizes without densifying.
+
+Shapes are static (nnz_max fixed at construction), so everything jits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from keystone_tpu.parallel import mesh as _mesh
+
+
+def is_scipy_sparse_rows(items) -> bool:
+    """True for a non-empty sequence of scipy sparse row vectors."""
+    return len(items) > 0 and all(
+        hasattr(r, "tocoo") and hasattr(r, "shape") for r in items[:2]
+    )
+
+
+class PaddedSparseRows:
+    """(n, nnz_max) int32 indices + float32 values + feature count.
+
+    ``indices``/``values`` live on device, row-sharded over the mesh
+    'data' axis like any Dataset array; rows past ``n`` and entries past
+    a row's true nnz are value-0 padding.
+    """
+
+    def __init__(self, indices, values, num_features: int, n: Optional[int] = None,
+                 shard: bool = True):
+        self.n = int(np.shape(indices)[0] if n is None else n)
+        self.num_features = int(num_features)
+        if shard:
+            self.indices = _mesh.shard_batch(np.asarray(indices, np.int32))
+            self.values = _mesh.shard_batch(np.asarray(values, np.float32))
+        else:
+            self.indices = jnp.asarray(indices, jnp.int32)
+            self.values = jnp.asarray(values, jnp.float32)
+
+    @property
+    def nnz_max(self) -> int:
+        return int(self.indices.shape[1])
+
+    @property
+    def shape(self):
+        return (self.n, self.num_features)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.indices.size * 4 + self.values.size * 4)
+
+    @staticmethod
+    def from_scipy_rows(
+        rows: Sequence, num_features: Optional[int] = None
+    ) -> "PaddedSparseRows":
+        """Build from scipy sparse row vectors (what ``Sparsify`` emits)."""
+        coos = [r.tocoo() for r in rows]
+        d = int(num_features if num_features is not None else coos[0].shape[-1])
+        nnz_max = max(1, max((c.nnz for c in coos), default=1))
+        n = len(coos)
+        idx = np.zeros((n, nnz_max), np.int32)
+        val = np.zeros((n, nnz_max), np.float32)
+        for i, c in enumerate(coos):
+            idx[i, : c.nnz] = c.col
+            val[i, : c.nnz] = c.data
+        return PaddedSparseRows(idx, val, d, n=n)
+
+    @staticmethod
+    def from_dense(x, threshold: float = 0.0) -> "PaddedSparseRows":
+        x = np.asarray(x)
+        mask = np.abs(x) > threshold
+        nnz_max = max(1, int(mask.sum(axis=1).max()))
+        n, d = x.shape
+        idx = np.zeros((n, nnz_max), np.int32)
+        val = np.zeros((n, nnz_max), np.float32)
+        for i in range(n):
+            cols = np.nonzero(mask[i])[0]
+            idx[i, : cols.size] = cols
+            val[i, : cols.size] = x[i, cols]
+        return PaddedSparseRows(idx, val, d, n=n)
+
+    def toarray(self) -> np.ndarray:
+        """Dense (n, d) host copy (tests / small data only)."""
+        idx = np.asarray(self.indices)[: self.n]
+        val = np.asarray(self.values)[: self.n]
+        out = np.zeros((self.n, self.num_features), np.float32)
+        for i in range(self.n):
+            np.add.at(out[i], idx[i], val[i])
+        return out
+
+    def matmul(self, w, intercept=None):
+        """Gather-based ``X @ w`` without densifying: (n_rows, k)."""
+        out = sparse_matmul(self.indices, self.values, jnp.asarray(w))
+        if intercept is not None:
+            out = out + intercept
+        return out
+
+
+def sparse_matmul(indices, values, w):
+    """(rows, nnz) COO × (d, k) → (rows, k): gather rows of w, weight, sum.
+
+    Padding entries (value 0) contribute nothing regardless of index."""
+    wg = w[indices]  # (rows, nnz, k)
+    return jnp.einsum(
+        "rn,rnk->rk", values, wg, preferred_element_type=jnp.float32
+    )
+
+
+def sparse_grad(indices, values, r, d):
+    """``Xᵀ r`` by scatter-add: (d, k) from (rows, nnz) COO and (rows, k).
+
+    Duplicate indices accumulate (jnp ``.at[].add``); padding entries add
+    zero."""
+    k = r.shape[1]
+    contrib = values[..., None] * r[:, None, :]  # (rows, nnz, k)
+    return (
+        jnp.zeros((d, k), jnp.float32)
+        .at[indices.reshape(-1)]
+        .add(contrib.reshape(-1, k))
+    )

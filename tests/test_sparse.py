@@ -1,0 +1,116 @@
+"""Sparse-gradient text path (VERDICT r1 item 7).
+
+The reference's LBFGS.scala § LeastSquaresSparseGradient computes
+least-squares gradients from CSR without densifying n×d; the TPU
+analogue is padded-COO gather/scatter (ops/sparse.py).  These tests pin:
+solver parity with the dense solver on the same data, the huge-vocab
+memory win, and the end-to-end Sparsify → SparseLBFGS → sparse-scoring
+pipeline flow.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Dataset, Pipeline
+
+
+def _sparse_problem(rng, n, d, k, nnz):
+    """Random sparse rows + targets from a sparse ground-truth model."""
+    idx = np.stack([rng.choice(d, size=nnz, replace=False) for _ in range(n)])
+    val = rng.normal(size=(n, nnz)).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32) * 0.3
+    dense = np.zeros((n, d), np.float32)
+    for i in range(n):
+        dense[i, idx[i]] = val[i]
+    y = (dense @ w_true + 0.05 * rng.normal(size=(n, k))).astype(np.float32)
+    return idx.astype(np.int32), val, dense, y
+
+
+def test_padded_sparse_rows_roundtrip_and_matmul():
+    from keystone_tpu.ops.sparse import PaddedSparseRows
+
+    rng = np.random.default_rng(0)
+    idx, val, dense, _ = _sparse_problem(rng, 32, 200, 3, 7)
+    sp = PaddedSparseRows(idx, val, 200)
+    np.testing.assert_allclose(sp.toarray(), dense, atol=1e-6)
+    w = rng.normal(size=(200, 5)).astype(np.float32)
+    got = np.asarray(sp.matmul(jnp.asarray(w)))[: sp.n]
+    np.testing.assert_allclose(got, dense @ w, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_lbfgs_matches_dense_lbfgs():
+    """Same data, same loss: the sparse-gradient solver must land on the
+    dense solver's optimum (overlapping vocab = every feature here)."""
+    from keystone_tpu.models import DenseLBFGSwithL2, SparseLBFGSwithL2
+    from keystone_tpu.ops.sparse import PaddedSparseRows
+
+    rng = np.random.default_rng(1)
+    idx, val, dense, y = _sparse_problem(rng, 256, 400, 4, 12)
+    lam = 1e-2
+
+    dense_model = DenseLBFGSwithL2(lam=lam, num_iterations=80).fit_arrays(dense, y)
+    sp = PaddedSparseRows(idx, val, 400)
+    sparse_model = SparseLBFGSwithL2(lam=lam, num_iterations=80).fit_sparse(
+        sp, jnp.asarray(y)
+    )
+    wd = np.asarray(dense_model.weights)
+    ws = np.asarray(sparse_model.weights)
+    scale = np.abs(wd).max() + 1e-9
+    assert np.abs(ws - wd).max() / scale < 2e-2, np.abs(ws - wd).max() / scale
+
+
+def test_sparse_fit_at_huge_vocab_without_densifying():
+    """d = 200k: the dense matrix would be ~400 MB; the padded-COO form
+    is ~3 orders smaller and the fit still runs and predicts."""
+    from keystone_tpu.models import SparseLBFGSwithL2
+    from keystone_tpu.ops.sparse import PaddedSparseRows
+
+    rng = np.random.default_rng(2)
+    n, d, k, nnz = 512, 200_000, 4, 24
+    idx = np.stack([rng.choice(d, size=nnz, replace=False) for _ in range(n)])
+    val = np.abs(rng.normal(size=(n, nnz))).astype(np.float32)
+    lab = rng.integers(0, k, size=n)
+    # class-dependent signal: shift indices into a class-specific band
+    idx = (idx // k) * k + lab[:, None]
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    sp = PaddedSparseRows(idx.astype(np.int32), val, d)
+    dense_bytes = n * d * 4
+    assert sp.nbytes * 100 < dense_bytes, (sp.nbytes, dense_bytes)
+
+    model = SparseLBFGSwithL2(lam=1e-3, num_iterations=30).fit_sparse(
+        sp, jnp.asarray(y)
+    )
+    w = np.asarray(model.weights)
+    assert np.isfinite(w).all()
+    pred = np.argmax(np.asarray(sp.matmul(model.weights)), axis=1)[:n]
+    assert (pred == lab).mean() > 0.9
+
+
+def test_sparsify_to_sparse_lbfgs_pipeline_and_scoring():
+    """End-to-end DSL flow: dense rows → Sparsify (host CSR items) →
+    SparseLBFGSwithL2 (sparse gradient fit) → sparse gather scoring →
+    MaxClassifier, without densifying inside the solver."""
+    from keystone_tpu.models import SparseLBFGSwithL2
+    from keystone_tpu.ops import MaxClassifier, Sparsify
+
+    rng = np.random.default_rng(3)
+    n, d, k = 128, 300, 3
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    dense = (rng.uniform(size=(n, d)) < 0.05).astype(np.float32) * rng.normal(
+        size=(n, d)
+    ).astype(np.float32)
+    lab = np.argmax(dense @ w_true, axis=1).astype(np.int32)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    pipe = Pipeline.of(Sparsify()).and_then(
+        SparseLBFGSwithL2(lam=1e-4, num_iterations=60),
+        Dataset(dense),
+        Dataset(y),
+    ).and_then(MaxClassifier())
+    fitted = pipe.fit()
+    pred = fitted(Dataset(dense)).get().numpy().ravel()[:n]
+    assert (pred == lab).mean() > 0.95
