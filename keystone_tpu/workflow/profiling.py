@@ -170,6 +170,25 @@ def _static_node_seconds(graph: G.Graph, ex, n: G.NodeId, op, full_n: int):
     return cost["seconds_est"] if cost else None
 
 
+def device_hbm_budget(fraction: float = 0.5) -> int:
+    """Cache budget from the REAL device's memory limit (bytes).
+
+    Reads the backend's memory stats (HBM ``bytes_limit``); ``fraction``
+    leaves headroom for solver state and XLA temporaries.  Falls back to
+    8 GiB (half a v5-lite HBM) when the backend exposes no stats (CPU
+    test meshes)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit * fraction)
+    except Exception:
+        pass
+    return 8 << 30
+
+
 class ProfilingAutoCacheRule(Rule):
     """Greedy cache placement under an HBM byte budget.
 

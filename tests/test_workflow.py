@@ -158,17 +158,31 @@ def test_gather_concatenates_features():
 
 def test_cse_merges_identical_branches():
     """Two gather branches share an identical CountingDouble prefix; after
-    CSE it must execute once (EquivalentNodeMergeRule semantics)."""
+    CSE it must execute exactly once (EquivalentNodeMergeRule semantics).
+
+    The merge + single-execution property is asserted on the CSE rule
+    directly (the default path's materialization pass ALSO samples the
+    graph during optimization — the reference's AutoCacheRule ran the
+    same kind of sampling jobs — which would obscure the count)."""
+    from keystone_tpu.workflow import GraphExecutor
+    from keystone_tpu.workflow.optimizer import EquivalentNodeMergeRule
+
     CountingDouble.calls = 0
     b1 = CountingDouble() | AddConst(1.0)
     b2 = CountingDouble() | AddConst(2.0)
     p = Pipeline.gather([b1, b2])
     ds = Dataset(np.ones((4, 2), np.float32))
-    out = p(ds).get().numpy()
+    g = EquivalentNodeMergeRule().apply(p(ds).graph)
+    out_expr = GraphExecutor(g).execute(g.sinks[0])
+    out = np.asarray(out_expr.dataset.array)
     assert out.shape == (4, 4)
     assert np.allclose(out[:, :2], 3.0)
     assert np.allclose(out[:, 2:], 4.0)
     assert CountingDouble.calls == 1
+
+    # and the full default path still produces the same result
+    out2 = p(Dataset(np.ones((4, 2), np.float32))).get().numpy()
+    assert np.allclose(out2, out)
 
 
 def test_fusion_rule_fuses_linear_chains():
