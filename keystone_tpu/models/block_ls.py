@@ -266,33 +266,106 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "bcd_epoch.npz")
         # fingerprint the problem: resuming a checkpoint from different
-        # data/labels/λ would silently break the P = Σ X_b W_b invariant
+        # data/labels/λ would silently break the P = Σ X_b W_b invariant.
+        # Hash probe ROWS of each process's addressable shard (order-
+        # sensitive: permutation-invariant scalar moments would accept a
+        # reshuffled dataset and resume a stale W/P pair) and allgather
+        # the per-process digests so the fingerprint is identical on
+        # every process.
         import hashlib
 
+        from keystone_tpu.parallel.multihost import gather_to_host, global_from_host
+
+        def _probe_digest(*arrays) -> int:
+            h = hashlib.sha256()
+            for a in arrays:
+                shards = getattr(a, "addressable_shards", None)
+                loc = np.asarray(shards[0].data) if shards else np.asarray(a)
+                h.update(loc[0].tobytes())
+                h.update(loc[-1].tobytes())
+            return int.from_bytes(h.digest()[:8], "little")
+
+        local_digest = np.asarray([_probe_digest(x, y)], np.uint64)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            digests = tuple(
+                np.asarray(
+                    multihost_utils.process_allgather(local_digest)
+                ).ravel().tolist()
+            )
+        else:
+            digests = tuple(local_digest.tolist())
         fp = hashlib.sha256()
-        fp.update(repr((x.shape, y.shape, int(n), self.lam, self.block_size)).encode())
-        fp.update(np.asarray(x[0]).tobytes())
-        fp.update(np.asarray(y[0]).tobytes())
+        fp.update(
+            repr(
+                (x.shape, y.shape, int(n), self.lam, self.block_size, digests)
+            ).encode()
+        )
         problem = fp.hexdigest()
 
-        start = 0
-        w = jnp.zeros((nb, bs, k), jnp.float32)
-        p = jnp.zeros_like(yc)
-        if os.path.exists(path):
+        def _read_checkpoint():
+            """(resume_epoch+1, w_host, p_host) or (0, zeros, zeros)."""
+            w0 = np.zeros((nb, bs, k), np.float32)
+            p0 = np.zeros(yc.shape, np.float32)
+            if not os.path.exists(path):
+                return 0, w0, p0
             try:
                 with np.load(path) as z:
                     if str(z["problem"]) == problem:
-                        start = int(z["epoch"]) + 1
-                        w = jnp.asarray(z["w"])
-                        p = jnp.asarray(z["p"])
+                        return int(z["epoch"]) + 1, z["w"], z["p"]
             except Exception:
                 pass  # unreadable/corrupt checkpoint: fit from scratch
+            return 0, w0, p0
+
+        if jax.process_count() > 1:
+            # processes must enter the epoch loop at the SAME iteration
+            # (every sweep runs collectives): process 0's checkpoint
+            # decision is broadcast, never decided per-process — a silent
+            # local read failure would desynchronize and deadlock
+            from jax.experimental import multihost_utils
+
+            if jax.process_index() == 0:
+                start, w_h, p_h = _read_checkpoint()
+            else:
+                start = 0
+                w_h = np.zeros((nb, bs, k), np.float32)
+                p_h = np.zeros(yc.shape, np.float32)
+            start, w_h, p_h = multihost_utils.broadcast_one_to_all(
+                (np.int32(start), np.asarray(w_h), np.asarray(p_h))
+            )
+            start = int(start)
+        else:
+            start, w_h, p_h = _read_checkpoint()
+
+        w = jnp.zeros((nb, bs, k), jnp.float32)
+        p = jnp.zeros_like(yc)
+        if start > 0:
+            # restore with mesh-wide shardings (w replicated, p like the
+            # labels) — the host copies exist on every process
+            mesh = getattr(yc.sharding, "mesh", None)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                w_sharding = NamedSharding(mesh, PartitionSpec())
+            else:
+                w_sharding = w.sharding
+            w = global_from_host(w_h, w_sharding)
+            p = global_from_host(p_h, yc.sharding)
         for e in range(start, self.num_iter):
             w, p = _bcd_epoch(xb, yc, nf, self.lam, w, p)
             jax.block_until_ready(w)
-            # atomic write: a crash mid-save must not destroy the checkpoint
-            tmp = path + ".tmp.npz"  # np.savez appends .npz to bare names
-            np.savez(tmp, epoch=e, w=np.asarray(w), p=np.asarray(p), problem=problem)
+            # atomic write: a crash mid-save must not destroy the
+            # checkpoint; per-process tmp names so concurrent writers on
+            # a shared dir never truncate each other mid-write
+            tmp = f"{path}.tmp.{jax.process_index()}.npz"
+            np.savez(
+                tmp,
+                epoch=e,
+                w=gather_to_host(w),
+                p=gather_to_host(p),
+                problem=problem,
+            )
             os.replace(tmp, path)
         return finish_block_model(
             w, xm, ym, x.shape[1], self.block_size, self.fit_intercept

@@ -103,6 +103,32 @@ def process_batch_slice(global_n: int) -> slice:
     return slice(start, min(start + per, global_n))
 
 
+def gather_to_host(arr) -> np.ndarray:
+    """Full host copy of a (possibly multi-process global) array.
+
+    Single-process: plain ``np.asarray``.  Multi-process: every host
+    gets the full array via ``process_allgather`` — the checkpoint-save
+    path for sharded solver state, where a bare ``np.asarray`` would
+    raise on non-addressable shards."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def global_from_host(host_array, sharding):
+    """Place a full host copy (present on EVERY process) as a global
+    array with the given sharding — the checkpoint-restore inverse of
+    :func:`gather_to_host`."""
+    host_array = np.asarray(host_array)
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx]
+    )
+
+
 def make_global_dataset(host_array, global_n: Optional[int] = None):
     """Assemble a globally-sharded Dataset from per-host shards via
     jax.make_array_from_process_local_data (multi-host path), or a plain

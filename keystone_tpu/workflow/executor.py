@@ -42,10 +42,19 @@ class TransformerExpr:
 
 
 class GraphExecutor:
-    def __init__(self, graph: G.Graph, profile: bool = False):
+    def __init__(
+        self, graph: G.Graph, profile: bool = False, node_retries: int = 0
+    ):
+        """``node_retries``: re-run a failed stage up to this many times
+        before propagating (SURVEY §5 "failure detection/elastic
+        recovery" — the coarse analogue of Spark task retry: stages are
+        pure functions of memoized inputs, so re-running one is always
+        safe).  Deterministic failures still propagate after the budget;
+        process-level recovery is workflow/recovery.py."""
         self.graph = graph
         self.results: Dict[G.GraphId, Any] = {}
         self.profile = profile
+        self.node_retries = max(0, int(node_retries))
         self.timings: Dict[G.NodeId, float] = {}
 
     def execute(self, target: G.GraphId):
@@ -63,7 +72,20 @@ class GraphExecutor:
         op = self.graph.operators[target]
         deps = [self._eval(d) for d in self.graph.dependencies[target]]
         t0 = time.perf_counter() if self.profile else 0.0
-        result = self._execute_op(op, deps)
+        for attempt in range(self.node_retries + 1):
+            try:
+                result = self._execute_op(op, deps)
+                break
+            except Exception as e:
+                if attempt >= self.node_retries:
+                    raise
+                logger.warning(
+                    "stage %s failed (%s); retry %d/%d",
+                    op.label(),
+                    e,
+                    attempt + 1,
+                    self.node_retries,
+                )
         if self.profile:
             _sync_expr(result)
             self.timings[target] = time.perf_counter() - t0

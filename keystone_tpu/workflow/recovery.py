@@ -1,0 +1,93 @@
+"""Coarse-grained failure recovery for pipeline fits.
+
+SURVEY.md §5 "Failure detection/elastic recovery": the reference
+delegated everything to Spark — lineage recompute of lost partitions,
+task retry, speculative execution.  The TPU-era decomposition here:
+
+- **stage retry** (executor.GraphExecutor ``node_retries``): stages are
+  pure functions of memoized inputs, so a transiently-failed stage
+  (preempted device, flaky interconnect) is simply re-run — the lineage-
+  recompute analogue at node granularity.
+- **process-level restart + resume** (this module): when a whole
+  process dies (host failure, killed Gloo peer), the surviving state is
+  what was durably saved — pipeline-prefix materializations
+  (workflow/state.py, reloaded by SavedStateLoadRule) and per-epoch
+  solver checkpoints (``fit_checkpointed`` /
+  ``fit_store(checkpoint_dir=...)``).  ``fit_with_recovery`` wraps the
+  build-fit cycle so a restarted attempt resumes from both instead of
+  recomputing.  In a multi-process job every process must restart
+  together (collectives are SPMD); the fault-injection test
+  (tests/test_faulttol.py) exercises exactly that: kill one of two Gloo
+  processes mid-fit, relaunch, assert the fit resumes from the epoch
+  checkpoint and matches an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def fit_with_recovery(
+    build_fn: Callable,
+    state_dir: Optional[str] = None,
+    max_restarts: int = 2,
+) -> Tuple[object, int]:
+    """Fit with in-process restart + saved-state resume.
+
+    ``build_fn() -> Pipeline`` builds the UNFITTED pipeline (training
+    data loading belongs inside it).  Each attempt fits; on failure the
+    pipeline is rebuilt and refitted.  With ``state_dir`` set,
+    previously-saved prefix materializations reload via
+    SavedStateLoadRule (PipelineEnv wiring), and solvers configured with
+    a ``checkpoint_dir`` resume from their last completed epoch — so a
+    retry resumes rather than recomputes.
+
+    Returns ``(fitted, attempts_used)``.  Raises the last error once
+    ``max_restarts`` is exhausted.
+    """
+    import jax
+
+    from keystone_tpu.workflow.pipeline import PipelineEnv
+
+    if max_restarts > 0 and jax.process_count() > 1:
+        # collectives are SPMD: a locally-restarted attempt would rerun
+        # collectives its peers never see and hang the job.  Multi-process
+        # restart is job-level (relaunch every process; the saved state
+        # and solver checkpoints make the relaunch resume) — fail fast
+        # here instead of deadlocking.
+        logger.warning(
+            "fit_with_recovery: in-process retry disabled under "
+            "multi-process execution (%d processes); restart the job to "
+            "recover",
+            jax.process_count(),
+        )
+        max_restarts = 0
+
+    prev_state_dir = PipelineEnv.state_dir
+    if state_dir is not None:
+        PipelineEnv.state_dir = state_dir
+    try:
+        last_err: Optional[BaseException] = None
+        for attempt in range(max_restarts + 1):
+            try:
+                fitted = build_fn().fit()
+                # force materialization so failures surface HERE, inside
+                # the retry scope, not at first use of the fitted model
+                fitted.block_until_ready()
+                return fitted, attempt
+            except Exception as e:
+                last_err = e
+                if attempt >= max_restarts:
+                    raise
+                logger.warning(
+                    "fit attempt %d failed (%s); restarting (%d left)",
+                    attempt,
+                    e,
+                    max_restarts - attempt,
+                )
+        raise last_err  # unreachable; keeps type checkers calm
+    finally:
+        PipelineEnv.state_dir = prev_state_dir

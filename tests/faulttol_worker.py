@@ -1,0 +1,94 @@
+"""Worker for the fault-injection test (test_faulttol.py).
+
+Two Gloo-connected processes fit a BlockLeastSquares solver with
+per-epoch checkpointing.  In "crash" mode, process 1 calls ``os._exit``
+before launching its 4th epoch sweep — mid-fit, between collectives —
+simulating a host failure.  In "resume" mode the workers relaunch with
+the same checkpoint dir, must resume from the last completed epoch
+(asserted: the checkpoint exists and its epoch > 0), finish the fit,
+and print a digest of the final weights.  The parent test compares the
+resumed digest against an uninterrupted control run's digest — recovery
+must land on EXACTLY the same model.
+"""
+
+import hashlib
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_procs, pid, mode, ckpt_dir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],  # crash | resume | control
+        sys.argv[5],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from keystone_tpu.parallel import multihost, set_mesh
+
+    multihost.initialize(
+        coordinator_address=coordinator, num_processes=num_procs, process_id=pid
+    )
+    mesh = multihost.hybrid_mesh(model_parallelism=1)
+    set_mesh(mesh)
+
+    import numpy as np
+
+    import keystone_tpu.models.block_ls as bls
+
+    rng = np.random.default_rng(0)
+    n, d, k = 256, 48, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    y = (x @ w_true + 0.01 * rng.normal(size=(n, k))).astype(np.float32)
+
+    sl = multihost.process_batch_slice(n)
+    data = multihost.make_global_dataset(x[sl], global_n=n)
+    labels = multihost.make_global_dataset(y[sl], global_n=n)
+
+    crash_after = 3  # completed epoch sweeps before the injected death
+    if mode == "crash" and pid == 1:
+        orig = bls._bcd_epoch
+        calls = {"n": 0}
+
+        def crashing(*args):
+            if calls["n"] >= crash_after:
+                sys.stderr.write("FAULT: injected crash before epoch %d\n" % calls["n"])
+                sys.stderr.flush()
+                os._exit(42)
+            calls["n"] += 1
+            return orig(*args)
+
+        bls._bcd_epoch = crashing
+
+    ckpt_path = os.path.join(ckpt_dir, "bcd_epoch.npz")
+    if mode == "resume":
+        # recovery must actually RESUME: the crash run left epochs 0..2
+        assert os.path.exists(ckpt_path), "no checkpoint survived the crash"
+        with np.load(ckpt_path) as z:
+            resumed_epoch = int(z["epoch"])
+        assert resumed_epoch >= 1, resumed_epoch
+        print(f"RESUMED_FROM {resumed_epoch}", flush=True)
+
+    est = bls.BlockLeastSquaresEstimator(
+        block_size=16, num_iter=6, lam=1e-3, fit_intercept=False
+    )
+    model = est.fit_checkpointed(data, labels, checkpoint_dir=ckpt_dir)
+
+    w = np.asarray(model.flat_weights, np.float64)
+    digest = hashlib.sha256(np.round(w, 4).tobytes()).hexdigest()[:16]
+    err = np.abs(w[:d] - np.linalg.solve(
+        x.astype(np.float64).T @ x + 1e-3 * n * np.eye(d),
+        x.astype(np.float64).T @ y,
+    )).max()
+    print(f"FAULTTOL_OK pid={pid} mode={mode} digest={digest} err={err:.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
