@@ -286,20 +286,19 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             return int.from_bytes(h.digest()[:8], "little")
 
         local_digest = np.asarray([_probe_digest(x, y)], np.uint64)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            digests = tuple(
-                np.asarray(
-                    multihost_utils.process_allgather(local_digest)
-                ).ravel().tolist()
-            )
-        else:
-            digests = tuple(local_digest.tolist())
+        digests = tuple(gather_to_host(local_digest).ravel().tolist())
         fp = hashlib.sha256()
         fp.update(
             repr(
-                (x.shape, y.shape, int(n), self.lam, self.block_size, digests)
+                (
+                    x.shape,
+                    y.shape,
+                    int(n),
+                    self.lam,
+                    self.block_size,
+                    bool(self.fit_intercept),
+                    digests,
+                )
             ).encode()
         )
         problem = fp.hexdigest()

@@ -39,6 +39,10 @@ class BlockKernelMatrix:
         self.num_blocks = -(-self.n // self.block_size)
         self._cache: "OrderedDict[Tuple[int, int], jnp.ndarray]" = OrderedDict()
         self._cache_blocks = int(cache_blocks)
+        # assembled (n, bs) column blocks, cached whole: the BCD sweep
+        # rereads columns across epochs, and re-concatenating tiles per
+        # access would copy the full n² every epoch
+        self._col_cache: "OrderedDict[int, jnp.ndarray]" = OrderedDict()
 
     def _rows(self, b: int) -> jnp.ndarray:
         lo = b * self.block_size
@@ -59,16 +63,22 @@ class BlockKernelMatrix:
     def column_block(self, j: int) -> jnp.ndarray:
         """K[:, X_j] — (n, <=bs); the unit the BCD sweep consumes.
 
-        Assembled from (i, j) tiles only when a full sweep's tiles fit
-        in the LRU (num_blocks² ≤ cache_blocks — repeat sweeps then get
-        pure cache hits); otherwise a sweep would insert-then-evict every
-        tile, so compute the column as the single O(n·bs·d) gemm."""
+        Cached WHOLE (one (n, bs) gemm, reread free on later sweeps)
+        when a full sweep's columns fit the budget (num_blocks² tiles ≤
+        cache_blocks ⇔ num_blocks columns); otherwise a sweep would
+        insert-then-evict every entry, so compute without caching."""
         if self.num_blocks == 0:
             return jnp.zeros((0, 0), jnp.float32)
         if self.num_blocks * self.num_blocks <= self._cache_blocks:
-            return jnp.concatenate(
-                [self.block(i, j) for i in range(self.num_blocks)], axis=0
-            )
+            blk = self._col_cache.get(j)
+            if blk is None:
+                blk = self.kernel_gen(self.x, self._rows(j))
+                self._col_cache[j] = blk
+                if len(self._col_cache) > self.num_blocks:
+                    self._col_cache.popitem(last=False)
+            else:
+                self._col_cache.move_to_end(j)
+            return blk
         return self.kernel_gen(self.x, self._rows(j))
 
     def diag_block(self, j: int) -> jnp.ndarray:

@@ -21,6 +21,7 @@ Typical usage (cf. pipelines/images/mnist/MnistRandomFFT.scala):
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import List, Optional, Sequence, Union
 
@@ -46,6 +47,10 @@ class PipelineEnv:
 
     optimizer = None  # lazily constructed default
     state_dir: Optional[str] = None
+    #: stage-retry budget for every executor the pipeline layer creates
+    #: (GraphExecutor node_retries — SURVEY §5 task-retry analogue);
+    #: settable in code or via KEYSTONE_STAGE_RETRIES
+    node_retries: int = int(os.environ.get("KEYSTONE_STAGE_RETRIES", "0"))
     _built_for_state_dir: Optional[str] = None
     _auto_built = None  # the instance get_optimizer constructed itself
     _auto_built_sig = ()  # identity of its rule batches at build time
@@ -214,7 +219,7 @@ class Pipeline(Chainable):
         prefixes run once."""
         opt = PipelineEnv.get_optimizer()
         g = opt.execute(self.graph)
-        ex = GraphExecutor(g)
+        ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
         fitted: dict = {}
         for n in g.topological_nodes():
             if isinstance(g.operators[n], G.EstimatorOperator):
@@ -372,7 +377,7 @@ class PipelineDataset:
         if self._result is None:
             opt = PipelineEnv.get_optimizer()
             g = opt.execute(self.graph)
-            ex = GraphExecutor(g)
+            ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatasetExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected dataset")
@@ -395,7 +400,7 @@ class PipelineDatum:
     def get(self):
         if not self._done:
             g = PipelineEnv.get_optimizer().execute(self.graph)
-            ex = GraphExecutor(g)
+            ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatumExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected datum")

@@ -149,6 +149,18 @@ def test_executor_stage_retry_recovers_transient_failure():
     with pytest.raises(RuntimeError, match="transient"):
         GraphExecutor(lazy.graph, node_retries=2).execute(lazy.graph.sinks[0])
 
+    # and the knob is reachable from the NORMAL pipeline path
+    from keystone_tpu.workflow.pipeline import PipelineEnv
+
+    prev = PipelineEnv.node_retries
+    PipelineEnv.node_retries = 2
+    try:
+        Flaky.fails, Flaky.budget = 0, 2
+        out = Pipeline.of(Flaky())(Dataset(np.ones((4, 2), np.float32))).get()
+        np.testing.assert_allclose(np.asarray(out.array), 2.0)
+    finally:
+        PipelineEnv.node_retries = prev
+
 
 def test_fit_with_recovery_restarts_and_resumes(tmp_path):
     """fit_with_recovery: a build_fn whose first attempt dies mid-fit is

@@ -270,6 +270,26 @@ def test_krr_matches_direct_dual_solve():
     np.testing.assert_allclose(pred, Kt @ alpha_ref, atol=1e-2)
 
 
+def test_krr_cached_blocks_matches_recompute():
+    """cache_kernel_blocks=True (BlockKernelMatrix LRU sweep, the
+    reference's cached-RDD strategy) must produce the same dual
+    coefficients as the inlined recompute sweep — including with padding
+    (n not a block multiple)."""
+    rng = np.random.default_rng(11)
+    n, d, k = 53, 5, 2
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n, k)).astype(np.float32)
+    kern = GaussianKernelGenerator(0.4)
+    kwargs = dict(lam=1e-2, block_size=16, num_epochs=8)
+    plain = KernelRidgeRegressionEstimator(kern, **kwargs).fit_arrays(x, y)
+    cached = KernelRidgeRegressionEstimator(
+        kern, cache_kernel_blocks=True, **kwargs
+    ).fit_arrays(x, y)
+    np.testing.assert_allclose(
+        np.asarray(cached.alpha)[:n], np.asarray(plain.alpha)[:n], atol=2e-4
+    )
+
+
 def test_solvers_in_pipeline_with_sharded_padding():
     """End-to-end through the DSL with a non-divisible row count."""
     rng = np.random.default_rng(10)
