@@ -82,31 +82,30 @@ class BlockKernelMatrix:
         return self.kernel_gen(self.x, self._rows(j))
 
     def diag_block(self, j: int) -> jnp.ndarray:
+        """K[X_j, X_j]; reads through the column cache in the cached
+        regime so the SAME n² budget serves every access path."""
+        if self.num_blocks * self.num_blocks <= self._cache_blocks:
+            lo = j * self.block_size
+            return self.column_block(j)[lo : lo + self.block_size]
         return self.block(j, j)
 
     def matvec(self, v: jnp.ndarray) -> jnp.ndarray:
         """K @ v computed blockwise (n never squares in memory).
 
-        Goes tile-by-tile through the LRU only when every tile fits
-        (num_blocks² ≤ cache_blocks — repeat matvecs then recompute
-        nothing); otherwise streams column gemms without polluting the
-        cache."""
+        Reads through the column cache when a full sweep fits the budget
+        (repeat matvecs and BCD sweeps then share one cached copy of K);
+        otherwise streams column gemms without polluting the cache."""
         if self.num_blocks == 0:
             return jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
-        if self.num_blocks * self.num_blocks <= self._cache_blocks:
-            parts = []
-            for i in range(self.num_blocks):
-                acc = None
-                for j in range(self.num_blocks):
-                    lo = j * self.block_size
-                    vj = v[lo : lo + self.block_size]
-                    term = self.block(i, j) @ vj
-                    acc = term if acc is None else acc + term
-                parts.append(acc)
-            return jnp.concatenate(parts, axis=0)
+        cached = self.num_blocks * self.num_blocks <= self._cache_blocks
         out = jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
         for j in range(self.num_blocks):
             lo = j * self.block_size
             vj = v[lo : lo + self.block_size]
-            out = out + self.kernel_gen(self.x, self._rows(j)) @ vj
+            kcol = (
+                self.column_block(j)
+                if cached
+                else self.kernel_gen(self.x, self._rows(j))
+            )
+            out = out + kcol @ vj
         return out

@@ -195,9 +195,13 @@ def _krr_fit_cached(x, y, n, kern, lam, bs, num_epochs):
     the cache is a host-side structure — with each block update jitted."""
     from keystone_tpu.models.kernel_matrix import BlockKernelMatrix
 
+    # fits always use solver-grade (true f32) kernel gemms, matching
+    # _krr_fit — the cache flag must not silently relax solve numerics
+    kern = dataclasses.replace(kern, solver_grade=True)
     n_rows = x.shape[0]
     nb = n_rows // bs
     row_ok = (jnp.arange(n_rows) < n).astype(jnp.float32)
+    x = constrain(x, DATA_AXIS)  # kernel gemms contract over the data axis
     y = jnp.asarray(y, jnp.float32) * row_ok[:, None]
     # capacity nb²: every tile of every column block stays cached, so
     # epochs >= 2 recompute nothing (full-K HBM residency — the caller

@@ -62,25 +62,11 @@ class DaisyExtractor(Transformer):
         return self.apply_batch(x[None])[0][0]
 
 
-def _gauss_kernel(sigma: float) -> np.ndarray:
-    r = max(1, int(3.0 * sigma))
-    x = np.arange(-r, r + 1, dtype=np.float32)
-    k = np.exp(-0.5 * (x / sigma) ** 2)
-    return k / k.sum()
-
-
 def _sep_gauss(omap, sigma):
     """Separable Gaussian depthwise blur of (n, h, w, o) maps."""
-    o = omap.shape[-1]
-    k1 = jnp.asarray(_gauss_kernel(sigma))
-    kh = k1.reshape(-1, 1, 1, 1) * jnp.eye(o)[None, None]
-    kw = k1.reshape(1, -1, 1, 1) * jnp.eye(o)[None, None]
-    out = lax.conv_general_dilated(
-        omap, kh, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
-    )
-    return lax.conv_general_dilated(
-        out, kw, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
-    )
+    from keystone_tpu.ops.filters import separable_gaussian_blur
+
+    return separable_gaussian_blur(omap, sigma)
 
 
 @partial(jax.jit, static_argnames=("step", "radius", "rings", "ring_points", "orients"))

@@ -1,0 +1,46 @@
+"""Shared small-filter helpers for the image feature extractors.
+
+One Gaussian-kernel builder and one separable depthwise blur, used by
+dense SIFT (per-scale pre-smoothing) and DAISY (orientation-map
+pooling) — keeping truncation and padding semantics in one place.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def gaussian_kernel1d(sigma: float, truncate: float = 3.0) -> np.ndarray:
+    """Normalized 1-D Gaussian, radius ⌈truncate·σ⌉ (≥1)."""
+    r = max(1, int(np.ceil(truncate * sigma)))
+    xs = np.arange(-r, r + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (xs / sigma) ** 2)
+    return k / k.sum()
+
+
+def separable_gaussian_blur(x, sigma: float):
+    """Depthwise separable Gaussian blur of (n, h, w, c) maps.
+
+    SAME zero padding (matches scipy ``mode="constant"``); accumulation
+    in f32 regardless of input dtype."""
+    c = x.shape[-1]
+    k1 = jnp.asarray(gaussian_kernel1d(sigma))
+    eye = jnp.eye(c)[None, None]
+    out = lax.conv_general_dilated(
+        x,
+        k1.reshape(-1, 1, 1, 1) * eye,
+        (1, 1),
+        "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    return lax.conv_general_dilated(
+        out,
+        k1.reshape(1, -1, 1, 1) * eye,
+        (1, 1),
+        "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )

@@ -39,12 +39,29 @@ class SIFTExtractor(Transformer):
 
     fusable = False
 
-    def __init__(self, step: int = 4, bin_sizes: Sequence[int] = (4,)):
+    def __init__(
+        self,
+        step: int = 4,
+        bin_sizes: Sequence[int] = (4,),
+        smoothing_magnif: float = 6.0,
+    ):
+        #: VLFeat smoothing: before gradients, each scale's image is
+        #: blurred with σ = √((bin/magnif)² − 0.25) (``vl_phow``'s
+        #: convention; the −0.25 discounts the camera's implicit ~0.5px
+        #: blur).  magnif=6 matches VLFeat's default; 0 disables (the
+        #: round-1 behavior, and the single-scale fast path when σ≲0.2).
         self.step = int(step)
         self.bin_sizes = tuple(int(b) for b in bin_sizes)
+        self.smoothing_magnif = float(smoothing_magnif)
 
     def params(self):
-        return (self.step, self.bin_sizes)
+        return (self.step, self.bin_sizes, self.smoothing_magnif)
+
+    def _sigma(self, bin_size: int) -> float:
+        if self.smoothing_magnif <= 0:
+            return 0.0
+        s2 = (bin_size / self.smoothing_magnif) ** 2 - 0.25
+        return float(np.sqrt(s2)) if s2 > 0.04 else 0.0
 
     def apply_batch(self, xs, mask=None):
         xs = jnp.asarray(xs, jnp.float32)
@@ -52,7 +69,15 @@ class SIFTExtractor(Transformer):
             xs = xs[..., 0]
         descs = []
         for b in self.bin_sizes:
-            descs.append(_dsift(xs, self.step, b, mxu=precision.matmul_mode()))
+            descs.append(
+                _dsift(
+                    xs,
+                    self.step,
+                    b,
+                    mxu=precision.matmul_mode(),
+                    sigma=self._sigma(b),
+                )
+            )
         out = jnp.concatenate(descs, axis=1)
         return out, jnp.ones(out.shape[:2], jnp.float32)
 
@@ -81,9 +106,16 @@ def _keypoint_grid(extent: int, step: int, bin_size: int) -> np.ndarray:
     return np.arange(lo, hi, step, dtype=np.int32)
 
 
-@partial(jax.jit, static_argnames=("step", "bin_size", "mxu"))
-def _dsift(imgs, step, bin_size, mxu: str = "f32"):
+@partial(jax.jit, static_argnames=("step", "bin_size", "mxu", "sigma"))
+def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
+    from keystone_tpu.ops.filters import separable_gaussian_blur
+
     n, h, w = imgs.shape
+
+    # --- per-scale Gaussian smoothing (vl_dsift applies it per bin size
+    # when smoothing != 0; separable depthwise conv) ---
+    if sigma > 0.0:
+        imgs = separable_gaussian_blur(imgs[..., None], sigma)[..., 0]
 
     # --- gradients (central differences, like vl_dsift's gradient) ---
     dy = jnp.pad(imgs[:, 2:, :] - imgs[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5

@@ -43,17 +43,27 @@ class TransformerExpr:
 
 class GraphExecutor:
     def __init__(
-        self, graph: G.Graph, profile: bool = False, node_retries: int = 0
+        self,
+        graph: G.Graph,
+        profile: bool = False,
+        node_retries: Optional[int] = None,
     ):
         """``node_retries``: re-run a failed stage up to this many times
         before propagating (SURVEY §5 "failure detection/elastic
         recovery" — the coarse analogue of Spark task retry: stages are
         pure functions of memoized inputs, so re-running one is always
-        safe).  Deterministic failures still propagate after the budget;
-        process-level recovery is workflow/recovery.py."""
+        safe).  Default (None) resolves PipelineEnv.node_retries /
+        KEYSTONE_STAGE_RETRIES, so EVERY executor the framework creates
+        honors the knob without per-site plumbing.  Deterministic
+        failures still propagate after the budget; process-level
+        recovery is workflow/recovery.py."""
         self.graph = graph
         self.results: Dict[G.GraphId, Any] = {}
         self.profile = profile
+        if node_retries is None:
+            from keystone_tpu.workflow.pipeline import PipelineEnv
+
+            node_retries = PipelineEnv.stage_retries()
         self.node_retries = max(0, int(node_retries))
         self.timings: Dict[G.NodeId, float] = {}
 

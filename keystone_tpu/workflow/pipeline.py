@@ -47,10 +47,27 @@ class PipelineEnv:
 
     optimizer = None  # lazily constructed default
     state_dir: Optional[str] = None
-    #: stage-retry budget for every executor the pipeline layer creates
-    #: (GraphExecutor node_retries — SURVEY §5 task-retry analogue);
-    #: settable in code or via KEYSTONE_STAGE_RETRIES
-    node_retries: int = int(os.environ.get("KEYSTONE_STAGE_RETRIES", "0"))
+    #: stage-retry budget for every executor the framework creates
+    #: (GraphExecutor node_retries — SURVEY §5 task-retry analogue).
+    #: None = read KEYSTONE_STAGE_RETRIES at use time (lazy: a malformed
+    #: env value must not crash module import, and post-import env
+    #: changes should take effect); set an int here to override.
+    node_retries: Optional[int] = None
+
+    @classmethod
+    def stage_retries(cls) -> int:
+        if cls.node_retries is not None:
+            return max(0, int(cls.node_retries))
+        raw = os.environ.get("KEYSTONE_STAGE_RETRIES", "0")
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KEYSTONE_STAGE_RETRIES=%r is not an integer; using 0", raw
+            )
+            return 0
     _built_for_state_dir: Optional[str] = None
     _auto_built = None  # the instance get_optimizer constructed itself
     _auto_built_sig = ()  # identity of its rule batches at build time
@@ -219,7 +236,7 @@ class Pipeline(Chainable):
         prefixes run once."""
         opt = PipelineEnv.get_optimizer()
         g = opt.execute(self.graph)
-        ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
+        ex = GraphExecutor(g)
         fitted: dict = {}
         for n in g.topological_nodes():
             if isinstance(g.operators[n], G.EstimatorOperator):
@@ -377,7 +394,7 @@ class PipelineDataset:
         if self._result is None:
             opt = PipelineEnv.get_optimizer()
             g = opt.execute(self.graph)
-            ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
+            ex = GraphExecutor(g)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatasetExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected dataset")
@@ -400,7 +417,7 @@ class PipelineDatum:
     def get(self):
         if not self._done:
             g = PipelineEnv.get_optimizer().execute(self.graph)
-            ex = GraphExecutor(g, node_retries=PipelineEnv.node_retries)
+            ex = GraphExecutor(g)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatumExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected datum")

@@ -166,7 +166,8 @@ def test_vector_splitter_combiner_roundtrip():
 def test_sift_shapes_and_properties():
     rng = np.random.default_rng(7)
     imgs = rng.normal(size=(2, 32, 32)).astype(np.float32)
-    ext = SIFTExtractor(step=4, bin_sizes=(4,))
+    # smoothing off: these pin the unsmoothed descriptor core
+    ext = SIFTExtractor(step=4, bin_sizes=(4,), smoothing_magnif=0)
     desc, mask = ext.apply_batch(jnp.asarray(imgs))
     k = sift_output_count(32, 32, 4, (4,))
     assert desc.shape == (2, k, 128)
@@ -407,9 +408,9 @@ def test_sift_matches_independent_numpy_reference():
             descs.append(d)
     ref = np.stack(descs)
 
-    out, mask = SIFTExtractor(step=step, bin_sizes=(bin_size,)).apply_batch(
-        img[None]
-    )
+    out, mask = SIFTExtractor(
+        step=step, bin_sizes=(bin_size,), smoothing_magnif=0
+    ).apply_batch(img[None])
     np.testing.assert_allclose(np.asarray(out[0]), ref, atol=2e-5, rtol=2e-4)
 
 
@@ -429,6 +430,46 @@ def test_pixel_scaler_only_if_integer():
         np.asarray(PixelScaler().apply_batch(f01 * 255.0)), 0.5
     )
     assert guard.params() != PixelScaler().params()  # distinct CSE identity
+
+
+def test_sift_per_scale_gaussian_smoothing():
+    """VLFeat applies per-scale Gaussian smoothing before gradients
+    (σ = √((bin/magnif)² − 0.25), magnif=6 default).  Pin: the σ
+    schedule, that smoothing is ON by default and changes descriptors,
+    and that it equals blur-then-unsmoothed-extract (self-consistency)."""
+    from scipy.ndimage import gaussian_filter
+
+    from keystone_tpu.ops import SIFTExtractor
+
+    ext = SIFTExtractor(step=5, bin_sizes=(4, 8))
+    assert ext._sigma(4) == pytest.approx(np.sqrt((4 / 6) ** 2 - 0.25), abs=1e-6)
+    assert ext._sigma(8) == pytest.approx(np.sqrt((8 / 6) ** 2 - 0.25), abs=1e-6)
+    assert SIFTExtractor(step=5, smoothing_magnif=0)._sigma(4) == 0.0
+
+    rng = np.random.default_rng(5)
+    imgs = rng.uniform(0, 1, (2, 40, 40)).astype(np.float32)
+    smoothed, _ = SIFTExtractor(step=5, bin_sizes=(8,)).apply_batch(
+        jnp.asarray(imgs)
+    )
+    plain, _ = SIFTExtractor(
+        step=5, bin_sizes=(8,), smoothing_magnif=0
+    ).apply_batch(jnp.asarray(imgs))
+    assert np.abs(np.asarray(smoothed) - np.asarray(plain)).max() > 1e-3
+
+    # self-consistency: smoothing inside == scipy blur outside + no smoothing
+    sigma = ext._sigma(8)
+    blurred = np.stack(
+        [
+            gaussian_filter(im, sigma, mode="constant", truncate=3.0)
+            for im in imgs
+        ]
+    ).astype(np.float32)  # mode="constant" = the conv's SAME zero padding
+    via_scipy, _ = SIFTExtractor(
+        step=5, bin_sizes=(8,), smoothing_magnif=0
+    ).apply_batch(jnp.asarray(blurred))
+    np.testing.assert_allclose(
+        np.asarray(smoothed), np.asarray(via_scipy), atol=2e-3
+    )
 
 
 def test_sift_multiscale_concatenates_per_scale_descriptors():
