@@ -468,10 +468,11 @@ def _oc_bcd_fit(
         return a
 
     if fit_intercept:
-        xm_rows = [
-            _oc_wmean(alpha, stage(blk), wsum)
-            for _, blk in store.iter_blocks(range(nb), prefetch=prefetch)
-        ]
+        xm_rows = []
+        for _, blk in store.iter_blocks(range(nb), prefetch=prefetch):
+            m = _oc_wmean(alpha, stage(blk), wsum)
+            np.asarray(m[:1])  # real sync: bound in-flight staged blocks
+            xm_rows.append(m)
         xm = jnp.stack(xm_rows)  # (nb, bs)
         ym = _oc_wmean(alpha, y, wsum)
     else:
@@ -516,8 +517,21 @@ def _oc_bcd_fit(
     lam_n = jnp.float32(lam * n)
     order = [b for _ in range(start, num_iter) for b in range(nb)]
     epoch = start
+    # Backpressure: a REAL device read (4 bytes) of the weights from TWO
+    # steps back before dispatching the next.  Async dispatch has no
+    # flow control (and block_until_ready does not drain the stream on
+    # every backend), so without this the Python loop races ahead and
+    # every staged block's host buffer stays pinned — at 4×-HBM scale
+    # that OOM-killed the host.  The 2-deep window keeps block b+1's H2D
+    # overlapping block b's compute while bounding in-flight staging.
+    from collections import deque
+
+    pending: deque = deque()
     for i, (b, blk) in enumerate(store.iter_blocks(order, prefetch=prefetch)):
+        if len(pending) >= 2:
+            np.asarray(pending.popleft()[:1, :1])
         w[b], p = _oc_block_step(stage(blk), xm[b], yc, sa, row_ok, p, w[b], lam_n)
+        pending.append(w[b])
         if (i + 1) % nb == 0:
             if ckpt_path is not None:
                 jax.block_until_ready(p)

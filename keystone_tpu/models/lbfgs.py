@@ -161,6 +161,28 @@ class DenseLBFGSwithL2(LabelEstimator):
     def params(self):
         return (self.lam, self.num_iterations, self.history, self.fit_intercept)
 
+    def choose_physical(self, sample):
+        """Dense vs sparse physical choice (the reference's
+        NodeOptimizationRule picking LeastSquaresDenseGradient vs
+        LeastSquaresSparseGradient from sampled data): host datasets of
+        scipy sparse rows route to the sparse-gradient solver."""
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows
+
+        if (
+            type(self) is DenseLBFGSwithL2
+            and not self.fit_intercept  # sparse path has no centering
+            and sample is not None
+            and sample.is_host
+            and is_scipy_sparse_rows(sample.items)
+        ):
+            return SparseLBFGSwithL2(
+                lam=self.lam,
+                num_iterations=self.num_iterations,
+                history=self.history,
+                fit_intercept=False,
+            )
+        return self
+
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("DenseLBFGSwithL2 requires labels")
@@ -200,6 +222,12 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
     ``fit_intercept`` is not supported on the sparse path (centering
     would densify); construct with ``fit_intercept=False``.
     """
+
+    # already the sparse physical form: restore the base hook (the same
+    # function object Estimator defines) so NodeChoiceRule's
+    # is-overridden guard skips the (expensive) sample execution
+    # entirely for nodes that could never swap
+    choose_physical = LabelEstimator.choose_physical
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows

@@ -78,6 +78,38 @@ class LinearMapEstimator(LabelEstimator):
     def params(self):
         return (self.lam, self.fit_intercept)
 
+    def choose_physical(self, sample):
+        """Physical choice (workflow/NodeOptimizationRule): on host
+        datasets of scipy sparse rows, the dense normal equations would
+        densify n×d AND form a d×d Gram — infeasible at text-scale
+        vocabularies — so route to the sparse-gradient L-BFGS solver,
+        which minimizes the SAME objective (1/(2n)‖XW−Y‖² + λ/2‖W‖² ⇒
+        (XᵀX+λnI)W = XᵀY).  The sparse path fits no intercept (centering
+        would densify); the reference's sparse gradient had the same
+        contract."""
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows
+
+        if sample is not None and sample.is_host and is_scipy_sparse_rows(
+            sample.items
+        ):
+            from keystone_tpu.models.lbfgs import SparseLBFGSwithL2
+
+            if self.fit_intercept:
+                import logging
+
+                # warning, not info: the swap changes model semantics
+                # (no intercept), and it must be visible under default
+                # logging.  Unlike DenseLBFGSwithL2 (which keeps its
+                # dense path when an intercept is requested), the exact
+                # solve CANNOT run on sparse input at all — densifying
+                # is the only alternative, so swap-and-warn it is.
+                logging.getLogger(__name__).warning(
+                    "sparse input: exact solve -> sparse L-BFGS "
+                    "(intercept dropped; centering would densify)"
+                )
+            return SparseLBFGSwithL2(lam=self.lam, num_iterations=100)
+        return self
+
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
         if labels is None:
             raise ValueError("LinearMapEstimator requires labels")

@@ -112,16 +112,36 @@ class TermFrequency(Transformer):
 
 
 class CommonSparseFeaturesModel(Transformer):
-    """doc term-dict → dense row over the learned vocabulary."""
+    """doc term-dict → row over the learned vocabulary.
+
+    ``sparse_output`` emits scipy CSR rows (the reference's
+    SparseVector) instead of dense — at 10⁵-feature vocabularies dense
+    rows multiply memory by the zero fraction, and the sparse solvers /
+    LinearMapper's gather scoring consume CSR directly."""
 
     is_host = True
     fusable = False
 
-    def __init__(self, vocab: Dict, num_features: int):
+    def __init__(self, vocab: Dict, num_features: int, sparse_output: bool = False):
         self.vocab = vocab
         self.num_features = int(num_features)
+        self.sparse_output = bool(sparse_output)
 
-    def apply_one(self, term_dict: Dict) -> np.ndarray:
+    def apply_one(self, term_dict: Dict):
+        if self.sparse_output:
+            import scipy.sparse as sp
+
+            cols, vals = [], []
+            for term, val in term_dict.items():
+                idx = self.vocab.get(term)
+                if idx is not None:
+                    cols.append(idx)
+                    vals.append(float(val))
+            return sp.csr_matrix(
+                (vals, ([0] * len(cols), cols)),
+                shape=(1, self.num_features),
+                dtype=np.float32,
+            )
         row = np.zeros((self.num_features,), np.float32)
         for term, val in term_dict.items():
             idx = self.vocab.get(term)
@@ -130,20 +150,24 @@ class CommonSparseFeaturesModel(Transformer):
         return row
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        if self.sparse_output:
+            return ds.with_items([self.apply_one(d) for d in ds.items])
         rows = np.stack([self.apply_one(d) for d in ds.items])
         return Dataset(rows)
 
 
 class CommonSparseFeatures(Estimator):
     """Vocabulary = top-k terms by document frequency
-    (nodes/misc/CommonSparseFeatures.scala).  The fitted transformer emits
-    dense rows (the TPU-side representation; see module docstring)."""
+    (nodes/misc/CommonSparseFeatures.scala).  The fitted transformer
+    emits dense rows by default; ``sparse_output=True`` keeps CSR rows
+    so the optimizer's physical choice can pick the sparse solvers."""
 
-    def __init__(self, num_features: int):
+    def __init__(self, num_features: int, sparse_output: bool = False):
         self.num_features = int(num_features)
+        self.sparse_output = bool(sparse_output)
 
     def params(self):
-        return (self.num_features,)
+        return (self.num_features, self.sparse_output)
 
     def fit_dataset(self, data: Dataset) -> CommonSparseFeaturesModel:
         return self.fit_arrays(data.items)
@@ -154,7 +178,9 @@ class CommonSparseFeatures(Estimator):
             df.update(set(d.keys()))
         top = [t for t, _ in df.most_common(self.num_features)]
         vocab = {t: i for i, t in enumerate(top)}
-        return CommonSparseFeaturesModel(vocab, self.num_features)
+        return CommonSparseFeaturesModel(
+            vocab, self.num_features, self.sparse_output
+        )
 
 
 def stable_term_hash(term) -> int:

@@ -53,7 +53,19 @@ class NewsgroupsPipeline:
             .and_then(Tokenizer())
             .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
             .and_then(TermFrequency(log_tf))
-            .and_then(CommonSparseFeatures(config.num_features), train_x)
+            # LS head at large vocabularies stays CSR: the optimizer's
+            # physical choice then routes to the sparse-gradient solver
+            # instead of densifying n×d (reference NodeOptimizationRule:
+            # dense vs sparse representation).  NB consumes dense counts.
+            .and_then(
+                CommonSparseFeatures(
+                    config.num_features,
+                    sparse_output=(
+                        config.head == "ls" and config.num_features >= 16384
+                    ),
+                ),
+                train_x,
+            )
         )
         if config.head == "nb":
             head = featurizer.and_then(

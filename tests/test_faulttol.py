@@ -162,6 +162,41 @@ def test_executor_stage_retry_recovers_transient_failure():
         PipelineEnv.node_retries = prev
 
 
+def test_stage_retries_env_parsing(monkeypatch):
+    """KEYSTONE_STAGE_RETRIES is parsed lazily and tolerantly: malformed
+    values warn and resolve to 0 instead of crashing imports; post-import
+    changes take effect; PipelineEnv.node_retries overrides."""
+    from keystone_tpu.workflow.pipeline import PipelineEnv
+
+    monkeypatch.setattr(PipelineEnv, "node_retries", None)
+    monkeypatch.setenv("KEYSTONE_STAGE_RETRIES", "3")
+    assert PipelineEnv.stage_retries() == 3
+    monkeypatch.setenv("KEYSTONE_STAGE_RETRIES", "two")
+    assert PipelineEnv.stage_retries() == 0
+    monkeypatch.setenv("KEYSTONE_STAGE_RETRIES", "-4")
+    assert PipelineEnv.stage_retries() == 0
+    monkeypatch.setattr(PipelineEnv, "node_retries", 5)
+    assert PipelineEnv.stage_retries() == 5
+
+
+def test_gather_and_scatter_host_roundtrip_single_process():
+    """gather_to_host / global_from_host: the single-process legs (the
+    multi-process legs are exercised by the Gloo fault test)."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel import shard_batch
+    from keystone_tpu.parallel.multihost import gather_to_host, global_from_host
+
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sharded = shard_batch(x)
+    host = gather_to_host(sharded)
+    np.testing.assert_allclose(host, x)
+    back = global_from_host(host, sharded.sharding)
+    assert isinstance(back, jax.Array)
+    np.testing.assert_allclose(np.asarray(back), x)
+
+
 def test_fit_with_recovery_restarts_and_resumes(tmp_path):
     """fit_with_recovery: a build_fn whose first attempt dies mid-fit is
     restarted; the solver's epoch checkpoint makes attempt 2 RESUME (the

@@ -89,6 +89,72 @@ def test_sparse_fit_at_huge_vocab_without_densifying():
     assert (pred == lab).mean() > 0.9
 
 
+def test_node_choice_swaps_dense_solvers_to_sparse():
+    """The optimizer's physical choice (NodeOptimizationRule analogue):
+    on host CSR samples, exact LS and dense LBFGS route to the
+    sparse-gradient solver; dense samples keep the original."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.models import (
+        DenseLBFGSwithL2,
+        LinearMapEstimator,
+        SparseLBFGSwithL2,
+    )
+
+    rows = [sp.csr_matrix(np.eye(1, 50, k=i, dtype=np.float32)) for i in range(4)]
+    sparse_sample = Dataset(rows)
+    dense_sample = Dataset(np.ones((4, 50), np.float32))
+
+    chosen = LinearMapEstimator(lam=0.3).choose_physical(sparse_sample)
+    assert isinstance(chosen, SparseLBFGSwithL2) and chosen.lam == 0.3
+    assert LinearMapEstimator(lam=0.3).choose_physical(dense_sample).__class__ \
+        is LinearMapEstimator
+
+    d = DenseLBFGSwithL2(lam=0.1, fit_intercept=False)
+    assert isinstance(d.choose_physical(sparse_sample), SparseLBFGSwithL2)
+    assert d.choose_physical(dense_sample) is d
+    # intercept-fitting dense LBFGS keeps the dense path (no centering sparse)
+    di = DenseLBFGSwithL2(lam=0.1, fit_intercept=True)
+    assert di.choose_physical(sparse_sample) is di
+    # already-sparse stays put
+    s = SparseLBFGSwithL2(lam=0.1)
+    assert s.choose_physical(sparse_sample) is s
+
+
+def test_common_sparse_features_sparse_output_pipeline():
+    """CommonSparseFeatures(sparse_output=True) keeps CSR rows through
+    the DAG; the default optimizer's node choice then fits the LS head
+    with the sparse solver, end to end — a pipeline whose dense route
+    would materialize n×d."""
+    from keystone_tpu.models import LinearMapEstimator
+    from keystone_tpu.ops import MaxClassifier
+    from keystone_tpu.ops.nlp import CommonSparseFeatures
+
+    rng = np.random.default_rng(4)
+    vocab = [f"w{i}" for i in range(64)]
+    n, k = 96, 3
+    lab = rng.integers(0, k, size=n).astype(np.int32)
+    docs = []
+    for i in range(n):
+        terms = {f"c{lab[i]}": 3.0}  # class-indicative token
+        for w in rng.choice(vocab, size=5, replace=False):
+            terms[w] = 1.0
+        docs.append(terms)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    pipe = Pipeline.of(
+        # identity host stage so the estimator sees the featurized docs
+        CommonSparseFeatures(67, sparse_output=True)
+        .fit_arrays(docs)
+    ).and_then(
+        LinearMapEstimator(lam=1e-3), Dataset(docs), Dataset(y)
+    ).and_then(MaxClassifier())
+    fitted = pipe.fit()
+    pred = fitted(Dataset(docs)).get().numpy().ravel()[:n]
+    assert (pred == lab).mean() > 0.95
+
+
 def test_sparsify_to_sparse_lbfgs_pipeline_and_scoring():
     """End-to-end DSL flow: dense rows → Sparsify (host CSR items) →
     SparseLBFGSwithL2 (sparse gradient fit) → sparse gather scoring →
