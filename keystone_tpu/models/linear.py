@@ -110,9 +110,16 @@ class LinearMapEstimator(LabelEstimator):
             return SparseLBFGSwithL2(lam=self.lam, num_iterations=100)
         return self
 
-    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
+    def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("LinearMapEstimator requires labels")
+        # robustness, not just optimization: host CSR datasets must fit
+        # even when NodeChoiceRule didn't run (custom optimizers,
+        # best-effort sampling failures) — route like choose_physical
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows
+
+        if data.is_host and is_scipy_sparse_rows(data.items):
+            return self.choose_physical(data).fit_dataset(data, labels)
         w, b = _fit_normal_equations(
             data.array,
             labels.array,

@@ -163,16 +163,31 @@ def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
         preferred_element_type=jnp.float32,
     )
 
-    # --- gather 4x4 bin responses around each keypoint ---
-    ys = jnp.asarray(_keypoint_grid(h, step, bin_size))
-    xs_ = jnp.asarray(_keypoint_grid(w, step, bin_size))
-    # bin-center offsets relative to the keypoint: (-1.5,-0.5,.5,1.5)*bin
-    offs = ((jnp.arange(_GRID) - (_GRID - 1) / 2.0) * bin_size).astype(jnp.int32)
-    yy = (ys[:, None] + offs[None, :]).reshape(-1)  # (Ky*4,)
-    xx = (xs_[:, None] + offs[None, :]).reshape(-1)  # (Kx*4,)
-    g = smoothed[:, yy, :, :][:, :, xx, :]  # (n, Ky*4, Kx*4, 8)
+    # --- extract 4x4 bin responses around each keypoint ---
+    # Keypoint centers and bin offsets are both uniform grids, so the
+    # "gather" is 16 STRIDED SLICES (stack over bin offsets), not a
+    # dynamic gather — device traces showed the gather's index staging
+    # costing ~15% of the whole forward per iteration.
+    ys = _keypoint_grid(h, step, bin_size)  # numpy, uniform stride=step
+    xs_ = _keypoint_grid(w, step, bin_size)
     ky, kx = ys.shape[0], xs_.shape[0]
-    g = g.reshape(n, ky, _GRID, kx, _GRID, o)
+    if ky == 0 or kx == 0:  # scale too large for the image: no keypoints
+        return jnp.zeros((n, 0, _GRID * _GRID * o), jnp.float32)
+    offs = ((np.arange(_GRID) - (_GRID - 1) / 2.0) * bin_size).astype(np.int64)
+
+    def bin_slices(arr, centers, axis):
+        """(…, len(centers), _GRID, …): strided slice per bin offset."""
+        parts = []
+        for off in offs:
+            lo = int(centers[0] + off)
+            hi = int(centers[-1] + off) + 1
+            parts.append(
+                lax.slice_in_dim(arr, lo, hi, stride=step, axis=axis)
+            )
+        return jnp.stack(parts, axis=axis + 1)
+
+    g = bin_slices(smoothed, ys, 1)  # (n, ky, 4, w, 8)
+    g = bin_slices(g, xs_, 3)  # (n, ky, 4, kx, 4, 8)
     desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(n, ky * kx, _GRID * _GRID * o)
 
     # --- SIFT normalization: L2 -> clamp 0.2 -> L2 ---

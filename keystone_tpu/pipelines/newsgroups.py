@@ -75,8 +75,16 @@ class NewsgroupsPipeline:
             )
         else:
             labels_pm1 = ClassLabelIndicators(config.num_classes)(train_labels)
+            # the sparse route fits no intercept (centering would
+            # densify): make that explicit at the call site instead of
+            # relying on the swap's runtime warning
+            sparse = config.num_features >= 16384
             head = featurizer.and_then(
-                LinearMapEstimator(lam=config.ls_lam), train_x, labels_pm1
+                LinearMapEstimator(
+                    lam=config.ls_lam, fit_intercept=not sparse
+                ),
+                train_x,
+                labels_pm1,
             )
         return head.and_then(MaxClassifier())
 

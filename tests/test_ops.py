@@ -432,6 +432,21 @@ def test_pixel_scaler_only_if_integer():
     assert guard.params() != PixelScaler().params()  # distinct CSE identity
 
 
+def test_sift_scale_too_large_for_image_yields_zero_keypoints():
+    """A bin size whose support exceeds the image contributes an empty
+    descriptor set (VLFeat drops such scales), not a crash."""
+    from keystone_tpu.ops import SIFTExtractor
+    from keystone_tpu.ops.sift import sift_output_count
+
+    imgs = np.random.default_rng(0).uniform(0, 1, (2, 32, 32)).astype(np.float32)
+    d, m = SIFTExtractor(step=4, bin_sizes=(8,)).apply_batch(jnp.asarray(imgs))
+    assert d.shape == (2, 0, 128) and m.shape == (2, 0)
+    # multi-scale: the feasible scale still contributes
+    d2, _ = SIFTExtractor(step=4, bin_sizes=(4, 8)).apply_batch(jnp.asarray(imgs))
+    assert d2.shape[1] == sift_output_count(32, 32, 4, (4, 8))
+    assert d2.shape[1] == sift_output_count(32, 32, 4, (4,))
+
+
 def test_sift_per_scale_gaussian_smoothing():
     """VLFeat applies per-scale Gaussian smoothing before gradients
     (σ = √((bin/magnif)² − 0.25), magnif=6 default).  Pin: the σ

@@ -121,6 +121,26 @@ def test_node_choice_swaps_dense_solvers_to_sparse():
     assert s.choose_physical(sparse_sample) is s
 
 
+def test_linear_map_fit_dataset_routes_sparse_without_optimizer():
+    """LinearMapEstimator.fit_dataset on a host CSR dataset must fit via
+    the sparse solver even when no optimizer rule rewired it."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.models import LinearMapEstimator
+
+    rng = np.random.default_rng(5)
+    n, d, k = 64, 80, 2
+    dense = (rng.uniform(size=(n, d)) < 0.1) * rng.normal(size=(n, d))
+    dense = dense.astype(np.float32)
+    lab = (dense.sum(axis=1) > 0).astype(np.int32)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+    rows = [sp.csr_matrix(dense[i : i + 1]) for i in range(n)]
+    model = LinearMapEstimator(lam=1e-3).fit_dataset(Dataset(rows), Dataset(y))
+    pred = np.argmax(np.asarray(model.apply_batch(jnp.asarray(dense))), axis=1)
+    assert (pred == lab).mean() > 0.9
+
+
 def test_common_sparse_features_sparse_output_pipeline():
     """CommonSparseFeatures(sparse_output=True) keeps CSR rows through
     the DAG; the default optimizer's node choice then fits the LS head
