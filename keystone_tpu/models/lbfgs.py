@@ -246,20 +246,10 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
                 "SparseLBFGSwithL2 does not support fit_intercept: "
                 "centering would densify the features"
             )
+        from keystone_tpu.ops.sparse import align_label_rows
+
         n = sp.n if n is None else int(n)
-        y = jnp.asarray(y, jnp.float32)
-        if y.shape[0] < n:
-            raise ValueError(
-                f"labels have {y.shape[0]} rows but the sparse matrix has "
-                f"{n} true rows"
-            )
-        rows = int(sp.indices.shape[0])  # rows >= n (mesh padding)
-        # keep the n true label rows, re-pad to the sparse rows' padding
-        # (label and feature padding may come from different meshes; rows
-        # beyond n are padding on both sides, so this drops no real data)
-        y = y[:rows]
-        if y.shape[0] < rows:
-            y = jnp.pad(y, ((0, rows - y.shape[0]), (0, 0)))
+        y = align_label_rows(y, n, int(sp.indices.shape[0]))
         w = _lbfgs_sparse_least_squares(
             sp.indices,
             sp.values,

@@ -52,13 +52,10 @@ class LinearMapper(Transformer):
     def apply_dataset(self, ds):
         # sparse scoring (LBFGS.scala sparse path): score scipy rows by
         # gathering weight rows — never densify n×d at huge vocab
-        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows, score_sparse_dataset
 
         if ds.is_host and is_scipy_sparse_rows(ds.items):
-            sp = PaddedSparseRows.from_scipy_rows(
-                ds.items, num_features=self.weights.shape[0]
-            )
-            return ds.with_array(sp.matmul(self.weights, self.intercept))
+            return score_sparse_dataset(ds, self.weights, self.intercept)
         return super().apply_dataset(ds)
 
 

@@ -33,6 +33,13 @@ class LogisticRegressionModel(Transformer):
     def apply_one(self, x):
         return x @ self.weights
 
+    def apply_dataset(self, ds):
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows, score_sparse_dataset
+
+        if ds.is_host and is_scipy_sparse_rows(ds.items):
+            return score_sparse_dataset(ds, self.weights)
+        return super().apply_dataset(ds)
+
     def predict_proba(self, xs):
         return jax.nn.softmax(xs @ self.weights, axis=-1)
 
@@ -58,18 +65,47 @@ class LogisticRegressionEstimator(LabelEstimator):
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("LogisticRegressionEstimator requires labels")
+        # sparse text (MLlib's logreg consumed SparseVectors; same role):
+        # host CSR rows fit via gather/scatter gradients, never densified
+        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+
+        if data.is_host and is_scipy_sparse_rows(data.items):
+            sp = PaddedSparseRows.from_scipy_rows(data.items)
+            return self.fit_sparse(sp, labels.array, n=data.n)
         return self._fit(data.array, labels.array, data.n)
+
+    def fit_sparse(self, sp, y, n: Optional[int] = None):
+        """Fit from a PaddedSparseRows feature matrix."""
+        from keystone_tpu.ops.sparse import align_label_rows
+
+        n = sp.n if n is None else int(n)
+        onehot = align_label_rows(
+            self._onehot(y), n, int(sp.indices.shape[0])
+        )
+        w = _logreg_fit_sparse(
+            sp.indices,
+            sp.values,
+            onehot,
+            jnp.float32(n),
+            sp.num_features,
+            self.lam,
+            self.num_iters,
+            self.history,
+        )
+        return LogisticRegressionModel(w)
+
+    def _onehot(self, y):
+        y = jnp.asarray(y)
+        if y.ndim == 1:
+            return jax.nn.one_hot(y.astype(jnp.int32), self.num_classes)
+        return (y > 0).astype(jnp.float32)
 
     def fit_arrays(self, x, y=None):
         x = jnp.asarray(x, jnp.float32)
         return self._fit(x, jnp.asarray(y), x.shape[0])
 
     def _fit(self, x, y, n):
-        y = jnp.asarray(y)
-        if y.ndim == 1:
-            onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_classes)
-        else:
-            onehot = (y > 0).astype(jnp.float32)
+        onehot = self._onehot(y)
         w = _logreg_fit(
             jnp.asarray(x, jnp.float32),
             onehot,
@@ -97,4 +133,32 @@ def _logreg_fit(x, onehot, n, lam, num_iters, history):
         return f, g
 
     w0 = jnp.zeros((x.shape[1], onehot.shape[1]), jnp.float32)
+    return lbfgs_minimize(value_and_grad, w0, max_iter=num_iters, history=history)
+
+
+@partial(jax.jit, static_argnames=("d", "num_iters", "history"))
+def _logreg_fit_sparse(idx, vals, onehot, n, d, lam, num_iters, history):
+    """Softmax CE on padded-COO features: forward = gather-matvec,
+    gradient = scatter-add (same sparse primitives as the LS solver).
+    Padding entries have value 0 and padding rows have zero one-hots, so
+    neither contributes to loss or gradient — EXCEPT the softmax's
+    normalizer, which is why padding rows are masked explicitly."""
+    from keystone_tpu.ops.sparse import sparse_grad, sparse_matmul
+
+    idx = constrain(idx, DATA_AXIS)
+    vals = constrain(vals, DATA_AXIS)
+    onehot = constrain(onehot, DATA_AXIS)
+    row_ok = (jnp.arange(idx.shape[0]) < n).astype(jnp.float32)
+    onehot = onehot * row_ok[:, None]
+
+    def value_and_grad(w):
+        logits = sparse_matmul(idx, vals, w)
+        lse = jax.scipy.special.logsumexp(logits, axis=1)
+        ll = jnp.sum(logits * onehot, axis=1) - lse * row_ok
+        f = -jnp.sum(ll) / n + 0.5 * lam * jnp.vdot(w, w)
+        p = jax.nn.softmax(logits, axis=1) * row_ok[:, None]
+        g = constrain(sparse_grad(idx, vals, p - onehot, d)) / n + lam * w
+        return f, g
+
+    w0 = jnp.zeros((d, onehot.shape[1]), jnp.float32)
     return lbfgs_minimize(value_and_grad, w0, max_iter=num_iters, history=history)

@@ -205,17 +205,37 @@ class HashingTF(Transformer):
     is_host = True
     fusable = False
 
-    def __init__(self, num_features: int = 2**16):
+    def __init__(self, num_features: int = 2**16, sparse_output: bool = False):
         self.num_features = int(num_features)
+        self.sparse_output = bool(sparse_output)
 
     def params(self):
-        return (self.num_features,)
+        return (self.num_features, self.sparse_output)
 
-    def apply_one(self, term_dict: Dict) -> np.ndarray:
+    def apply_one(self, term_dict: Dict):
+        if self.sparse_output:
+            import scipy.sparse as sp
+            from collections import defaultdict
+
+            acc: Dict[int, float] = defaultdict(float)
+            for term, val in term_dict.items():
+                acc[stable_term_hash(term) % self.num_features] += float(val)
+            cols = list(acc.keys())
+            return sp.csr_matrix(
+                ([acc[c] for c in cols], ([0] * len(cols), cols)),
+                shape=(1, self.num_features),
+                dtype=np.float32,
+            )
         row = np.zeros((self.num_features,), np.float32)
         for term, val in term_dict.items():
             row[stable_term_hash(term) % self.num_features] += val
         return row
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if self.sparse_output:
+            return ds.with_items([self.apply_one(d) for d in ds.items])
+        rows = np.stack([self.apply_one(d) for d in ds.items])
+        return Dataset(rows)
 
 
 class NGramsCounts(Transformer):

@@ -122,6 +122,37 @@ def sparse_matmul(indices, values, w):
     )
 
 
+def align_label_rows(y, n: int, rows: int):
+    """Validate + re-pad a label matrix for a sparse feature matrix.
+
+    ``n`` true rows must all be present; rows beyond ``n`` are padding on
+    both sides (possibly from different meshes), so truncating/expanding
+    to ``rows`` drops no real data.  Raises on missing labels — silently
+    zero-padding real rows would actively train toward a wrong model."""
+    import jax.numpy as jnp
+
+    y = jnp.asarray(y, jnp.float32)
+    if y.shape[0] < n:
+        raise ValueError(
+            f"labels have {y.shape[0]} rows but the sparse matrix has "
+            f"{n} true rows"
+        )
+    y = y[:rows]
+    if y.shape[0] < rows:
+        y = jnp.pad(y, ((0, rows - y.shape[0]), (0, 0)))
+    return y
+
+
+def score_sparse_dataset(ds, weights, intercept=None):
+    """Score a host Dataset of scipy sparse rows against dense weights
+    by gathering weight rows (shared by LinearMapper and the logistic
+    model — n×d never densifies)."""
+    sp = PaddedSparseRows.from_scipy_rows(
+        ds.items, num_features=weights.shape[0]
+    )
+    return ds.with_array(sp.matmul(weights, intercept))
+
+
 def sparse_grad(indices, values, r, d):
     """``Xᵀ r`` by scatter-add: (d, k) from (rows, nnz) COO and (rows, k).
 

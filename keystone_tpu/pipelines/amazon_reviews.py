@@ -49,7 +49,15 @@ class AmazonReviewsPipeline:
             .and_then(Tokenizer())
             .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
             .and_then(TermFrequency(log_tf))
-            .and_then(HashingTF(config.num_features))
+            # hashed features stay CSR at large dimensions: the logistic
+            # solver fits them with gather/scatter gradients (the role
+            # MLlib's SparseVector logreg played in the reference)
+            .and_then(
+                HashingTF(
+                    config.num_features,
+                    sparse_output=config.num_features >= 16384,
+                )
+            )
         )
         return featurizer.and_then(
             LogisticRegressionEstimator(

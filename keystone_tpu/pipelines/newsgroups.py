@@ -47,6 +47,10 @@ class NewsgroupsPipeline:
 
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
+        # ONE decision for representation AND solver contract: sparse
+        # features imply the no-intercept sparse solver (desyncing the
+        # two silently changes model semantics)
+        sparse = config.head == "ls" and config.num_features >= 16384
         featurizer = (
             Pipeline.of(Trimmer())
             .and_then(LowerCase())
@@ -58,12 +62,7 @@ class NewsgroupsPipeline:
             # instead of densifying n×d (reference NodeOptimizationRule:
             # dense vs sparse representation).  NB consumes dense counts.
             .and_then(
-                CommonSparseFeatures(
-                    config.num_features,
-                    sparse_output=(
-                        config.head == "ls" and config.num_features >= 16384
-                    ),
-                ),
+                CommonSparseFeatures(config.num_features, sparse_output=sparse),
                 train_x,
             )
         )
@@ -78,7 +77,6 @@ class NewsgroupsPipeline:
             # the sparse route fits no intercept (centering would
             # densify): make that explicit at the call site instead of
             # relying on the swap's runtime warning
-            sparse = config.num_features >= 16384
             head = featurizer.and_then(
                 LinearMapEstimator(
                     lam=config.ls_lam, fit_intercept=not sparse

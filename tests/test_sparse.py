@@ -175,6 +175,42 @@ def test_common_sparse_features_sparse_output_pipeline():
     assert (pred == lab).mean() > 0.95
 
 
+def test_sparse_logreg_matches_dense_and_runs_amazon():
+    """Sparse logistic regression (gather/scatter gradients) matches the
+    dense fit on identical data, and the Amazon app runs end-to-end with
+    CSR hashed features."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.models import LogisticRegressionEstimator
+
+    rng = np.random.default_rng(6)
+    n, d, k = 256, 300, 3
+    dense = ((rng.uniform(size=(n, d)) < 0.08) * rng.normal(size=(n, d))).astype(
+        np.float32
+    )
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    lab = np.argmax(dense @ w_true, axis=1).astype(np.int32)
+
+    est = LogisticRegressionEstimator(k, lam=1e-3, num_iters=120)
+    dm = est.fit_arrays(dense, lab)
+    rows = [sp.csr_matrix(dense[i : i + 1]) for i in range(n)]
+    sm = est.fit_dataset(Dataset(rows), Dataset(lab))
+    wd, ws = np.asarray(dm.weights), np.asarray(sm.weights)
+    scale = np.abs(wd).max() + 1e-9
+    assert np.abs(ws - wd).max() / scale < 3e-2, np.abs(ws - wd).max() / scale
+
+    # sparse scoring path through the model
+    scored = sm.apply_dataset(Dataset(rows)).numpy()
+    np.testing.assert_allclose(
+        scored, dense @ ws, rtol=1e-4, atol=1e-4
+    )
+
+    from keystone_tpu.pipelines.amazon_reviews import AmazonReviewsPipeline, Config
+
+    out = AmazonReviewsPipeline.run(Config(num_features=20000, synthetic_n=300))
+    assert out["accuracy"] > 0.9, out
+
+
 def test_sparsify_to_sparse_lbfgs_pipeline_and_scoring():
     """End-to-end DSL flow: dense rows → Sparsify (host CSR items) →
     SparseLBFGSwithL2 (sparse gradient fit) → sparse gather scoring →
