@@ -33,6 +33,13 @@ class NaiveBayesModel(Transformer):
     def apply_one(self, x):
         return x @ self.log_cond.T + self.log_prior
 
+    def apply_dataset(self, ds):
+        from keystone_tpu.ops.sparse import is_scipy_sparse_rows, score_sparse_dataset
+
+        if ds.is_host and is_scipy_sparse_rows(ds.items):
+            return score_sparse_dataset(ds, self.log_cond.T, self.log_prior)
+        return super().apply_dataset(ds)
+
 
 class NaiveBayesEstimator(LabelEstimator):
     """labels: int class ids (n,) or one-hot/±1 indicator matrix (n, K)."""
@@ -47,6 +54,30 @@ class NaiveBayesEstimator(LabelEstimator):
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("NaiveBayesEstimator requires labels")
+        # sparse counts: the sufficient statistic onehotᵀX is one
+        # scatter-add over the COO entries — never densify n×d
+        from keystone_tpu.ops.sparse import (
+            PaddedSparseRows,
+            align_label_rows,
+            is_scipy_sparse_rows,
+        )
+
+        if data.is_host and is_scipy_sparse_rows(data.items):
+            sp = PaddedSparseRows.from_scipy_rows(data.items)
+            onehot = align_label_rows(
+                _to_onehot(labels.array, self.num_classes),
+                data.n,
+                int(sp.indices.shape[0]),
+            )
+            lp, lc = _nb_fit_sparse(
+                sp.indices,
+                sp.values,
+                onehot,
+                jnp.float32(data.n),
+                sp.num_features,
+                self.lam,
+            )
+            return NaiveBayesModel(lp, lc)
         return self._fit(data.array, labels.array, data.n)
 
     def fit_arrays(self, x, y=None):
@@ -65,6 +96,21 @@ def _to_onehot(y, k):
     return (y > 0).astype(jnp.float32)
 
 
+@partial(jax.jit, static_argnames=("d",))
+def _nb_fit_sparse(idx, vals, onehot, n, d, lam):
+    """Sparse multinomial NB: feat_counts = (Xᵀ·onehot)ᵀ via scatter-add
+    on the padded-COO entries (sparse_grad); identical math to _nb_fit."""
+    from keystone_tpu.ops.sparse import sparse_grad
+
+    idx = constrain(idx, DATA_AXIS)
+    vals = constrain(vals, DATA_AXIS)
+    row_ok = (jnp.arange(idx.shape[0]) < n).astype(jnp.float32)
+    onehot = onehot * row_ok[:, None]
+    class_counts = constrain(jnp.sum(onehot, axis=0))  # (K,)
+    feat_counts = constrain(sparse_grad(idx, vals, onehot, d)).T  # (K, d)
+    return _nb_finish(class_counts, feat_counts, n, lam)
+
+
 @jax.jit
 def _nb_fit(x, onehot, n, lam):
     x = constrain(x.astype(jnp.float32), DATA_AXIS)
@@ -72,6 +118,11 @@ def _nb_fit(x, onehot, n, lam):
     onehot = onehot * row_ok[:, None]
     class_counts = constrain(jnp.sum(onehot, axis=0))  # (K,)
     feat_counts = constrain(onehot.T @ x)  # (K, d) — treeAggregate analogue
+    return _nb_finish(class_counts, feat_counts, n, lam)
+
+
+def _nb_finish(class_counts, feat_counts, n, lam):
+    """Shared prior/smoothing/log-conditional tail of both fit paths."""
     log_prior = jnp.log(jnp.maximum(class_counts, 1e-10)) - jnp.log(n)
     smoothed = feat_counts + lam
     log_cond = jnp.log(smoothed) - jnp.log(

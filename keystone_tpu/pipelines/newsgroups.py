@@ -48,19 +48,19 @@ class NewsgroupsPipeline:
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
         # ONE decision for representation AND solver contract: sparse
-        # features imply the no-intercept sparse solver (desyncing the
-        # two silently changes model semantics)
-        sparse = config.head == "ls" and config.num_features >= 16384
+        # features imply the sparse heads (NB counts via scatter-add;
+        # LS via the no-intercept sparse-gradient solver — desyncing
+        # representation and solver silently changes model semantics)
+        sparse = config.num_features >= 16384
         featurizer = (
             Pipeline.of(Trimmer())
             .and_then(LowerCase())
             .and_then(Tokenizer())
             .and_then(NGramsFeaturizer(tuple(range(1, config.ngrams + 1))))
             .and_then(TermFrequency(log_tf))
-            # LS head at large vocabularies stays CSR: the optimizer's
-            # physical choice then routes to the sparse-gradient solver
-            # instead of densifying n×d (reference NodeOptimizationRule:
-            # dense vs sparse representation).  NB consumes dense counts.
+            # large vocabularies stay CSR end to end: NB scatter-adds
+            # its counts, LS fits via the sparse-gradient solver — the
+            # reference NodeOptimizationRule's dense-vs-sparse choice
             .and_then(
                 CommonSparseFeatures(config.num_features, sparse_output=sparse),
                 train_x,

@@ -175,6 +175,33 @@ def test_common_sparse_features_sparse_output_pipeline():
     assert (pred == lab).mean() > 0.95
 
 
+def test_sparse_naive_bayes_matches_dense():
+    """NB on CSR rows (scatter-add counts) must equal the dense fit, and
+    its model must score sparse datasets."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.models import NaiveBayesEstimator
+
+    rng = np.random.default_rng(7)
+    n, d, k = 128, 200, 4
+    dense = (rng.uniform(size=(n, d)) < 0.1) * rng.integers(1, 5, size=(n, d))
+    dense = dense.astype(np.float32)
+    lab = rng.integers(0, k, size=n).astype(np.int32)
+    rows = [sp.csr_matrix(dense[i : i + 1]) for i in range(n)]
+
+    dm = NaiveBayesEstimator(k, lam=1.0).fit_arrays(dense, lab)
+    sm = NaiveBayesEstimator(k, lam=1.0).fit_dataset(Dataset(rows), Dataset(lab))
+    np.testing.assert_allclose(
+        np.asarray(sm.log_cond), np.asarray(dm.log_cond), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(sm.log_prior), np.asarray(dm.log_prior), rtol=1e-6
+    )
+    scored = sm.apply_dataset(Dataset(rows)).numpy()
+    want = np.asarray(dm.apply_batch(jnp.asarray(dense)))
+    np.testing.assert_allclose(scored, want, rtol=1e-4, atol=1e-4)
+
+
 def test_sparse_logreg_matches_dense_and_runs_amazon():
     """Sparse logistic regression (gather/scatter gradients) matches the
     dense fit on identical data, and the Amazon app runs end-to-end with
