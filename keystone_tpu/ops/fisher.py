@@ -39,8 +39,9 @@ class FisherVector(Transformer):
     kernel (ops/fisher_pallas.py); False forces the XLA einsum path; None
     (default) picks per call: the fused kernel on TPU when the
     responsibility tensor γ (T·K floats per image) is large enough to be
-    HBM-bandwidth bound (measured crossover on v5 lite: ~1.5× faster at
-    T=512, K=256; parity below T·K ≈ 32k), einsum otherwise.
+    HBM-bandwidth bound (re-measured r2 with the whole-image-tile
+    kernel on v5 lite: 1.7× at T=784/K=64, 3× at T=784/K=256; parity
+    at T ≤ 256 for any K), einsum otherwise.
     """
 
     fusable = False
