@@ -197,6 +197,49 @@ def test_gather_and_scatter_host_roundtrip_single_process():
     np.testing.assert_allclose(np.asarray(back), x)
 
 
+def test_fit_with_recovery_reuses_saved_featurize_prefix(tmp_path):
+    """The composed recovery story: an expensive featurize prefix saved
+    via save_pipeline_state is RELOADED (not recomputed) by every fit
+    attempt under fit_with_recovery — the Spark lineage-reuse analogue."""
+    from test_aux import Expensive, expensive_calls
+
+    from keystone_tpu.models import LinearMapEstimator
+    from keystone_tpu.workflow import Dataset, Pipeline, fit_with_recovery
+    from keystone_tpu.workflow.state import save_pipeline_state
+
+    state_dir = str(tmp_path / "state")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = rng.normal(size=(32, 2)).astype(np.float32)
+
+    featurizer = Pipeline.of(Expensive("prefix"))
+    lazy = featurizer(Dataset(x, name="rec-train"))
+    Expensive.calls = 0
+    save_pipeline_state(lazy, state_dir)
+    assert expensive_calls() >= 1  # materialized once to save
+
+    attempt = {"n": 0}
+
+    def build():
+        attempt["n"] += 1
+        if attempt["n"] == 1:
+            raise RuntimeError("injected pre-fit failure")
+        return featurizer.and_then(
+            LinearMapEstimator(lam=1e-3),
+            Dataset(x, name="rec-train"),
+            Dataset(y),
+        )
+
+    Expensive.calls = 0
+    fitted, attempts = fit_with_recovery(build, state_dir=state_dir, max_restarts=2)
+    assert attempts == 1
+    # the saved prefix replaced the Expensive node before execution AND
+    # before the optimizer's sampling passes: zero re-executions
+    assert expensive_calls() == 0, expensive_calls()
+    pred = fitted(Dataset(x, name="rec-train")).get().numpy()
+    assert np.isfinite(pred).all()
+
+
 def test_fit_with_recovery_restarts_and_resumes(tmp_path):
     """fit_with_recovery: a build_fn whose first attempt dies mid-fit is
     restarted; the solver's epoch checkpoint makes attempt 2 RESUME (the

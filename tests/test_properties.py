@@ -272,3 +272,56 @@ def test_kmeans_is_translation_equivariant(x, t):
     c0 = np.sort(np.asarray(est().fit_arrays(x).centers), axis=0)
     c1 = np.sort(np.asarray(est().fit_arrays(x + t).centers), axis=0)
     np.testing.assert_allclose(c1, c0 + t, rtol=1e-3, atol=1e-2)
+
+
+# ------------------------------------------------------------- sparse ops
+@given(
+    st.integers(2, 12),  # rows
+    st.integers(4, 40),  # d
+    st.integers(1, 6),  # nnz
+    st.integers(1, 4),  # k
+    st.integers(0, 2**31 - 1),
+)
+@settings(max_examples=25, deadline=None)
+def test_sparse_matmul_and_grad_match_dense(rows, d, nnz, k, seed):
+    """sparse_matmul == dense X @ w and sparse_grad == dense Xᵀ r for any
+    padded-COO matrix, INCLUDING duplicate indices (which accumulate)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.sparse import sparse_grad, sparse_matmul
+
+    rng = np.random.default_rng(seed)
+    nnz = min(nnz, d)
+    idx = rng.integers(0, d, size=(rows, nnz)).astype(np.int32)  # dups allowed
+    val = rng.normal(size=(rows, nnz)).astype(np.float32)
+    # random padding entries must be inert
+    pad_mask = rng.uniform(size=(rows, nnz)) < 0.3
+    val[pad_mask] = 0.0
+    dense = np.zeros((rows, d), np.float32)
+    for i in range(rows):
+        np.add.at(dense[i], idx[i], val[i])
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    r = rng.normal(size=(rows, k)).astype(np.float32)
+
+    got_mm = np.asarray(sparse_matmul(jnp.asarray(idx), jnp.asarray(val), jnp.asarray(w)))
+    np.testing.assert_allclose(got_mm, dense @ w, rtol=2e-4, atol=2e-4)
+    got_g = np.asarray(sparse_grad(jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r), d))
+    np.testing.assert_allclose(got_g, dense.T @ r, rtol=2e-4, atol=2e-4)
+
+
+@given(
+    st.integers(2, 10),
+    st.integers(3, 30),
+    st.integers(0, 2**31 - 1),
+)
+@settings(max_examples=20, deadline=None)
+def test_padded_sparse_rows_roundtrip_property(rows, d, seed):
+    """from_dense → toarray is the identity for any dense matrix."""
+    from keystone_tpu.ops.sparse import PaddedSparseRows
+
+    rng = np.random.default_rng(seed)
+    x = ((rng.uniform(size=(rows, d)) < 0.4) * rng.normal(size=(rows, d))).astype(
+        np.float32
+    )
+    sp = PaddedSparseRows.from_dense(x)
+    np.testing.assert_allclose(sp.toarray(), x, atol=1e-6)
