@@ -45,3 +45,15 @@ def test_bench_forward_batch_invariance():
 def test_measure_ips_runs_on_cpu():
     ips = bench.measure_ips(batch=2, run_lengths=(1, 2, 3), reps=1, warmup=1)
     assert ips > 0
+
+
+def test_flops_accounting_tracks_real_descriptor_count():
+    """MFU honesty guard: the analytic FLOP count must use the actual
+    SIFT grid size (a hand-derived T once overcounted it by ~4%), and
+    the FV term must dominate as documented."""
+    from keystone_tpu.ops.sift import sift_output_count
+
+    t = sift_output_count(bench.IMAGE_HW, bench.IMAGE_HW, bench.SIFT_STEP, (4,))
+    total = bench.flops_per_image()
+    fv = 4 * 2 * t * bench.PCA_DIMS * bench.GMM_K
+    assert fv < total < 3 * fv
