@@ -121,6 +121,9 @@ class CommonSparseFeaturesModel(Transformer):
 
     is_host = True
     fusable = False
+    # Class-level default: models pickled before sparse_output existed
+    # unpickle to the dense rows they were fitted with.
+    sparse_output = False
 
     def __init__(self, vocab: Dict, num_features: int, sparse_output: bool = False):
         self.vocab = vocab
@@ -204,6 +207,9 @@ class HashingTF(Transformer):
 
     is_host = True
     fusable = False
+    # Class-level default for pre-sparse_output pickles (see
+    # CommonSparseFeaturesModel above).
+    sparse_output = False
 
     def __init__(self, num_features: int = 2**16, sparse_output: bool = False):
         self.num_features = int(num_features)

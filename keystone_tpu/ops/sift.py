@@ -38,6 +38,9 @@ class SIFTExtractor(Transformer):
     """
 
     fusable = False
+    # Class-level default so pipelines pickled before smoothing existed
+    # unpickle to the behavior they were fitted with (no smoothing).
+    smoothing_magnif = 0.0
 
     def __init__(
         self,

@@ -72,6 +72,15 @@ class PaddedSparseRows:
         """Build from scipy sparse row vectors (what ``Sparsify`` emits)."""
         coos = [r.tocoo() for r in rows]
         d = int(num_features if num_features is not None else coos[0].shape[-1])
+        widths = {int(c.shape[-1]) for c in coos}
+        if widths - {d}:
+            # JAX's gather clamps out-of-range indices, so a
+            # featurizer/weights width mismatch would silently mis-score;
+            # fail loudly like the dense path's shape error instead.
+            raise ValueError(
+                f"sparse rows have width(s) {sorted(widths)} but "
+                f"num_features={d}"
+            )
         nnz_max = max(1, max((c.nnz for c in coos), default=1))
         n = len(coos)
         idx = np.zeros((n, nnz_max), np.int32)

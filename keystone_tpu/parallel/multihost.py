@@ -106,10 +106,19 @@ def process_batch_slice(global_n: int) -> slice:
 def gather_to_host(arr) -> np.ndarray:
     """Full host copy of a (possibly multi-process global) array.
 
-    Single-process: plain ``np.asarray``.  Multi-process: every host
-    gets the full array via ``process_allgather`` — the checkpoint-save
-    path for sharded solver state, where a bare ``np.asarray`` would
-    raise on non-addressable shards."""
+    Single-process: plain ``np.asarray``.  Multi-process the semantics
+    fork by input type, and both are load-bearing:
+
+    - a global ``jax.Array`` → every host gets ONE full copy of the
+      global value (the checkpoint-save path for sharded solver state,
+      where a bare ``np.asarray`` would raise on non-addressable shards);
+    - a host ``np.ndarray`` (or other host value) → the per-process
+      values are CONCATENATED along axis 0, i.e. a P-process call with a
+      (k,)-shaped input returns (P·k,) — the cross-process digest in
+      ``models/block_ls.py`` relies on this to compare per-host hashes.
+
+    Callers holding a host array that is already identical on every
+    process should NOT round-trip it through here expecting a no-op."""
     if jax.process_count() == 1:
         return np.asarray(arr)
     from jax.experimental import multihost_utils

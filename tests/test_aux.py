@@ -511,3 +511,45 @@ def test_saved_state_orbax_backend_roundtrip(tmp_path):
     g = SavedStateLoadRule(state_dir).apply(lazy2.graph)
     out = GraphExecutor(g).execute(g.sinks[0])
     np.testing.assert_allclose(out.dataset.numpy(), 6.0)
+
+
+def test_old_pickle_missing_new_attrs_still_applies():
+    """Pipelines pickled before smoothing_magnif / sparse_output existed
+    must unpickle to the behavior they were fitted with (ADVICE r2)."""
+    import numpy as np
+
+    from keystone_tpu.ops.nlp import CommonSparseFeaturesModel, HashingTF
+    from keystone_tpu.ops.sift import SIFTExtractor
+
+    # Simulate an old pickle: bypass __init__, drop the new attributes.
+    sift = SIFTExtractor.__new__(SIFTExtractor)
+    sift.step = 8
+    sift.bin_sizes = (4,)
+    assert sift.smoothing_magnif == 0.0  # class-level default
+    img = np.random.default_rng(0).uniform(size=(1, 32, 32)).astype(np.float32)
+    d, m = sift.apply_batch(img)
+    assert np.all(np.isfinite(np.asarray(d)))
+
+    csf = CommonSparseFeaturesModel.__new__(CommonSparseFeaturesModel)
+    csf.vocab = {"a": 0, "b": 1}
+    csf.num_features = 2
+    assert csf.sparse_output is False
+    row = csf.apply_one({"a": 2.0})
+    assert isinstance(row, np.ndarray) and row[0] == 2.0
+
+    tf = HashingTF.__new__(HashingTF)
+    tf.num_features = 16
+    assert tf.sparse_output is False
+    assert isinstance(tf.apply_one({"x": 1.0}), np.ndarray)
+
+
+def test_from_scipy_rows_width_mismatch_raises():
+    import scipy.sparse as sp
+
+    from keystone_tpu.ops.sparse import PaddedSparseRows
+
+    rows = [sp.csr_matrix(([1.0], ([0], [3])), shape=(1, 10))]
+    with pytest.raises(ValueError, match="width"):
+        PaddedSparseRows.from_scipy_rows(rows, num_features=7)
+    # Matching width still fine.
+    PaddedSparseRows.from_scipy_rows(rows, num_features=10)
