@@ -55,6 +55,12 @@ def _f32_peak() -> float:
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
 
     return _ROOFLINE_PEAKS["tpu"][0]
+
+
+_BF16_EFFECTIVE_PEAK = 1.97e14  # TPU v5 lite bf16-grade MXU peak (~197 Tf/s);
+# XLA executes default-precision f32 matmuls as bf16-grade passes, so this
+# is the honest utilization denominator for the matmul-dense stages
+N_LEGS = int(os.environ.get("BENCH_LEGS", "3"))  # ≥3 resynced samples
 _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
 # bump whenever the methodology or config changes so stale caches die
 _BASELINE_VERSION = 4
@@ -245,7 +251,33 @@ def main():
             )
         return
 
-    ips = measure_ips(BATCH)
+    if "--leg" in sys.argv:
+        # one independent sample for the band (fresh process = fresh
+        # backend init, which is where the ±10–25% ambient device-clock
+        # spread lives — BASELINE.md "Where the variance lives")
+        print(json.dumps({"leg_ips": measure_ips(BATCH)}))
+        return
+
+    # The headline is a MEDIAN over ≥3 process-level legs, with the
+    # min/max band in the JSON — a single invocation's number can sit
+    # anywhere in a ±25% band (VERDICT r2 item 7).  The first leg runs
+    # in-process (it also pays any compile); later legs ride the
+    # compilation cache.
+    samples = [measure_ips(BATCH)]
+    for _ in range(max(0, N_LEGS - 1)):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--leg"],
+            capture_output=True,
+            text=True,
+            timeout=3600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        try:
+            line = proc.stdout.strip().splitlines()[-1]
+            samples.append(float(json.loads(line)["leg_ips"]))
+        except Exception:
+            sys.stderr.write(f"bench leg failed: {proc.stderr[-300:]}\n")
+    ips = float(np.median(samples))
     tf = ips * flops_per_image() / 1e12
     cpu_ips = cpu_baseline_ips()
     vs = ips / cpu_ips if cpu_ips > 0 else None
@@ -256,8 +288,14 @@ def main():
                 "value": round(ips, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(vs, 2) if vs else None,
+                "band": {
+                    "min": round(min(samples), 2),
+                    "max": round(max(samples), 2),
+                    "n_legs": len(samples),
+                },
                 "tflops": round(tf, 2),
                 "mfu_f32": round(tf * 1e12 / _f32_peak(), 3),
+                "mfu_bf16_eff": round(tf * 1e12 / _BF16_EFFECTIVE_PEAK, 3),
                 "config": {
                     "batch": BATCH, "image_hw": IMAGE_HW, "sift_step": SIFT_STEP,
                     "gmm_k": GMM_K, "pca_dims": PCA_DIMS, "classes": NUM_CLASSES,

@@ -16,4 +16,4 @@ from keystone_tpu.loaders.timit import TimitFeaturesDataLoader  # noqa: F401
 from keystone_tpu.loaders.imagenet import ImageNetLoader  # noqa: F401
 from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader  # noqa: F401
 from keystone_tpu.loaders.voc import VOCLoader  # noqa: F401
-from keystone_tpu.loaders.stream import ShardedBatchStream  # noqa: F401
+from keystone_tpu.loaders.stream import batched, prefetched  # noqa: F401

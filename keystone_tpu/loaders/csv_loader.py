@@ -44,3 +44,51 @@ class CsvDataLoader:
             _read_csv_matrix(path, delimiter),
             name=f"csv:{os.path.abspath(path)}:d{delimiter!r}",
         )
+
+    @staticmethod
+    def stream(
+        path: str,
+        label_col: int = 0,
+        delimiter: str = ",",
+        batch_size: int = 4096,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: one cheap line pass reads only the label
+        column and fixes ``n``; features re-parse from disk in
+        ``batch_size``-row chunks each time a stage sweeps the data."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        labels = []
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    labels.append(float(line.split(delimiter)[label_col]))
+        labels = np.asarray(labels, np.float32).astype(np.int32)
+        n = len(labels)
+
+        def batches():
+            buf = []
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    buf.append(line)
+                    if len(buf) == batch_size:
+                        yield _parse_lines(buf, label_col, delimiter)
+                        buf = []
+            if buf:
+                yield _parse_lines(buf, label_col, delimiter)
+
+        name = (
+            f"csv-stream:{os.path.abspath(path)}:l{label_col}"
+            f":d{delimiter!r}:b{batch_size}"
+        )
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
+            Dataset(labels, name=name + "-labels"),
+        )
+
+
+def _parse_lines(lines, label_col: int, delimiter: str) -> np.ndarray:
+    mat = np.loadtxt(lines, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    return np.delete(mat, label_col, axis=1)

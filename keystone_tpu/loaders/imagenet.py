@@ -1,13 +1,25 @@
 """ImageNet loader (reference loaders/ImageNetLoader.scala +
 ImageLoaderUtils.scala): tar archives of JPEGs, label derived from the
 archive/directory name via a synset→label map; JPEG decode on host CPU
-(the reference decodes with javax.imageio inside executors; here PIL
-decodes inside the threaded prefetch pool of
-:class:`keystone_tpu.loaders.stream.ShardedBatchStream`)."""
+(the reference decodes with javax.imageio inside executors; here
+libjpeg/PIL decode on the stream's prefetch thread).
+
+Two entry points mirror the reference's scaling story:
+
+- :meth:`ImageNetLoader.load` — decode everything into one in-memory
+  Dataset (small data / tests);
+- :meth:`ImageNetLoader.stream` — the out-of-core path: a cheap index
+  pass over the tar headers fixes ``n`` and the labels, then a
+  re-iterable :class:`~keystone_tpu.workflow.dataset.StreamDataset`
+  decodes batches on a background thread each time a pipeline stage
+  sweeps the data.  The reference starts its larger-than-memory story at
+  exactly this loader (tar shards streamed through RDD partitions).
+"""
 
 from __future__ import annotations
 
 import io
+import logging
 import os
 import tarfile
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -15,7 +27,9 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from keystone_tpu.loaders.labeled import LabeledData
-from keystone_tpu.workflow.dataset import Dataset
+from keystone_tpu.workflow.dataset import Dataset, StreamDataset
+
+logger = logging.getLogger(__name__)
 
 
 def _decode_jpeg(data: bytes, size: Optional[Tuple[int, int]]) -> np.ndarray:
@@ -29,7 +43,134 @@ def _decode_jpeg(data: bytes, size: Optional[Tuple[int, int]]) -> np.ndarray:
     return np.asarray(img, np.uint8)
 
 
+def _list_tars(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, f)
+        for f in sorted(os.listdir(path))
+        if f.endswith(".tar")
+    ]
+
+
+def _default_label_map(tars: List[str]) -> Dict[str, int]:
+    return {
+        os.path.splitext(os.path.basename(t))[0]: i for i, t in enumerate(tars)
+    }
+
+
+def _decode_entry_batch(
+    entries: List[Tuple[str, int, int, int]], size: Tuple[int, int]
+) -> np.ndarray:
+    """Decode one batch of index entries → (m, H, W, 3) uint8.
+
+    Undecodable members become zero images WITH their label kept (a
+    warning is logged): the streaming path must preserve row/label
+    alignment fixed by the index pass, where :meth:`ImageNetLoader.load`
+    can simply skip bad members."""
+    from keystone_tpu import native
+
+    by_tar: Dict[str, List[int]] = {}
+    for j, (t, off, sz, _lab) in enumerate(entries):
+        by_tar.setdefault(t, []).append(j)
+    blobs: List[bytes] = [b""] * len(entries)
+    for t, idxs in by_tar.items():
+        with open(t, "rb") as f:
+            for j in idxs:
+                _, off, sz, _ = entries[j]
+                f.seek(off)
+                blobs[j] = f.read(sz)
+    out = np.zeros((len(entries), *size, 3), np.uint8)
+    decoded = native.decode_jpegs(blobs, size)
+    if decoded is not None:
+        imgs, ok = decoded
+        for j in range(len(entries)):
+            if ok[j]:
+                out[j] = imgs[j]
+            else:
+                logger.warning(
+                    "undecodable member in %s at offset %d; substituting "
+                    "a zero image (label kept)",
+                    entries[j][0],
+                    entries[j][1],
+                )
+        return out
+    for j, (t, off, sz, _lab) in enumerate(entries):
+        try:
+            out[j] = _decode_jpeg(blobs[j], size)
+        except Exception:
+            logger.warning(
+                "undecodable member in %s at offset %d; substituting a "
+                "zero image (label kept)",
+                t,
+                off,
+            )
+    return out
+
+
 class ImageNetLoader:
+    @staticmethod
+    def index(
+        path: str, label_map: Optional[Dict[str, int]] = None
+    ) -> List[Tuple[str, int, int, int]]:
+        """Cheap header-only pass: ``(tar, offset, size, label)`` per
+        file member.  Fixes ``n`` and the label vector for streaming
+        without decoding a single JPEG."""
+        tars = _list_tars(path)
+        if label_map is None:
+            label_map = _default_label_map(tars)
+        from keystone_tpu import native
+
+        entries: List[Tuple[str, int, int, int]] = []
+        for t in tars:
+            synset = os.path.splitext(os.path.basename(t))[0]
+            lab = label_map.get(synset, 0)
+            idx = native.tar_index(t)
+            if idx is not None:
+                for _, off, sz in idx:
+                    entries.append((t, off, sz, lab))
+                continue
+            with tarfile.open(t) as tf:
+                for m in tf.getmembers():
+                    if m.isfile():
+                        entries.append((t, m.offset_data, m.size, lab))
+        return entries
+
+    @staticmethod
+    def stream(
+        path: str,
+        label_map: Optional[Dict[str, int]] = None,
+        size: Tuple[int, int] = (256, 256),
+        batch_size: int = 64,
+        limit: Optional[int] = None,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: labels from an index pass, pixels from a
+        re-iterable decoded stream.
+
+        Each pipeline stage that sweeps the data re-decodes from the tar
+        shards (the out-of-core contract: disk is the backing tier, host
+        RAM holds ``prefetch + 1`` batches).  Labels stay in memory —
+        they are 4 bytes/image."""
+        entries = ImageNetLoader.index(path, label_map)
+        if limit is not None:
+            entries = entries[:limit]
+        labels = np.asarray([e[3] for e in entries], np.int32)
+        n = len(entries)
+
+        def batches() -> Iterator[np.ndarray]:
+            for i in range(0, n, batch_size):
+                yield _decode_entry_batch(entries[i : i + batch_size], size)
+
+        name = (
+            f"imagenet-stream:{os.path.abspath(path)}:{size[0]}x{size[1]}"
+            f":lim{limit}:b{batch_size}"
+        )
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
+            Dataset(labels, name=name + "-labels"),
+        )
+
     @staticmethod
     def load(
         path: str,
@@ -40,20 +181,9 @@ class ImageNetLoader:
         """``path``: a tar file or a directory of per-synset tars.  Labels
         come from ``label_map[synset]``; by default synsets are enumerated
         in sorted order."""
-        tars: List[str] = (
-            [path]
-            if os.path.isfile(path)
-            else [
-                os.path.join(path, f)
-                for f in sorted(os.listdir(path))
-                if f.endswith(".tar")
-            ]
-        )
+        tars = _list_tars(path)
         if label_map is None:
-            label_map = {
-                os.path.splitext(os.path.basename(t))[0]: i
-                for i, t in enumerate(tars)
-            }
+            label_map = _default_label_map(tars)
         from keystone_tpu import native
 
         images, labels = [], []
@@ -111,28 +241,77 @@ class ImageNetLoader:
     ) -> LabeledData:
         """Class-structured texture images (oriented gratings + color bias
         per class) so SIFT/LCS features carry label signal."""
-        rng = np.random.default_rng(seed)
-        h, w = size
-        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
-        labels = rng.integers(0, num_classes, size=n)
-        imgs = np.zeros((n, h, w, 3), np.float32)
-        for i in range(n):
-            c = labels[i]
-            angle = np.pi * c / num_classes
-            freq = 0.2 + 0.05 * (c % 4)
-            phase = rng.uniform(0, 2 * np.pi)
-            grating = 0.5 + 0.5 * np.sin(
-                freq * (np.cos(angle) * xx + np.sin(angle) * yy) + phase
-            )
-            color = 0.3 + 0.6 * np.array(
-                [((c >> b) & 1) for b in range(3)], np.float32
-            )
-            img = grating[..., None] * color[None, None, :]
-            img += 0.05 * rng.normal(size=(h, w, 3))
-            imgs[i] = np.clip(img, 0, 1)
-        pixels = np.rint(imgs * 255.0).astype(np.uint8)
+        labels, pixels = _synth_all(n, num_classes, size, seed)
         name = f"imagenet-synth-n{n}-c{num_classes}-{size[0]}x{size[1]}-s{seed}"
         return LabeledData(
             Dataset(pixels, name=name),
             Dataset(labels.astype(np.int32), name=name + "-labels"),
         )
+
+    @staticmethod
+    def synthetic_stream(
+        n: int = 64,
+        num_classes: int = 16,
+        size: Tuple[int, int] = (64, 64),
+        seed: int = 0,
+        batch_size: int = 32,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Streaming variant of :meth:`synthetic` — PIXEL-IDENTICAL to it
+        for the same (n, num_classes, size, seed): each iteration replays
+        the same generator sequence, materializing only ``batch_size``
+        images at a time.  The stream-vs-in-memory demo/test path."""
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+
+        def batches() -> Iterator[np.ndarray]:
+            gen_rng = np.random.default_rng(seed)
+            labs = gen_rng.integers(0, num_classes, size=n)
+            buf: List[np.ndarray] = []
+            for i in range(n):
+                buf.append(_synth_image(labs[i], num_classes, size, gen_rng))
+                if len(buf) == batch_size:
+                    yield np.stack(buf)
+                    buf = []
+            if buf:
+                yield np.stack(buf)
+
+        name = (
+            f"imagenet-synth-stream-n{n}-c{num_classes}"
+            f"-{size[0]}x{size[1]}-s{seed}-b{batch_size}"
+        )
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
+            Dataset(labels, name=name + "-labels"),
+        )
+
+
+def _synth_image(
+    c: int, num_classes: int, size: Tuple[int, int], rng: np.random.Generator
+) -> np.ndarray:
+    """One class-structured texture image (uint8).  Draws exactly one
+    uniform (phase) then one normal block (noise) from ``rng`` — the
+    sequence :func:`_synth_all` and ``synthetic_stream`` both replay."""
+    h, w = size
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    angle = np.pi * c / num_classes
+    freq = 0.2 + 0.05 * (c % 4)
+    phase = rng.uniform(0, 2 * np.pi)
+    grating = 0.5 + 0.5 * np.sin(
+        freq * (np.cos(angle) * xx + np.sin(angle) * yy) + phase
+    )
+    color = 0.3 + 0.6 * np.array([((c >> b) & 1) for b in range(3)], np.float32)
+    img = grating[..., None] * color[None, None, :]
+    img += 0.05 * rng.normal(size=(h, w, 3))
+    return np.rint(np.clip(img, 0, 1) * 255.0).astype(np.uint8)
+
+
+def _synth_all(
+    n: int, num_classes: int, size: Tuple[int, int], seed: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    pixels = np.stack(
+        [_synth_image(labels[i], num_classes, size, rng) for i in range(n)]
+    )
+    return labels, pixels

@@ -1,72 +1,28 @@
-"""Out-of-core streaming input pipeline.
+"""Out-of-core streaming input helpers.
 
 The reference streams data through Spark partitions (RDD iterators,
-executor-side decode — SURVEY.md §2.5, §3.4); the TPU equivalent feeds the
-chip from host shards with decode/transform on host threads overlapping
-device compute (the role grain plays in TPU stacks; implemented here
-directly since grain isn't in this image — double-buffered producer
-threads + ``jax.device_put`` onto the mesh's 'data' sharding).
+executor-side decode — SURVEY.md §2.5, §3.4); the TPU equivalent feeds
+the chip from host shards with decode/transform on host threads
+overlapping device compute (the role grain plays in TPU stacks;
+implemented here directly since grain isn't in this image).
+
+The user-facing out-of-core type is
+:class:`keystone_tpu.workflow.dataset.StreamDataset`; this module holds
+the host-side building blocks loaders use to construct one:
+
+- :func:`batched` — re-iterable batch source over an in-memory array;
+- :func:`prefetched` — wrap any re-iterable batch source so host work
+  (decode, transforms) runs on a background thread one batch ahead of
+  the consumer.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterable, Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import numpy as np
-
-from keystone_tpu.parallel import mesh as _mesh
-
-
-class ShardedBatchStream:
-    """Iterate device-resident batches from a host record source.
-
-    source: an iterable of numpy batches (or a callable returning such an
-    iterator, so the stream is re-iterable).  Each batch is host-processed
-    by ``transform`` on a worker thread, then device_put with the batch
-    axis sharded over 'data'.
-    """
-
-    def __init__(
-        self,
-        source,
-        transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
-        prefetch: int = 2,
-    ):
-        self._source = source
-        self._transform = transform
-        self._prefetch = max(1, int(prefetch))
-
-    def _iterator(self) -> Iterator[np.ndarray]:
-        src = self._source() if callable(self._source) else iter(self._source)
-        return iter(src)
-
-    def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
-        sentinel = object()
-        err: list = []
-
-        def produce():
-            try:
-                for batch in self._iterator():
-                    if self._transform is not None:
-                        batch = self._transform(batch)
-                    q.put(batch)
-            except BaseException as e:  # surface worker errors to consumer
-                err.append(e)
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                if err:
-                    raise err[0]
-                return
-            yield _mesh.shard_batch(item)
 
 
 def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndarray]]:
@@ -75,5 +31,68 @@ def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndar
     def gen():
         for i in range(0, len(array), batch_size):
             yield array[i : i + batch_size]
+
+    return gen
+
+
+def prefetched(
+    source,
+    transform: Optional[Callable] = None,
+    prefetch: int = 2,
+) -> Callable[[], Iterator]:
+    """Re-iterable source whose host work runs on a producer thread.
+
+    ``source``: an iterable of host batches, or a callable returning a
+    fresh iterator (required for re-iteration).  Each batch is passed
+    through ``transform`` on the worker thread, then handed to the
+    consumer through a bounded queue (``prefetch`` deep) so decode
+    overlaps device compute.  Worker exceptions re-raise in the
+    consumer.
+    """
+    depth = max(1, int(prefetch))
+
+    def gen():
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        sentinel = object()
+        stop = threading.Event()
+        err: list = []
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer abandoned the
+            # generator — otherwise the thread would park forever on a
+            # full queue, pinning decoded host batches
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                src = source() if callable(source) else iter(source)
+                for batch in src:
+                    if transform is not None:
+                        batch = transform(batch)
+                    if stop.is_set() or not put(batch):
+                        return
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                put(sentinel)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
 
     return gen

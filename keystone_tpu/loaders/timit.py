@@ -40,6 +40,59 @@ class TimitFeaturesDataLoader:
         )
 
     @staticmethod
+    def stream(
+        features_path: str,
+        labels_path: str,
+        batch_size: int = 8192,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: ``.npy`` features are memory-mapped and
+        re-read in ``batch_size``-frame chunks per sweep (labels — 4
+        bytes/frame — stay in memory).  CSV features fall back to the
+        CsvDataLoader-style chunked re-parse."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        labels = (
+            np.load(labels_path)
+            if labels_path.endswith(".npy")
+            else np.loadtxt(labels_path, dtype=np.int64)
+        ).astype(np.int32)
+        n = len(labels)
+        name = (
+            f"timit-stream:{os.path.abspath(features_path)}"
+            f":{os.path.abspath(labels_path)}:b{batch_size}"
+        )
+
+        if features_path.endswith(".npy"):
+
+            def batches():
+                mm = np.load(features_path, mmap_mode="r")
+                for i in range(0, n, batch_size):
+                    yield np.asarray(mm[i : i + batch_size], np.float32)
+
+        else:
+
+            def batches():
+                buf = []
+                with open(features_path) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        buf.append(line)
+                        if len(buf) == batch_size:
+                            yield np.loadtxt(
+                                buf, delimiter=",", dtype=np.float32, ndmin=2
+                            )
+                            buf = []
+                if buf:
+                    yield np.loadtxt(buf, delimiter=",", dtype=np.float32, ndmin=2)
+
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
+            Dataset(labels, name=name + "-labels"),
+        )
+
+    @staticmethod
     def synthetic(n: int = 4096, num_classes: int = NUM_CLASSES, seed: int = 0) -> LabeledData:
         rng = np.random.default_rng(seed)
         labels = rng.integers(0, num_classes, size=n)

@@ -136,20 +136,34 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     where P = Σ_b X_b W_b is the running prediction.
     """
 
+    # class-level default for pre-spill_dtype pickles
+    spill_dtype = "float32"
+
     def __init__(
         self,
         block_size: int = 4096,
         num_iter: int = 1,
         lam: float = 0.0,
         fit_intercept: bool = True,
+        spill_dtype: str = "float32",
     ):
         self.block_size = int(block_size)
         self.num_iter = int(num_iter)
         self.lam = float(lam)
         self.fit_intercept = fit_intercept
+        #: out-of-core spill precision: "bfloat16" halves disk + wire
+        #: bytes per sweep (a bandwidth lever — utils/precision.py);
+        #: solver math stays f32 either way
+        self.spill_dtype = str(spill_dtype)
 
     def params(self):
-        return (self.block_size, self.num_iter, self.lam, self.fit_intercept)
+        return (
+            self.block_size,
+            self.num_iter,
+            self.lam,
+            self.fit_intercept,
+            self.spill_dtype,
+        )
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
@@ -175,7 +189,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.workflow.blockstore import FeatureBlockStore
 
         store = FeatureBlockStore.from_batches(
-            _spill_dir(spill_dir), data.batches(), data.n, self.block_size
+            _spill_dir(spill_dir),
+            data.batches(),
+            data.n,
+            self.block_size,
+            dtype=self.spill_dtype,
         )
         fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
         shutil.rmtree(store.directory, ignore_errors=True)
@@ -465,6 +483,10 @@ def _oc_bcd_fit(
                 f"store rows pad to {a.shape[0]} but labels have {n_rows}: "
                 "store.n must equal the label Dataset's n"
             )
+        # bf16 stores cross the host→device wire at half width; solver
+        # math stays f32 — cast on DEVICE, after the transfer
+        if a.dtype != jnp.float32:
+            a = a.astype(jnp.float32)
         return a
 
     if fit_intercept:

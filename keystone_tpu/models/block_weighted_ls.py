@@ -52,6 +52,9 @@ def class_weights(y: jnp.ndarray, n, mixture_weight: float):
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
+    # class-level default for pre-spill_dtype pickles
+    spill_dtype = "float32"
+
     def __init__(
         self,
         block_size: int = 4096,
@@ -59,12 +62,17 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         lam: float = 0.0,
         mixture_weight: float = 0.5,
         fit_intercept: bool = True,
+        spill_dtype: str = "float32",
     ):
         self.block_size = int(block_size)
         self.num_iter = int(num_iter)
         self.lam = float(lam)
         self.mixture_weight = float(mixture_weight)
         self.fit_intercept = fit_intercept
+        #: out-of-core spill precision: "bfloat16" halves disk + wire
+        #: bytes per sweep (a bandwidth lever — utils/precision.py);
+        #: solver math stays f32 either way
+        self.spill_dtype = str(spill_dtype)
 
     def params(self):
         return (
@@ -73,6 +81,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             self.lam,
             self.mixture_weight,
             self.fit_intercept,
+            self.spill_dtype,
         )
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
@@ -96,7 +105,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.workflow.blockstore import FeatureBlockStore
 
         store = FeatureBlockStore.from_batches(
-            _spill_dir(spill_dir), data.batches(), data.n, self.block_size
+            _spill_dir(spill_dir),
+            data.batches(),
+            data.n,
+            self.block_size,
+            dtype=self.spill_dtype,
         )
         fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
         shutil.rmtree(store.directory, ignore_errors=True)

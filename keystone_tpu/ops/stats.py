@@ -196,6 +196,10 @@ class StandardScaler(Estimator):
         return (self.normalize_std, self.eps)
 
     def fit_dataset(self, data: Dataset) -> StandardScalerModel:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(data, StreamDataset):
+            return self.fit_stream(data.batches)
         return self._fit(data.array, data.n)
 
     def fit_arrays(self, x) -> StandardScalerModel:
@@ -334,6 +338,40 @@ class ColumnSampler(Transformer):
         return (self.num_samples, self.seed)
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        key = jax.random.PRNGKey(self.seed)
+        if isinstance(ds, StreamDataset):
+            # Out-of-core path: sample each descriptor batch as it
+            # streams past and keep only the (small) samples.  Keys are
+            # derived from the GLOBAL item index, so the sample is
+            # identical to the in-memory path regardless of batching.
+            import numpy as np
+
+            outs = []
+            offset = 0
+            for arr, mask in ds.device_batches():
+                if arr.ndim != 3:
+                    raise ValueError(
+                        "ColumnSampler expects (n, max_k, d) descriptor sets"
+                    )
+                m = arr.shape[0]
+                out = _sample_descriptors(
+                    arr,
+                    mask
+                    if mask is not None
+                    else jnp.ones(arr.shape[:2], jnp.float32),
+                    self.num_samples,
+                    key,
+                    offset=offset,
+                )
+                outs.append(np.asarray(out.reshape(m * self.num_samples, -1)))
+                offset += m
+            if offset != ds.n:
+                raise ValueError(
+                    f"descriptor stream produced {offset} items, expected {ds.n}"
+                )
+            return Dataset(np.concatenate(outs, axis=0))
         arr = ds.array
         if arr.ndim != 3:
             raise ValueError("ColumnSampler expects (n, max_k, d) descriptor sets")
@@ -344,7 +382,7 @@ class ColumnSampler(Transformer):
             if ds.mask is not None
             else jnp.ones(arr.shape[:2], jnp.float32),
             self.num_samples,
-            jax.random.PRNGKey(self.seed),
+            key,
         )
         flat = out[:n].reshape(n * self.num_samples, arr.shape[-1])
         return Dataset(flat)
@@ -357,9 +395,14 @@ from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("k",))
-def _sample_descriptors(arr, mask, k, key):
+def _sample_descriptors(arr, mask, k, key, offset=0):
     n, max_k, d = arr.shape
-    keys = jax.random.split(key, n)
+    # Per-item keys fold in the GLOBAL item index (offset for stream
+    # batches), so sampling is batching-invariant: the streaming and
+    # in-memory paths draw identical descriptors for the same seed.
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n, dtype=jnp.int32) + jnp.int32(offset)
+    )
 
     def per_item(a, m, kk):
         logits = jnp.where(m > 0, 0.0, -jnp.inf)

@@ -70,6 +70,12 @@ class Config:
     # persist/reuse the fitted pipeline (standard and augmented paths;
     # the config is saved alongside and checked on load)
     model_path: Optional[str] = None
+    # out-of-core: load training images as a StreamDataset (tar shards
+    # re-decoded per sweep on a prefetch thread) so the feature matrix
+    # spills to a FeatureBlockStore instead of HBM — the reference's
+    # ImageNetLoader-streams-through-RDD-partitions scaling path
+    stream: bool = False
+    stream_batch_size: int = 64
 
 
 def _fv_branch(base: Pipeline, config: Config, train_x: Dataset, seed: int) -> Pipeline:
@@ -148,7 +154,11 @@ class ImageNetSiftLcsFV:
     def run(config: Config) -> dict:
         sz = (config.image_size, config.image_size)
         if config.train_path:
-            test = ImageNetLoader.load(config.test_path or config.train_path)
+            # image_size governs the resize for real tars too, so train
+            # and test always agree on resolution
+            test = ImageNetLoader.load(
+                config.test_path or config.train_path, size=sz
+            )
         else:
             test = ImageNetLoader.synthetic(
                 max(8, config.synthetic_n // 4), config.num_classes, size=sz, seed=2
@@ -156,8 +166,22 @@ class ImageNetSiftLcsFV:
 
         def _train():
             # loaded ONLY when a fit is needed (saved-model runs skip it)
+            if config.stream:
+                if config.train_path:
+                    return ImageNetLoader.stream(
+                        config.train_path,
+                        size=sz,
+                        batch_size=config.stream_batch_size,
+                    )
+                return ImageNetLoader.synthetic_stream(
+                    config.synthetic_n,
+                    config.num_classes,
+                    size=sz,
+                    seed=1,
+                    batch_size=config.stream_batch_size,
+                )
             if config.train_path:
-                return ImageNetLoader.load(config.train_path)
+                return ImageNetLoader.load(config.train_path, size=sz)
             return ImageNetLoader.synthetic(
                 config.synthetic_n, config.num_classes, size=sz, seed=1
             )
@@ -243,6 +267,15 @@ def main(argv=None):
     p.add_argument("--image-size", type=int, default=64)
     p.add_argument("--augmented-eval", action="store_true")
     p.add_argument("--model-path")
+    p.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help="stream training images from tar shards; features spill to "
+        "a disk block store instead of residing in HBM",
+    )
+    p.add_argument("--stream-batch-size", type=int, default=64)
     a = p.parse_args(argv)
     cfg = Config(
         train_path=a.train_path,
@@ -255,6 +288,8 @@ def main(argv=None):
         image_size=a.image_size,
         augmented_eval=a.augmented_eval,
         model_path=a.model_path,
+        stream=a.stream,
+        stream_batch_size=a.stream_batch_size,
     )
     print(ImageNetSiftLcsFV.run(cfg))
 

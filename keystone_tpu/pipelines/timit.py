@@ -38,6 +38,10 @@ class Config:
     seed: int = 0
     synthetic_n: int = 4096
     model_path: Optional[str] = None
+    # out-of-core: stream MFCC frames from disk per sweep; the cosine
+    # feature matrix spills to a FeatureBlockStore instead of HBM
+    stream: bool = False
+    stream_batch_size: int = 8192
 
 
 class TimitPipeline:
@@ -46,7 +50,12 @@ class TimitPipeline:
 
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
-        dim = train_x.array.shape[1]
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(train_x, StreamDataset):
+            (dim,) = train_x.peek_shape()  # one batch, not the stream
+        else:
+            dim = train_x.array.shape[1]
         num_blocks = max(1, config.num_cosine_features // config.cosine_block_size)
         branches = [
             Pipeline.of(
@@ -83,17 +92,41 @@ class TimitPipeline:
             # needs it — one parse, not two
             if not _train_cache:
                 if config.features_path:
+                    loader = (
+                        TimitFeaturesDataLoader.stream
+                        if config.stream
+                        else TimitFeaturesDataLoader.load
+                    )
+                    kw = (
+                        {"batch_size": config.stream_batch_size}
+                        if config.stream
+                        else {}
+                    )
                     _train_cache.append(
-                        TimitFeaturesDataLoader.load(
-                            config.features_path, config.labels_path
-                        )
+                        loader(config.features_path, config.labels_path, **kw)
                     )
                 else:
-                    _train_cache.append(
-                        TimitFeaturesDataLoader.synthetic(
-                            config.synthetic_n, config.num_classes, seed=1
-                        )
+                    synth = TimitFeaturesDataLoader.synthetic(
+                        config.synthetic_n, config.num_classes, seed=1
                     )
+                    if config.stream:
+                        # demo/test path: stream the synthetic frames in
+                        # batches so the out-of-core fit path engages
+                        from keystone_tpu.loaders.stream import batched
+                        from keystone_tpu.loaders.labeled import LabeledData
+                        from keystone_tpu.workflow.dataset import StreamDataset
+
+                        synth = LabeledData(
+                            StreamDataset(
+                                batched(
+                                    synth.data.numpy(),
+                                    config.stream_batch_size,
+                                ),
+                                n=synth.data.n,
+                            ),
+                            synth.labels,
+                        )
+                    _train_cache.append(synth)
             return _train_cache[0]
 
         if config.features_path:
@@ -135,6 +168,10 @@ class TimitPipeline:
             "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
+            # macro metrics surface class-balance effects: on skewed
+            # data they are what mixture_weight exists to move
+            "macro_f1": m.macro_f1,
+            "macro_recall": m.macro_recall,
         }
 
 
@@ -148,6 +185,15 @@ def main(argv=None):
     p.add_argument("--num-classes", type=int, default=NUM_CLASSES)
     p.add_argument("--synthetic-n", type=int, default=4096)
     p.add_argument("--model-path")
+    p.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help="stream MFCC frames from disk; cosine features spill to a "
+        "disk block store instead of residing in HBM",
+    )
+    p.add_argument("--stream-batch-size", type=int, default=8192)
     a = p.parse_args(argv)
     cfg = Config(
         features_path=a.features_path,
@@ -158,6 +204,8 @@ def main(argv=None):
         num_classes=a.num_classes,
         synthetic_n=a.synthetic_n,
         model_path=a.model_path,
+        stream=a.stream,
+        stream_batch_size=a.stream_batch_size,
     )
     print(TimitPipeline.run(cfg))
 

@@ -20,6 +20,14 @@ The final block is zero-padded on columns to ``block_size`` (the
 VectorSplitter convention, nodes/util/VectorSplitter.scala), which keeps
 every device transfer and every compiled block-step identical in shape —
 one XLA program serves all (epoch, block) steps.
+
+``dtype="bfloat16"`` halves both the disk footprint and the
+disk→host→device bytes per sweep — on this chip bf16 is a bandwidth
+lever, not a compute lever (utils/precision.py), and the out-of-core
+sweep is bandwidth-bound, so this is exactly where it pays.  Blocks are
+stored as uint16 bit patterns (npy's parser chokes on the registered
+bfloat16 descr) and read back as ml_dtypes.bfloat16; consumers cast to
+f32 ON DEVICE so solver math is unchanged.
 """
 
 from __future__ import annotations
@@ -33,6 +41,13 @@ from typing import Iterable, Iterator, Optional, Sequence, Tuple
 import numpy as np
 
 _META = "meta.json"
+_DTYPES = ("float32", "bfloat16")
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
 
 
 class FeatureBlockStore:
@@ -50,21 +65,43 @@ class FeatureBlockStore:
         self.d = int(meta["d"])
         self.block_size = int(meta["block_size"])
         self.num_blocks = int(meta["nb"])
+        # stores written before the dtype option are float32
+        self.dtype = str(meta.get("dtype", "float32"))
+
+    @property
+    def _disk_dtype(self):
+        return np.uint16 if self.dtype == "bfloat16" else np.float32
 
     # ------------------------------------------------------------ create
     @classmethod
-    def create(cls, directory: str, n: int, d: int, block_size: int):
+    def create(
+        cls,
+        directory: str,
+        n: int,
+        d: int,
+        block_size: int,
+        dtype: str = "float32",
+    ):
         """Allocate an empty store; fill it with :meth:`append_rows`."""
+        if dtype not in _DTYPES:
+            raise ValueError(f"dtype must be one of {_DTYPES}, got {dtype!r}")
         os.makedirs(directory, exist_ok=True)
         nb = -(-d // block_size)
-        meta = {"n": int(n), "d": int(d), "block_size": int(block_size), "nb": nb}
+        meta = {
+            "n": int(n),
+            "d": int(d),
+            "block_size": int(block_size),
+            "nb": nb,
+            "dtype": dtype,
+        }
         with open(os.path.join(directory, _META), "w") as f:
             json.dump(meta, f)
+        disk_dtype = np.uint16 if dtype == "bfloat16" else np.float32
         for b in range(nb):
             mm = np.lib.format.open_memmap(
                 cls._block_path(directory, b),
                 mode="w+",
-                dtype=np.float32,
+                dtype=disk_dtype,
                 shape=(n, block_size),
             )
             del mm  # flushed zero-initialized file
@@ -93,27 +130,36 @@ class FeatureBlockStore:
             chunk = x[:, b * bs : (b + 1) * bs]
             if chunk.shape[1] < bs:  # final ragged block: zero-pad columns
                 chunk = np.pad(chunk, ((0, 0), (0, bs - chunk.shape[1])))
+            if self.dtype == "bfloat16":
+                chunk = chunk.astype(_bf16()).view(np.uint16)
             mm[start:stop] = chunk
             del mm
         self._cursor = stop
 
     @classmethod
-    def from_array(cls, directory: str, x, block_size: int):
+    def from_array(cls, directory: str, x, block_size: int, dtype: str = "float32"):
         x = np.asarray(x, np.float32)
-        store = cls.create(directory, x.shape[0], x.shape[1], block_size)
+        store = cls.create(directory, x.shape[0], x.shape[1], block_size, dtype=dtype)
         store.append_rows(x)
         return store
 
     @classmethod
     def from_batches(
-        cls, directory: str, batches: Iterable[np.ndarray], n: int, block_size: int
+        cls,
+        directory: str,
+        batches: Iterable[np.ndarray],
+        n: int,
+        block_size: int,
+        dtype: str = "float32",
     ):
         """Build from a stream of (m_i, d) host batches (Σ m_i == n)."""
         store = None
         for batch in batches:
             batch = np.asarray(batch, np.float32)
             if store is None:
-                store = cls.create(directory, n, batch.shape[1], block_size)
+                store = cls.create(
+                    directory, n, batch.shape[1], block_size, dtype=dtype
+                )
             store.append_rows(batch)
         if store is None:
             raise ValueError("empty batch stream")
@@ -125,8 +171,15 @@ class FeatureBlockStore:
 
     # -------------------------------------------------------------- read
     def read_block(self, b: int) -> np.ndarray:
-        """One (n, block_size) block, as an in-memory host array."""
-        return np.array(np.load(self._block_path(self.directory, b), mmap_mode="r"))
+        """One (n, block_size) block, as an in-memory host array.
+
+        bf16 stores return ml_dtypes.bfloat16 — consumers transfer the
+        half-width bytes to device and cast to f32 THERE (halving the
+        host→device wire cost, the scarce resource on this backend)."""
+        raw = np.array(np.load(self._block_path(self.directory, b), mmap_mode="r"))
+        if self.dtype == "bfloat16":
+            return raw.view(_bf16())
+        return raw
 
     def iter_blocks(
         self, order: Sequence[int], prefetch: int = 2
@@ -175,4 +228,5 @@ class FeatureBlockStore:
             stop.set()
 
     def nbytes(self) -> int:
-        return self.n * self.num_blocks * self.block_size * 4
+        itemsize = 2 if self.dtype == "bfloat16" else 4
+        return self.n * self.num_blocks * self.block_size * itemsize

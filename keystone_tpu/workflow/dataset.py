@@ -131,6 +131,9 @@ class StreamDataset(Dataset):
     ``source``: a callable returning an iterator of host batches (or a
     re-iterable).  Each batch is a ``(m_i, ...)`` array or an
     ``(array, mask)`` pair for ragged payloads.  ``n`` — total rows.
+    ``prefetch`` > 0 moves the source's host work (decode, transforms)
+    onto a background thread that stays ``prefetch`` batches ahead of
+    the consumer (loaders pass their decode cost through this).
 
     Estimators without a streaming fit path fall back to
     :attr:`array`, which materializes the whole stream into device
@@ -138,7 +141,9 @@ class StreamDataset(Dataset):
     out-of-core guarantee only where implemented.
     """
 
-    def __init__(self, source, n: int, name: Optional[str] = None):
+    def __init__(
+        self, source, n: int, name: Optional[str] = None, prefetch: int = 0
+    ):
         self.name = name
         self.n = int(n)
         self._host = None
@@ -152,6 +157,10 @@ class StreamDataset(Dataset):
                 "returning a fresh iterator (or a list of batches), not a "
                 "one-shot generator/iterator"
             )
+        if prefetch > 0:
+            from keystone_tpu.loaders.stream import prefetched
+
+            source = prefetched(source, prefetch=prefetch)
 
         def gen():
             src = source() if callable(source) else iter(source)
@@ -176,6 +185,18 @@ class StreamDataset(Dataset):
     def device_batches(self):
         """Iterate ``(array, mask_or_None)`` device batches."""
         return self._gen()
+
+    def peek_shape(self) -> tuple:
+        """Per-item shape ``(...)`` from the first batch (cached) —
+        lets callers derive feature dims without materializing the
+        stream (costs one batch's host work on first call)."""
+        if not hasattr(self, "_peek_shape"):
+            for arr, _ in self._gen():
+                self._peek_shape = tuple(arr.shape[1:])
+                break
+            else:
+                raise ValueError("empty stream")
+        return self._peek_shape
 
     def batches(self):
         """Iterate host (numpy) batches of the mapped values."""

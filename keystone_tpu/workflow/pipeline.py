@@ -374,6 +374,11 @@ def fit_relevant_config(config, exclude=()):
         "test_features_path",
         "test_labels_path",
         "view_patch",
+        # execution strategy, not model identity: streaming the same
+        # data fits the same model (to fp tolerance), so a saved model
+        # stays valid across in-memory/out-of-core runs
+        "stream",
+        "stream_batch_size",
     } | set(exclude)
     for k in eval_only:
         d.pop(k, None)

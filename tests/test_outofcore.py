@@ -216,3 +216,99 @@ def test_stream_host_transformer_rejected():
     host_t = LambdaTransformer(lambda s: s, name="HostOp", host=True)
     with pytest.raises(TypeError, match="host transformer"):
         host_t.apply_dataset(stream)
+
+
+# -------------------------------------------------------- bf16 spill tier
+
+
+def test_store_bf16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    x = np.random.default_rng(5).normal(size=(10, 6)).astype(np.float32)
+    store = FeatureBlockStore.from_array(
+        str(tmp_path / "b"), x, block_size=4, dtype="bfloat16"
+    )
+    assert store.dtype == "bfloat16"
+    b0 = store.read_block(0)
+    assert b0.dtype == ml_dtypes.bfloat16
+    # values round-trip at bf16 precision (8-bit mantissa)
+    np.testing.assert_allclose(
+        b0.astype(np.float32), x[:, :4].astype(ml_dtypes.bfloat16).astype(np.float32)
+    )
+    # half the disk footprint of an f32 store
+    f32 = FeatureBlockStore.from_array(str(tmp_path / "f"), x, block_size=4)
+    assert store.nbytes() * 2 == f32.nbytes()
+
+
+def test_store_meta_backcompat_missing_dtype(tmp_path):
+    """Stores written before the dtype option must load as float32."""
+    import json
+    import os
+
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=4)
+    meta_path = os.path.join(store.directory, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta.pop("dtype")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    reloaded = FeatureBlockStore(store.directory)
+    assert reloaded.dtype == "float32"
+    np.testing.assert_array_equal(reloaded.read_block(0), x)
+
+
+def test_store_invalid_dtype_raises(tmp_path):
+    with pytest.raises(ValueError, match="dtype"):
+        FeatureBlockStore.create(str(tmp_path / "s"), 4, 4, 2, dtype="float16")
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_oc_bf16_spill_matches_inmemory(tmp_path, weighted):
+    """bf16 spill halves sweep IO; the fitted model must still match the
+    in-memory f32 fit to bf16-quantization tolerance (weights are O(1),
+    bf16 has ~3 decimal digits -> atol ~1e-2 after 3 BCD epochs)."""
+    x, y, _ = _problem(seed=7, skew=weighted)
+    cls = (
+        BlockWeightedLeastSquaresEstimator if weighted else BlockLeastSquaresEstimator
+    )
+    est = cls(block_size=16, num_iter=3, lam=1e-2)
+    ref = est.fit_arrays(x, y)
+    store = FeatureBlockStore.from_array(
+        str(tmp_path / "s"), x, block_size=16, dtype="bfloat16"
+    )
+    oc = est.fit_store(store, Dataset(y, n=y.shape[0]))
+    np.testing.assert_allclose(
+        np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(oc.intercept), np.asarray(ref.intercept), atol=2e-2
+    )
+
+
+def test_oc_spill_dtype_plumbed_through_stream_fit(tmp_path, monkeypatch):
+    """StreamDataset -> fit_stream_dataset spills at the estimator's
+    spill_dtype."""
+    from keystone_tpu.workflow import blockstore as bs_mod
+
+    seen = []
+    orig = bs_mod.FeatureBlockStore.from_batches.__func__
+
+    def spy(cls, directory, batches, n, block_size, dtype="float32"):
+        seen.append(dtype)
+        return orig(cls, directory, batches, n, block_size, dtype=dtype)
+
+    monkeypatch.setattr(
+        bs_mod.FeatureBlockStore, "from_batches", classmethod(spy)
+    )
+    x, y, _ = _problem(seed=9)
+    est = BlockLeastSquaresEstimator(
+        block_size=16, num_iter=2, lam=1e-2, spill_dtype="bfloat16"
+    )
+    stream = StreamDataset([x[:32], x[32:64], x[64:]], n=x.shape[0])
+    oc = est.fit_stream_dataset(stream, Dataset(y, n=y.shape[0]))
+    assert seen == ["bfloat16"]
+    ref = est.fit_arrays(x, y)
+    np.testing.assert_allclose(
+        np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-2
+    )

@@ -1,0 +1,273 @@
+"""Out-of-core end to end: loader → StreamDataset → app → CLI.
+
+The reference's scaling story starts at the loader (ImageNetLoader
+streams tar shards through RDD partitions into the whole pipeline —
+SURVEY.md §2.5/§3.4); these tests pin the TPU analogue: tar shards →
+StreamDataset → two-branch SIFT/LCS+FV featurization → out-of-core
+BlockWeightedLS spill-fit, producing the SAME model as the in-memory
+path, with the feature matrix never materialized in device memory.
+"""
+
+import io
+import logging
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.csv_loader import CsvDataLoader
+from keystone_tpu.loaders.imagenet import ImageNetLoader
+from keystone_tpu.loaders.timit import TimitFeaturesDataLoader
+from keystone_tpu.workflow import Dataset, StreamDataset
+
+
+def _write_jpeg_tars(root, num_tars=3, per_tar=4, size=(48, 48), seed=0):
+    """A multi-tar fixture of decodable JPEGs, one synset per tar."""
+    from PIL import Image as PILImage
+
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    # per-SYNSET base colors, well separated, so classes are learnable
+    anchors = np.array(
+        [[200, 60, 60], [60, 200, 60], [60, 60, 200], [200, 200, 60]],
+        np.float32,
+    )
+    for t in range(num_tars):
+        path = os.path.join(root, f"n{t:08d}.tar")
+        base_color = anchors[t % len(anchors)]
+        with tarfile.open(path, "w") as tf:
+            for j in range(per_tar):
+                # low-frequency texture so JPEG decode is near-lossless
+                base = base_color + rng.uniform(-15, 15, size=(3,))
+                img = np.tile(base, (*size, 1)) + rng.normal(0, 8, (*size, 3))
+                pil = PILImage.fromarray(
+                    np.clip(img, 0, 255).astype(np.uint8)
+                )
+                buf = io.BytesIO()
+                pil.save(buf, format="JPEG", quality=95)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=f"n{t:08d}_{j}.JPEG")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    return root
+
+
+# ------------------------------------------------------------- loaders
+
+
+def test_imagenet_index_counts_members(tmp_path):
+    root = _write_jpeg_tars(str(tmp_path / "tars"), num_tars=3, per_tar=4)
+    entries = ImageNetLoader.index(root)
+    assert len(entries) == 12
+    labels = [e[3] for e in entries]
+    assert labels == [0] * 4 + [1] * 4 + [2] * 4
+
+
+def test_imagenet_stream_matches_load(tmp_path, mesh):
+    root = _write_jpeg_tars(str(tmp_path / "tars"))
+    size = (48, 48)
+    mem = ImageNetLoader.load(root, size=size)
+    st = ImageNetLoader.stream(root, size=size, batch_size=5)
+    assert isinstance(st.data, StreamDataset)
+    assert st.data.n == mem.data.n
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    got = np.concatenate(list(st.data.batches()))
+    np.testing.assert_array_equal(got, mem.data.numpy())
+    # re-iterable: a second sweep decodes the same pixels
+    again = np.concatenate(list(st.data.batches()))
+    np.testing.assert_array_equal(again, got)
+
+
+def test_imagenet_stream_limit(tmp_path):
+    root = _write_jpeg_tars(str(tmp_path / "tars"))
+    st = ImageNetLoader.stream(root, size=(48, 48), batch_size=4, limit=7)
+    assert st.data.n == 7 and st.labels.n == 7
+
+
+def test_synthetic_stream_pixel_identical_to_synthetic(mesh):
+    st = ImageNetLoader.synthetic_stream(24, 4, size=(48, 48), seed=1, batch_size=7)
+    mem = ImageNetLoader.synthetic(24, 4, size=(48, 48), seed=1)
+    np.testing.assert_array_equal(
+        np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+
+
+def test_csv_stream_matches_load(tmp_path, mesh):
+    rng = np.random.default_rng(0)
+    mat = np.column_stack(
+        [rng.integers(0, 5, size=23), rng.normal(size=(23, 7))]
+    )
+    path = str(tmp_path / "rows.csv")
+    np.savetxt(path, mat, delimiter=",", fmt="%.6f")
+    mem = CsvDataLoader.load(path)
+    st = CsvDataLoader.stream(path, batch_size=6)
+    assert st.data.n == 23
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_allclose(
+        np.concatenate(list(st.data.batches())), mem.data.numpy(), rtol=1e-6
+    )
+
+
+def test_timit_stream_matches_load_npy(tmp_path, mesh):
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(31, 12)).astype(np.float32)
+    labs = rng.integers(0, 9, size=31).astype(np.int64)
+    fp, lp = str(tmp_path / "f.npy"), str(tmp_path / "l.npy")
+    np.save(fp, feats)
+    np.save(lp, labs)
+    mem = TimitFeaturesDataLoader.load(fp, lp)
+    st = TimitFeaturesDataLoader.stream(fp, lp, batch_size=8)
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_allclose(
+        np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
+
+
+def test_column_sampler_stream_matches_inmemory(mesh):
+    from keystone_tpu.ops import ColumnSampler
+
+    rng = np.random.default_rng(3)
+    descs = rng.normal(size=(20, 15, 6)).astype(np.float32)
+    masks = (rng.uniform(size=(20, 15)) < 0.7).astype(np.float32)
+    masks[:, 0] = 1.0  # every item keeps at least one valid descriptor
+    cs = ColumnSampler(8, seed=5)
+    mem = cs.apply_dataset(Dataset(descs, mask=Dataset(masks).array))
+    batches = [
+        (descs[:7], masks[:7]),
+        (descs[7:12], masks[7:12]),
+        (descs[12:], masks[12:]),
+    ]
+    st = cs.apply_dataset(StreamDataset(batches, n=20))
+    np.testing.assert_allclose(st.numpy(), mem.numpy(), rtol=1e-6)
+
+
+# ------------------------------------------------- end-to-end app parity
+
+
+def _fv_config(stream: bool, **kw):
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import Config
+
+    base = dict(
+        num_classes=4,
+        synthetic_n=24,
+        image_size=48,
+        gmm_k=4,
+        pca_dims=16,
+        num_epochs=2,
+        descriptor_samples_per_image=16,
+        solver_block_size=64,
+        stream=stream,
+        stream_batch_size=7,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_imagenet_fv_stream_fit_matches_inmemory(mesh, caplog, monkeypatch):
+    """The north-star gate: tar-shard-style streaming through the FULL
+    two-branch pipeline produces the in-memory model's predictions,
+    the features spill through a FeatureBlockStore, and the big stream
+    is never materialized into device memory."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetSiftLcsFV
+    from keystone_tpu.workflow import blockstore
+
+    cfg = _fv_config(stream=False)
+    train_mem = ImageNetLoader.synthetic(24, 4, size=(48, 48), seed=1)
+    test = ImageNetLoader.synthetic(8, 4, size=(48, 48), seed=2)
+    fitted_mem = ImageNetSiftLcsFV.build(
+        cfg, train_mem.data, train_mem.labels
+    ).fit()
+    pred_mem = fitted_mem(test.data).get().numpy()
+
+    spills = []
+    orig = blockstore.FeatureBlockStore.from_batches.__func__
+
+    def spy(cls, directory, batches, n, block_size, dtype="float32"):
+        store = orig(cls, directory, batches, n, block_size, dtype=dtype)
+        spills.append((n, store.d))
+        return store
+
+    monkeypatch.setattr(
+        blockstore.FeatureBlockStore, "from_batches", classmethod(spy)
+    )
+    train_st = ImageNetLoader.synthetic_stream(
+        24, 4, size=(48, 48), seed=1, batch_size=7
+    )
+    with caplog.at_level(logging.WARNING, "keystone_tpu.workflow.dataset"):
+        fitted_st = ImageNetSiftLcsFV.build(
+            _fv_config(stream=True), train_st.data, train_st.labels
+        ).fit()
+        pred_st = fitted_st(test.data).get().numpy()
+    assert spills and spills[0][0] == 24  # out-of-core spill path engaged
+    assert not [
+        r for r in caplog.records if "materializing StreamDataset" in r.message
+    ], "a pipeline stage materialized the stream"
+    np.testing.assert_array_equal(pred_st, pred_mem)
+
+
+def test_imagenet_fv_app_entry_stream(mesh):
+    """Through the app's run() entry point (the user-facing command)."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetSiftLcsFV
+
+    out = ImageNetSiftLcsFV.run(_fv_config(stream=True))
+    assert out["pipeline"] == "ImageNetSiftLcsFV"
+    assert 0.0 <= out["top5_error"] <= 1.0
+    # the synthetic textures are learnable: streaming must not break fit
+    assert out["accuracy"] > 0.5
+
+
+def test_imagenet_fv_app_from_tar_fixture_stream(tmp_path, mesh):
+    """One command fits from multi-tar shards via --stream: the loader
+    indexes the tars, streams decode, and the fit goes out-of-core."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetSiftLcsFV
+
+    root = _write_jpeg_tars(
+        str(tmp_path / "tars"), num_tars=3, per_tar=6, size=(48, 48)
+    )
+    cfg = _fv_config(
+        stream=True, train_path=root, test_path=root, num_classes=3
+    )
+    out = ImageNetSiftLcsFV.run(cfg)
+    # 3 flat-color synsets are separable by the LCS branch's color stats
+    assert out["accuracy"] > 0.9
+
+
+def test_timit_app_stream_matches_inmemory(mesh):
+    from keystone_tpu.pipelines.timit import Config, TimitPipeline
+
+    base = dict(
+        num_cosine_features=256,
+        cosine_block_size=128,
+        num_classes=8,
+        synthetic_n=256,
+        num_epochs=2,
+    )
+    out_mem = TimitPipeline.run(Config(**base))
+    out_st = TimitPipeline.run(Config(**base, stream=True, stream_batch_size=64))
+    assert abs(out_st["accuracy"] - out_mem["accuracy"]) < 0.05
+
+
+def test_cli_stream_flag(tmp_path, mesh, capsys):
+    """bin-level: the CLI routes --stream through to the app."""
+    from keystone_tpu import cli
+
+    rc = cli.main(
+        [
+            "ImageNetSiftLcsFV",
+            "--stream",
+            "--synthetic-n",
+            "16",
+            "--num-classes",
+            "4",
+            "--image-size",
+            "48",
+            "--gmm-k",
+            "4",
+            "--pca-dims",
+            "16",
+        ]
+    )
+    assert rc == 0
+    assert "ImageNetSiftLcsFV" in capsys.readouterr().out
