@@ -42,9 +42,20 @@ def lbfgs_minimize(
 
     ``value_and_grad(x) -> (f, g)`` must be jit-traceable.  Returns the
     final iterate.  The whole loop compiles to a single XLA program.
+
+    The iterate and the (m, ·) history buffers are kept FLATTENED: a
+    (m, d, k) history pads its k lane dim to the 128-wide TPU tile (1.7×
+    extra HBM at k=147 — the difference between fitting and OOM at
+    d=10⁶), while (m, d·k) pads only the tail of one axis.
     """
     m = history
     shape = x0.shape
+    orig_vag = value_and_grad
+    x0 = jnp.asarray(x0).reshape(-1)
+
+    def value_and_grad(x):
+        f, g = orig_vag(x.reshape(shape))
+        return f, jnp.asarray(g).reshape(-1)
 
     def dot(a, b):
         return jnp.vdot(a, b)
@@ -131,12 +142,12 @@ def lbfgs_minimize(
         return carry, carry[1]
 
     f0, g0 = value_and_grad(x0)
-    s_hist = jnp.zeros((m,) + shape, jnp.float32)
-    y_hist = jnp.zeros((m,) + shape, jnp.float32)
+    s_hist = jnp.zeros((m, x0.size), jnp.float32)
+    y_hist = jnp.zeros((m, x0.size), jnp.float32)
     rho_hist = jnp.zeros((m,), jnp.float32)
     init = (x0, f0, g0, s_hist, y_hist, rho_hist, 0, jnp.array(False))
     (x, f, g, *_), _ = lax.scan(step, init, None, length=max_iter)
-    return x
+    return x.reshape(shape)
 
 
 class DenseLBFGSwithL2(LabelEstimator):
@@ -170,7 +181,6 @@ class DenseLBFGSwithL2(LabelEstimator):
 
         if (
             type(self) is DenseLBFGSwithL2
-            and not self.fit_intercept  # sparse path has no centering
             and sample is not None
             and sample.is_host
             and is_scipy_sparse_rows(sample.items)
@@ -179,7 +189,9 @@ class DenseLBFGSwithL2(LabelEstimator):
                 lam=self.lam,
                 num_iterations=self.num_iterations,
                 history=self.history,
-                fit_intercept=False,
+                # survives the swap: the sparse path models the intercept
+                # as an unregularized constant column
+                fit_intercept=self.fit_intercept,
             )
         return self
 
@@ -209,18 +221,25 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
     """Sparse-gradient variant (LBFGS.scala § SparseLBFGSwithL2 /
     LeastSquaresSparseGradient).
 
-    Features stay in padded-COO form (ops/sparse.PaddedSparseRows —
-    n·nnz (index, value) pairs, never the dense n×d matrix): the forward
-    pass gathers weight rows, the gradient scatter-adds into (d, k).
-    At 100k+ vocabulary this is ~3 orders of magnitude less memory than
-    densifying, which is exactly how the reference ran text at scale.
+    Features stay in COO form, nnz-BUCKETED (ops/sparse.BucketedSparseRows
+    — rows grouped by power-of-two nnz caps so one dense-ish document
+    doesn't inflate every row's padding), never the dense n×d matrix:
+    the forward pass gathers weight rows, the gradient scatter-adds into
+    (d, k), both row-chunked so the live intermediate stays bounded at
+    any (vocab, k).  At 100k+ vocabulary this is ~3 orders of magnitude
+    less memory than densifying, which is exactly how the reference ran
+    text at scale.
+
+    ``fit_intercept=True`` augments each row with a constant feature
+    (index d, value 1) whose weight is excluded from the L2 penalty —
+    the sparse-safe intercept (centering would densify; the constant
+    column does not).
 
     Accepts: a host Dataset of scipy sparse rows (what ``Sparsify``
-    emits), a ``PaddedSparseRows`` directly via :meth:`fit_sparse`, or —
-    fallback — any dense input, which routes to the dense solver so the
-    optimizer's physical-choice rule can still select either class name.
-    ``fit_intercept`` is not supported on the sparse path (centering
-    would densify); construct with ``fit_intercept=False``.
+    emits), a ``PaddedSparseRows``/``BucketedSparseRows`` directly via
+    :meth:`fit_sparse`, or — fallback — any dense input, which routes to
+    the dense solver so the optimizer's physical-choice rule can still
+    select either class name.
     """
 
     # already the sparse physical form: restore the base hook (the same
@@ -230,61 +249,142 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
     choose_physical = LabelEstimator.choose_physical
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
-        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+        from keystone_tpu.ops.sparse import (
+            BucketedSparseRows,
+            is_scipy_sparse_rows,
+        )
 
         if labels is None:
             raise ValueError("SparseLBFGSwithL2 requires labels")
         if data.is_host and is_scipy_sparse_rows(data.items):
-            sp = PaddedSparseRows.from_scipy_rows(data.items)
+            sp = BucketedSparseRows.from_scipy_rows(data.items)
             return self.fit_sparse(sp, labels.array, n=data.n)
         return super().fit_dataset(data, labels)
 
     def fit_sparse(self, sp, y, n: Optional[int] = None):
-        """Fit from a PaddedSparseRows feature matrix."""
-        if self.fit_intercept:
-            raise ValueError(
-                "SparseLBFGSwithL2 does not support fit_intercept: "
-                "centering would densify the features"
-            )
-        from keystone_tpu.ops.sparse import align_label_rows
+        """Fit from a PaddedSparseRows or BucketedSparseRows matrix."""
+        import numpy as np
 
+        from keystone_tpu.ops.sparse import BucketedSparseRows, PaddedSparseRows
+        from keystone_tpu.parallel import mesh as _pmesh
+
+        if isinstance(sp, PaddedSparseRows):
+            sp = BucketedSparseRows(
+                [sp], np.arange(sp.n), sp.num_features, sp.n
+            )
         n = sp.n if n is None else int(n)
-        y = align_label_rows(y, n, int(sp.indices.shape[0]))
+        y = np.asarray(y, np.float32)
+        if y.shape[0] < n:
+            raise ValueError(
+                f"labels have {y.shape[0]} rows but the sparse matrix has "
+                f"{n} true rows"
+            )
+        y = y[:n]
+        d = sp.num_features
+        intercept = bool(self.fit_intercept)
+        bidx, bvals, by = [], [], []
+        start = 0
+        for b in sp.buckets:
+            sel = sp.perm[start : start + b.n]
+            start += b.n
+            rows_b = int(b.indices.shape[0])  # mesh-padded row count
+            row_ok = (np.arange(rows_b) < b.n).astype(np.float32)
+            yb = np.zeros((rows_b, y.shape[1]), np.float32)
+            yb[: b.n] = y[sel]
+            idx, vals = b.indices, b.values * jnp.asarray(row_ok)[:, None]
+            if intercept:
+                # constant column: one extra entry per TRUE row at the
+                # augmented index d (padding rows get value 0)
+                idx = jnp.concatenate(
+                    [idx, jnp.full((rows_b, 1), d, jnp.int32)], axis=1
+                )
+                vals = jnp.concatenate(
+                    [vals, jnp.asarray(row_ok)[:, None]], axis=1
+                )
+            bidx.append(idx)
+            bvals.append(vals)
+            by.append(_pmesh.shard_batch(yb))
+        d_aug = d + 1 if intercept else d
+        k = y.shape[1]
+        # L-BFGS history is 2·m weight-sized buffers; at text-scale
+        # (d=10⁶, k=147 → 0.6 GB per buffer) a fixed m=10 alone exceeds
+        # HBM.  Cap m so the history fits in a fraction of the device,
+        # trading convergence rate for feasibility (still L-BFGS, just
+        # shorter memory).
+        from keystone_tpu.workflow.profiling import device_hbm_budget
+
+        per_pair = 2 * d_aug * k * 4
+        # 0.2: the line search holds ~6 more weight-sized temporaries
+        # (x, g, p, trial iterates, value_and_grad activations) beyond
+        # the 2·m history buffers — measured at d=10⁶·k=147, 0.35 OOMed
+        hist_fraction = 0.2
+        history = min(
+            self.history,
+            max(2, int(device_hbm_budget(hist_fraction) // per_pair)),
+        )
+        if history < self.history:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "sparse L-BFGS: history %d -> %d (weight-sized pairs are "
+                "%.2f GB each; keeping them under %d%% of HBM)",
+                self.history,
+                history,
+                per_pair / 2**30,
+                int(hist_fraction * 100),
+            )
         w = _lbfgs_sparse_least_squares(
-            sp.indices,
-            sp.values,
-            y,
+            tuple(bidx),
+            tuple(bvals),
+            tuple(by),
             jnp.float32(n),
-            sp.num_features,
+            d_aug,
             self.lam,
             self.num_iterations,
-            self.history,
+            history,
+            intercept,
         )
+        if intercept:
+            return LinearMapper(w[:d], w[d])
         return LinearMapper(w, None)
 
 
-@partial(jax.jit, static_argnames=("d", "num_iterations", "history"))
-def _lbfgs_sparse_least_squares(idx, vals, y, n, d, lam, num_iterations, history):
-    """L-BFGS least squares on padded-COO features: the model (d, k) is
+@partial(
+    jax.jit, static_argnames=("d", "num_iterations", "history", "intercept")
+)
+def _lbfgs_sparse_least_squares(
+    bidx, bvals, by, n, d, lam, num_iterations, history, intercept=False
+):
+    """L-BFGS least squares on bucketed COO features: the model (d, k) is
     replicated; per-iteration work is a row-sharded gather-matvec forward
-    and a scatter-add gradient, all-reduced over the mesh — the sparse
-    analogue of the dense path's einsum + psum."""
+    and a scatter-add gradient per bucket, all-reduced over the mesh —
+    the sparse analogue of the dense path's einsum + psum.  Bucket
+    padding rows carry value-0 entries and zero labels, so they
+    contribute nothing.  With ``intercept``, the last weight row is the
+    unregularized bias of the constant column."""
     from keystone_tpu.ops.sparse import sparse_grad, sparse_matmul
 
-    idx = constrain(idx, DATA_AXIS)
-    vals = constrain(vals, DATA_AXIS)
-    y = constrain(y, DATA_AXIS)
-    row_ok = (jnp.arange(y.shape[0]) < n).astype(jnp.float32)[:, None]
-    y = y * row_ok
-    vals = vals * row_ok  # padding rows contribute nothing anywhere
+    bidx = tuple(constrain(i, DATA_AXIS) for i in bidx)
+    bvals = tuple(constrain(v, DATA_AXIS) for v in bvals)
+    by = tuple(constrain(y, DATA_AXIS) for y in by)
+    k = by[0].shape[1]
+    # L2 mask: exclude the intercept row from the penalty
+    if intercept:
+        reg = jnp.ones((d, 1), jnp.float32).at[d - 1].set(0.0)
+    else:
+        reg = jnp.ones((d, 1), jnp.float32)
 
     def value_and_grad(w):
-        r = sparse_matmul(idx, vals, w) - y  # (rows, k), row-sharded
-        f = 0.5 * jnp.vdot(r, r) / n + 0.5 * lam * jnp.vdot(w, w)
-        g = constrain(sparse_grad(idx, vals, r, d)) / n + lam * w
+        wp = w * reg
+        f = 0.5 * lam * jnp.vdot(wp, wp)
+        g = lam * wp
+        for idx, vals, y in zip(bidx, bvals, by):
+            r = sparse_matmul(idx, vals, w) - y  # (rows_b, k), row-sharded
+            f = f + 0.5 * jnp.vdot(r, r) / n
+            g = g + constrain(sparse_grad(idx, vals, r, d)) / n
         return f, g
 
-    w0 = jnp.zeros((d, y.shape[1]), jnp.float32)
+    w0 = jnp.zeros((d, k), jnp.float32)
     return lbfgs_minimize(
         value_and_grad, w0, max_iter=num_iterations, history=history
     )

@@ -75,15 +75,21 @@ class LinearMapEstimator(LabelEstimator):
     def params(self):
         return (self.lam, self.fit_intercept)
 
-    def choose_physical(self, sample):
-        """Physical choice (workflow/NodeOptimizationRule): on host
-        datasets of scipy sparse rows, the dense normal equations would
-        densify n×d AND form a d×d Gram — infeasible at text-scale
-        vocabularies — so route to the sparse-gradient L-BFGS solver,
-        which minimizes the SAME objective (1/(2n)‖XW−Y‖² + λ/2‖W‖² ⇒
-        (XᵀX+λnI)W = XᵀY).  The sparse path fits no intercept (centering
-        would densify); the reference's sparse gradient had the same
-        contract."""
+    def choose_physical(self, sample, full_n=None):
+        """Physical choice (workflow/NodeOptimizationRule), two axes like
+        the reference's rule:
+
+        - sparsity: on host datasets of scipy sparse rows, the dense
+          normal equations would densify n×d AND form a d×d Gram —
+          infeasible at text-scale vocabularies — so route to the
+          sparse-gradient L-BFGS solver, which minimizes the SAME
+          objective (1/(2n)‖XW−Y‖² + λ/2‖W‖² ⇒ (XᵀX+λnI)W = XᵀY).  An
+          intercept survives the swap (unregularized constant column).
+        - size: when the FULL problem is small (n·d below the measured
+          crossover — BASELINE.md "Local vs distributed solve"), pick
+          :class:`LocalLeastSquaresEstimator`, the unsharded
+          single-device solve with no collectives and no mesh padding
+          (the reference's collect()+LAPACK path for small data)."""
         from keystone_tpu.ops.sparse import is_scipy_sparse_rows
 
         if sample is not None and sample.is_host and is_scipy_sparse_rows(
@@ -91,20 +97,21 @@ class LinearMapEstimator(LabelEstimator):
         ):
             from keystone_tpu.models.lbfgs import SparseLBFGSwithL2
 
-            if self.fit_intercept:
-                import logging
-
-                # warning, not info: the swap changes model semantics
-                # (no intercept), and it must be visible under default
-                # logging.  Unlike DenseLBFGSwithL2 (which keeps its
-                # dense path when an intercept is requested), the exact
-                # solve CANNOT run on sparse input at all — densifying
-                # is the only alternative, so swap-and-warn it is.
-                logging.getLogger(__name__).warning(
-                    "sparse input: exact solve -> sparse L-BFGS "
-                    "(intercept dropped; centering would densify)"
-                )
-            return SparseLBFGSwithL2(lam=self.lam, num_iterations=100)
+            return SparseLBFGSwithL2(
+                lam=self.lam,
+                num_iterations=100,
+                fit_intercept=self.fit_intercept,
+            )
+        if (
+            sample is not None
+            and not sample.is_host
+            and full_n is not None
+            and sample.array.ndim == 2
+            and full_n * sample.array.shape[1] <= _LOCAL_SOLVE_MAX_ELEMENTS
+        ):
+            return LocalLeastSquaresEstimator(
+                lam=self.lam, fit_intercept=self.fit_intercept
+            )
         return self
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
@@ -117,6 +124,22 @@ class LinearMapEstimator(LabelEstimator):
 
         if data.is_host and is_scipy_sparse_rows(data.items):
             return self.choose_physical(data).fit_dataset(data, labels)
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(data, StreamDataset):
+            # out-of-core: labels are (n, k) and stay in memory; features
+            # stream past the sufficient-statistic accumulators
+            import numpy as np
+
+            y = np.asarray(labels.numpy())
+
+            def pairs():
+                offset = 0
+                for b in data.batches():
+                    yield b, y[offset : offset + len(b)]
+                    offset += len(b)
+
+            return self.fit_stream(pairs)
         w, b = _fit_normal_equations(
             data.array,
             labels.array,
@@ -219,6 +242,14 @@ def _acc_gram(carry, x, y, xm, ym, row_ok):
 LeastSquaresEstimator = LinearMapEstimator
 
 
+#: n·d crossover below which the unsharded local solve beats the sharded
+#: normal-equations path.  Measured on an 8-device mesh (BASELINE.md
+#: "Local vs distributed solve"): local wins through n·d = 2²⁰
+#: (4096×256: 49 ms vs 52 ms, and 2.7× at 256×64), the sharded path wins
+#: from n·d = 2²³ up (2.2× at 16384×512); the boundary sits between.
+_LOCAL_SOLVE_MAX_ELEMENTS = 1 << 21
+
+
 class LocalLeastSquaresEstimator(LabelEstimator):
     """Single-device exact solve via QR/SVD lstsq — the physical
     alternative the optimizer picks for small data
@@ -226,11 +257,14 @@ class LocalLeastSquaresEstimator(LabelEstimator):
     everything is gathered to one device, like the reference's
     ``collect()`` + LAPACK path."""
 
-    def __init__(self, lam: float = 0.0):
+    fit_intercept = True  # class default for pre-option pickles
+
+    def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
         self.lam = float(lam)
+        self.fit_intercept = bool(fit_intercept)
 
     def params(self):
-        return (self.lam,)
+        return (self.lam, self.fit_intercept)
 
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None) -> LinearMapper:
         if labels is None:
@@ -242,13 +276,18 @@ class LocalLeastSquaresEstimator(LabelEstimator):
     def fit_arrays(self, x, y=None) -> LinearMapper:
         x = jnp.asarray(x)
         y = jnp.asarray(y)
-        xm = jnp.mean(x, axis=0)
-        ym = jnp.mean(y, axis=0)
-        xc, yc = x - xm, y - ym
+        if self.fit_intercept:
+            xm = jnp.mean(x, axis=0)
+            ym = jnp.mean(y, axis=0)
+            xc, yc = x - xm, y - ym
+        else:
+            xc, yc = x, y
         if self.lam > 0.0:
             w = solve_spd(sdot(xc.T, xc), sdot(xc.T, yc), reg=self.lam * x.shape[0])
         else:
             w = jnp.linalg.lstsq(xc, yc)[0]
+        if not self.fit_intercept:
+            return LinearMapper(w, None)
         return LinearMapper(w, ym - xm @ w)
 
 

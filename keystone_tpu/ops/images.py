@@ -27,12 +27,35 @@ class Convolver(Transformer):
     :meth:`from_whitened_patches`: convolving ZCA-whitened patches with
     raw filters equals convolving raw patches with ``W_zca·filters`` plus
     a constant offset — one gemm instead of two.
+
+    Two physical forms (the reference's NodeOptimizationRule chose conv
+    strategies the same way — SURVEY.md §2.1):
+
+    - ``"direct"`` — ``lax.conv_general_dilated``, XLA's native conv path;
+    - ``"im2col"`` — explicit patch extraction + ONE (N·OH·OW, fh·fw·c) ×
+      (fh·fw·c, K) gemm, the reference's own execution strategy and a
+      better MXU mapping when the patch dim and filter count are both
+      MXU-friendly (≥~128) while the conv is small;
+    - ``"auto"`` (default) — resolved per shape from the measured
+      crossover (BASELINE.md "Convolver strategy crossover"), pinned to a
+      concrete form by the optimizer's NodeChoiceRule when it samples.
     """
 
-    def __init__(self, filters: jnp.ndarray, stride: int = 1, offset=None):
+    strategy = "auto"  # class default for pre-strategy pickles
+
+    def __init__(
+        self,
+        filters: jnp.ndarray,
+        stride: int = 1,
+        offset=None,
+        strategy: str = "auto",
+    ):
+        if strategy not in ("auto", "direct", "im2col"):
+            raise ValueError(f"unknown Convolver strategy {strategy!r}")
         self.filters = jnp.asarray(filters, jnp.float32)
         self.stride = int(stride)
         self.offset = offset  # (num_filters,) additive term
+        self.strategy = strategy
 
     @classmethod
     def from_whitened_patches(
@@ -55,7 +78,30 @@ class Convolver(Transformer):
             fp = cached_fingerprint(self, "_fp", self.filters)
         else:
             fp = cached_fingerprint(self, "_fp", self.filters, self.offset)
-        return (self.filters.shape, fp, self.stride, self.offset is None)
+        return (
+            self.filters.shape,
+            fp,
+            self.stride,
+            self.offset is None,
+            self.strategy,
+        )
+
+    def choose_physical(self, sample):
+        """Pin ``"auto"`` to the measured-best concrete strategy for the
+        sampled image shape (NodeOptimizationRule conv choice)."""
+        if self.strategy != "auto" or sample is None or sample.is_host:
+            return self
+        shape = tuple(sample.array.shape)
+        if len(shape) == 3:
+            shape = shape + (1,)
+        if len(shape) != 4:
+            return self
+        picked = _pick_conv_strategy(
+            shape[1], shape[2], self.filters.shape, self.stride
+        )
+        return Convolver(
+            self.filters, stride=self.stride, offset=self.offset, strategy=picked
+        )
 
     def apply_batch(self, xs, mask=None):
         # Not under the bf16 matmul policy: XLA's default precision already
@@ -64,20 +110,70 @@ class Convolver(Transformer):
         # v5 lite) while costing input accuracy.  See utils/precision.py.
         if xs.ndim == 3:
             xs = xs[..., None]
-        rhs = jnp.transpose(self.filters, (1, 2, 3, 0))  # HWIO
-        out = lax.conv_general_dilated(
-            xs.astype(jnp.float32),
-            rhs,
-            window_strides=(self.stride, self.stride),
-            padding="VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        xs = xs.astype(jnp.float32)
+        strategy = self.strategy
+        if strategy == "auto":
+            strategy = _pick_conv_strategy(
+                xs.shape[1], xs.shape[2], self.filters.shape, self.stride
+            )
+        if strategy == "im2col":
+            out = self._apply_im2col(xs)
+        else:
+            rhs = jnp.transpose(self.filters, (1, 2, 3, 0))  # HWIO
+            out = lax.conv_general_dilated(
+                xs,
+                rhs,
+                window_strides=(self.stride, self.stride),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.offset is not None:
             out = out + self.offset
         return out
 
+    def _apply_im2col(self, xs):
+        """Patch extraction + one gemm — the reference's own execution
+        plan (Windower im2col → BLAS gemm, SURVEY.md §3.3), mapped to the
+        MXU as a single (N·OH·OW, fh·fw·c) × (fh·fw·c, K) contraction."""
+        k, fh, fw, c = self.filters.shape
+        n, h, w, _ = xs.shape
+        patches = lax.conv_general_dilated_patches(
+            xs,
+            filter_shape=(fh, fw),
+            window_strides=(self.stride, self.stride),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # (n, oh, ow, c*fh*fw) — channel-major patch layout
+        oh, ow = patches.shape[1], patches.shape[2]
+        # filters (k, fh, fw, c) -> (c, fh, fw, k) flattened to match the
+        # patches' (c, fh, fw) minor order
+        rhs = jnp.transpose(self.filters, (3, 1, 2, 0)).reshape(c * fh * fw, k)
+        out = jnp.dot(
+            patches.reshape(n * oh * ow, c * fh * fw),
+            rhs,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(n, oh, ow, k)
+
     def apply_one(self, x):
         return self.apply_batch(x[None])[0]
+
+
+#: measured crossover, TPU v5 lite (BASELINE.md "Convolver strategy
+#: crossover"): the im2col patches tensor per image — (oh·ow) positions
+#: × (fh·fw·c) patch dim — below this many elements, patch-extract+gemm
+#: beats XLA's conv emitter (its fixed per-conv costs dominate small
+#: convs); above it, materializing patches loses to the fused conv.
+_IM2COL_MAX_PATCH_ELEMENTS = 58_000
+
+
+def _pick_conv_strategy(h: int, w: int, filter_shape, stride: int) -> str:
+    k, fh, fw, c = filter_shape
+    oh = max(0, (h - fh) // stride + 1)
+    ow = max(0, (w - fw) // stride + 1)
+    if oh * ow * fh * fw * c <= _IM2COL_MAX_PATCH_ELEMENTS:
+        return "im2col"
+    return "direct"
 
 
 class Pooler(Transformer):

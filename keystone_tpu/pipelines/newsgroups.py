@@ -124,6 +124,7 @@ class NewsgroupsPipeline:
             "model_loaded": loaded,
             "test_error": m.total_error,
             "accuracy": m.accuracy,
+            "macro_f1": m.macro_f1,
         }
 
 

@@ -78,11 +78,15 @@ class VOCSIFTFisher:
     def run(config: Config) -> dict:
         # train/test come from ONE load+split, so the load stays eager
         # (the test half is always needed, even for saved-model runs)
+        sz = (config.image_size, config.image_size)
         if config.images_dir:
-            data = VOCLoader.load(config.images_dir, config.annotations_dir)
+            # image_size governs the resize for real JPEGs too (the
+            # ImageNet app's convention)
+            data = VOCLoader.load(
+                config.images_dir, config.annotations_dir, size=sz
+            )
             train, test = data.split(0.7, seed=0)
         else:
-            sz = (config.image_size, config.image_size)
             train = VOCLoader.synthetic(config.synthetic_n, size=sz, seed=1)
             test = VOCLoader.synthetic(max(8, config.synthetic_n // 3), size=sz, seed=2)
         from keystone_tpu.workflow.pipeline import (

@@ -169,14 +169,37 @@ class NodeChoiceRule(Rule):
         self.sample_size = sample_size
 
     def apply(self, graph: G.Graph) -> G.Graph:
+        from keystone_tpu.workflow.dataset import Dataset
         from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
+        from keystone_tpu.workflow.transformer import Transformer
 
+        # full dataset size: lets size-based choices (local vs
+        # distributed solve) see past the truncated sample
+        full_n = max(
+            (
+                op.dataset.n if isinstance(op.dataset, Dataset) else len(op.dataset)
+                for op in graph.operators.values()
+                if isinstance(op, G.DatasetOperator)
+            ),
+            default=None,
+        )
         for n in list(graph.topological_nodes()):
             op = graph.operators.get(n)
-            if not isinstance(op, G.EstimatorOperator):
+            if isinstance(op, G.EstimatorOperator):
+                node = op.estimator
+                overridden = (
+                    type(node).choose_physical is not Estimator.choose_physical
+                )
+                rewrap = G.EstimatorOperator
+            elif isinstance(op, G.TransformerOperator):
+                node = op.transformer
+                overridden = (
+                    type(node).choose_physical is not Transformer.choose_physical
+                )
+                rewrap = G.TransformerOperator
+            else:
                 continue
-            est = op.estimator
-            if type(est).choose_physical is Estimator.choose_physical:
+            if not overridden:
                 continue
             sample = None
             try:
@@ -185,11 +208,16 @@ class NodeChoiceRule(Rule):
                 if isinstance(expr, DatasetExpr):
                     sample = expr.dataset
             except Exception as e:  # sampling is best-effort, like upstream
-                logger.debug("node-choice sampling failed for %s: %s", est.label, e)
-            chosen = est.choose_physical(sample)
-            if chosen is not est:
-                logger.info("node choice: %s -> %s", est.label, chosen.label)
-                graph = graph.set_operator(n, G.EstimatorOperator(chosen))
+                logger.debug("node-choice sampling failed for %s: %s", node.label, e)
+            import inspect
+
+            if "full_n" in inspect.signature(node.choose_physical).parameters:
+                chosen = node.choose_physical(sample, full_n=full_n)
+            else:
+                chosen = node.choose_physical(sample)
+            if chosen is not node:
+                logger.info("node choice: %s -> %s", node.label, chosen.label)
+                graph = graph.set_operator(n, rewrap(chosen))
         return graph
 
 
@@ -206,12 +234,39 @@ class _SampleExecutor:
 
 
 def _truncate_datasets(graph: G.Graph, k: int) -> G.Graph:
-    from keystone_tpu.workflow.dataset import Dataset, as_dataset
+    from keystone_tpu.workflow.dataset import Dataset, StreamDataset, as_dataset
 
     for n, op in list(graph.operators.items()):
         if isinstance(op, G.DatasetOperator):
             ds = as_dataset(op.dataset)
-            if not ds.is_host and ds.n > k:
+            if isinstance(ds, StreamDataset):
+                # sample the first batch(es) — materializing the whole
+                # stream to truncate it would defeat out-of-core (the
+                # reference's AutoCacheRule samples partitions the same
+                # way); the sampled rows stand in for the stream in the
+                # truncated PROFILING graph only
+                import numpy as np
+
+                parts, masks, got = [], [], 0
+                for arr, mask in ds.device_batches():
+                    parts.append(np.asarray(arr))
+                    if mask is not None:
+                        masks.append(np.asarray(mask))
+                    got += arr.shape[0]
+                    if got >= k:
+                        break
+                if not parts:
+                    continue
+                sample = np.concatenate(parts, axis=0)[:k]
+                m = min(k, ds.n)
+                # ragged streams: keep the per-batch masks, or sampled
+                # nodes would treat padded descriptor rows as real data
+                smask = (
+                    np.concatenate(masks, axis=0)[:k] if masks else None
+                )
+                sliced = Dataset(sample, n=m, mask=smask, shard=False)
+                graph = graph.set_operator(n, G.DatasetOperator(sliced))
+            elif not ds.is_host and ds.n > k:
                 sliced = Dataset(ds.array[:k], n=min(k, ds.n), shard=False)
                 graph = graph.set_operator(n, G.DatasetOperator(sliced))
             elif ds.is_host and ds.n > k:

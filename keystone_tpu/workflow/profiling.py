@@ -186,7 +186,8 @@ def device_hbm_budget(fraction: float = 0.5) -> int:
             return int(limit * fraction)
     except Exception:
         pass
-    return 8 << 30
+    # no stats (axon/CPU): assume a 16 GiB v5e-class device
+    return int((16 << 30) * fraction)
 
 
 class ProfilingAutoCacheRule(Rule):

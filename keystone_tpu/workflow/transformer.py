@@ -56,6 +56,12 @@ class Transformer(Chainable):
         p = self.params()
         return None if p is None else (type(self).__name__, p)
 
+    # Optimizer hook: physical-operator choice (workflow/NodeOptimizationRule).
+    def choose_physical(self, sample) -> "Transformer":
+        """Return the best physical implementation of this logical
+        transformer given a data sample (shapes).  Default: self."""
+        return self
+
     # ------------------------------------------------------------- apply
     def apply_one(self, x):
         raise NotImplementedError(type(self).__name__)

@@ -62,3 +62,36 @@ def test_augmented_examples_evaluator_unsorted_ids():
     labels_occurrence_order = np.array([0, 1])  # img9 -> 0, img1 -> 1
     m = AugmentedExamplesEvaluator(2).evaluate(scores, ids, labels_occurrence_order)
     assert m.accuracy == 1.0
+
+
+def test_map_evaluator_hand_computed_multiclass():
+    """Hand-computed 3-class fixture (VERDICT r2 item 6).
+
+    Class 0, score order d0>d2>d1, labels [1,0,1]:
+      rank1 d0 pos P=1/1; rank2 d2 neg; rank3 d1 pos P=2/3
+      AP0 = (1 + 2/3)/2 = 5/6
+    Class 1, order d1>d0>d3, labels (by doc) d1=0, d0=1, d3=1:
+      rank2 d0 pos P=1/2; rank3 d3 pos P=2/3 -> AP1 = (1/2+2/3)/2 = 7/12
+    Class 2, order d3>d2, labels d3=1, d2=0, d0/d1 scored lowest (neg):
+      rank1 d3 pos P=1 -> AP2 = 1
+    mAP = (5/6 + 7/12 + 1)/3 = 29/36
+    """
+    scores = np.array(
+        [
+            # class0 class1 class2
+            [0.9, 0.5, 0.05],  # d0
+            [0.2, 0.8, 0.01],  # d1
+            [0.5, 0.0, 0.30],  # d2
+            [0.1, 0.4, 0.90],  # d3
+        ]
+    )
+    labels = np.array(
+        [
+            [1, 1, 0],
+            [1, 0, 0],
+            [0, 0, 0],
+            [0, 1, 1],
+        ]
+    )
+    ap = MeanAveragePrecisionEvaluator(3).evaluate(scores, labels)
+    assert abs(ap - 29 / 36) < 1e-9, ap

@@ -113,9 +113,10 @@ def test_node_choice_swaps_dense_solvers_to_sparse():
     d = DenseLBFGSwithL2(lam=0.1, fit_intercept=False)
     assert isinstance(d.choose_physical(sparse_sample), SparseLBFGSwithL2)
     assert d.choose_physical(dense_sample) is d
-    # intercept-fitting dense LBFGS keeps the dense path (no centering sparse)
+    # intercept now survives the swap (constant-column intercept)
     di = DenseLBFGSwithL2(lam=0.1, fit_intercept=True)
-    assert di.choose_physical(sparse_sample) is di
+    chosen_i = di.choose_physical(sparse_sample)
+    assert isinstance(chosen_i, SparseLBFGSwithL2) and chosen_i.fit_intercept
     # already-sparse stays put
     s = SparseLBFGSwithL2(lam=0.1)
     assert s.choose_physical(sparse_sample) is s
@@ -263,3 +264,225 @@ def test_sparsify_to_sparse_lbfgs_pipeline_and_scoring():
     fitted = pipe.fit()
     pred = fitted(Dataset(dense)).get().numpy().ravel()[:n]
     assert (pred == lab).mean() > 0.95
+
+
+# ------------------------------------------------- bucketing + chunking
+
+
+def _random_csr_rows(rng, n, d, nnz_per_row):
+    import scipy.sparse as sp
+
+    rows = []
+    for i in range(n):
+        nz = int(nnz_per_row[i])
+        cols = rng.choice(d, size=max(nz, 1), replace=False)
+        vals = rng.normal(size=max(nz, 1)).astype(np.float32)
+        rows.append(
+            sp.csr_matrix((vals, ([0] * len(cols), cols)), shape=(1, d))
+        )
+    return rows
+
+
+def test_bucketed_kills_global_padding_cliff():
+    """One dense row must NOT inflate every row's padding (VERDICT r2):
+    bucketed memory stays near Σnnz while global padding blows up n×max."""
+    from keystone_tpu.ops.sparse import BucketedSparseRows, PaddedSparseRows
+
+    rng = np.random.default_rng(0)
+    n, d = 256, 5000
+    nnz = np.full(n, 8)
+    nnz[0] = 4000  # the one dense-ish document
+    rows = _random_csr_rows(rng, n, d, nnz)
+    padded = PaddedSparseRows.from_scipy_rows(rows)
+    bucketed = BucketedSparseRows.from_scipy_rows(rows)
+    assert padded.nnz_max >= 4000
+    # padded: every row pays 4000 entries; bucketed: ~8-entry buckets + one
+    assert bucketed.nbytes < padded.nbytes / 20
+    # and the math agrees with the dense product
+    dense = np.concatenate([r.toarray() for r in rows]).astype(np.float32)
+    w = rng.normal(size=(d, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        bucketed.matmul(w), dense @ w, atol=2e-3
+    )
+
+
+def test_bucketed_matmul_restores_row_order():
+    from keystone_tpu.ops.sparse import BucketedSparseRows
+
+    rng = np.random.default_rng(1)
+    n, d = 40, 100
+    nnz = rng.integers(1, 60, size=n)  # spans several pow2 buckets
+    rows = _random_csr_rows(rng, n, d, nnz)
+    sp_m = BucketedSparseRows.from_scipy_rows(rows)
+    assert len(sp_m.buckets) > 1
+    dense = np.concatenate([r.toarray() for r in rows]).astype(np.float32)
+    w = rng.normal(size=(d, 4)).astype(np.float32)
+    np.testing.assert_allclose(sp_m.matmul(w), dense @ w, atol=2e-3)
+
+
+def test_bucketed_max_buckets_cap():
+    from keystone_tpu.ops.sparse import BucketedSparseRows
+
+    rng = np.random.default_rng(2)
+    n, d = 128, 4096
+    nnz = 2 ** rng.integers(0, 11, size=n)  # 11 natural pow2 caps
+    rows = _random_csr_rows(rng, n, d, nnz)
+    sp_m = BucketedSparseRows.from_scipy_rows(rows, max_buckets=4)
+    assert len(sp_m.buckets) <= 4
+
+
+def test_chunked_ops_match_unchunked(monkeypatch):
+    """sparse_matmul / sparse_grad with a tiny chunk budget must agree
+    with the single-shot path bit-for-bit-ish."""
+    import keystone_tpu.ops.sparse as sparse_mod
+
+    rng = np.random.default_rng(3)
+    rows, nnz, d, k = 300, 13, 70, 5
+    idx = rng.integers(0, d, size=(rows, nnz)).astype(np.int32)
+    vals = rng.normal(size=(rows, nnz)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    r = rng.normal(size=(rows, k)).astype(np.float32)
+    big_mm = np.asarray(sparse_mod.sparse_matmul(idx, vals, w))
+    big_g = np.asarray(sparse_mod.sparse_grad(idx, vals, r, d))
+    monkeypatch.setattr(sparse_mod, "_auto_chunk", lambda *a: 64)
+    small_mm = np.asarray(sparse_mod.sparse_matmul(idx, vals, w))
+    small_g = np.asarray(sparse_mod.sparse_grad(idx, vals, r, d))
+    np.testing.assert_allclose(small_mm, big_mm, atol=1e-5)
+    np.testing.assert_allclose(small_g, big_g, atol=1e-4)
+
+
+def test_sparse_lbfgs_heavy_tailed_nnz_property():
+    """Property test (VERDICT r2 item 4): a heavy-tailed nnz corpus fits
+    through the bucketed path and matches the dense solver."""
+    from keystone_tpu.models import DenseLBFGSwithL2, SparseLBFGSwithL2
+
+    rng = np.random.default_rng(4)
+    n, d, k = 192, 400, 3
+    # log-normal-ish tail: most rows tiny, a few near-dense
+    nnz = np.minimum((rng.pareto(1.0, size=n) * 5 + 1).astype(int), d - 1)
+    rows = _random_csr_rows(rng, n, d, nnz)
+    dense = np.concatenate([r.toarray() for r in rows]).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    lab = np.argmax(dense @ w_true, axis=1)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    sparse_model = SparseLBFGSwithL2(lam=1e-3, num_iterations=150).fit_dataset(
+        Dataset(rows), Dataset(y)
+    )
+    dense_model = DenseLBFGSwithL2(
+        lam=1e-3, num_iterations=150, fit_intercept=False
+    ).fit_arrays(dense, y)
+    # both near the shared optimum; heavy-tailed nnz makes the problem
+    # ill-conditioned, so allow loose convergence slack
+    np.testing.assert_allclose(
+        np.asarray(sparse_model.weights),
+        np.asarray(dense_model.weights),
+        atol=1e-2,
+    )
+
+
+def test_sparse_lbfgs_intercept_matches_dense():
+    """The constant-column intercept must reproduce the dense solver's
+    centered intercept (same objective, different parameterization)."""
+    from keystone_tpu.models import DenseLBFGSwithL2, SparseLBFGSwithL2
+
+    rng = np.random.default_rng(5)
+    n, d, k = 160, 90, 3
+    dense = ((rng.uniform(size=(n, d)) < 0.2) * rng.normal(size=(n, d))).astype(
+        np.float32
+    )
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    shift = np.array([1.0, -2.0, 0.5], np.float32)
+    scores = dense @ w_true + shift
+    lab = np.argmax(scores, axis=1)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    import scipy.sparse as sp_
+
+    rows = [sp_.csr_matrix(dense[i : i + 1]) for i in range(n)]
+    m_sp = SparseLBFGSwithL2(
+        lam=1e-3, num_iterations=150, fit_intercept=True
+    ).fit_dataset(Dataset(rows), Dataset(y))
+    m_d = DenseLBFGSwithL2(
+        lam=1e-3, num_iterations=150, fit_intercept=True
+    ).fit_arrays(dense, y)
+    assert m_sp.intercept is not None
+    np.testing.assert_allclose(
+        np.asarray(m_sp.weights), np.asarray(m_d.weights), atol=2e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_sp.intercept), np.asarray(m_d.intercept), atol=2e-2
+    )
+
+
+# ------------------------------------- node-choice breadth (VERDICT r2 3)
+
+
+def test_node_choice_local_vs_distributed_ls():
+    """Size-based physical choice: a small full problem swaps the sharded
+    normal-equations estimator for the local single-device solve; a large
+    one keeps the distributed path."""
+    from keystone_tpu.models import (
+        LinearMapEstimator,
+        LocalLeastSquaresEstimator,
+    )
+
+    rng = np.random.default_rng(0)
+    small = Dataset(rng.normal(size=(64, 16)).astype(np.float32))
+    est = LinearMapEstimator(lam=1e-2)
+    chosen = est.choose_physical(small, full_n=64)
+    assert isinstance(chosen, LocalLeastSquaresEstimator)
+    assert chosen.lam == est.lam and chosen.fit_intercept == est.fit_intercept
+    # big full_n (sample is still small) keeps the distributed solve
+    assert est.choose_physical(small, full_n=1_000_000) is est
+    # no size information -> no swap
+    assert est.choose_physical(small) is est
+
+
+def test_node_choice_fires_through_optimizer_pipeline(caplog):
+    """Both r3 choices fire from SAMPLED stats inside the default
+    optimizer: local-LS swap on a small pipeline, Convolver strategy
+    pinned from the sampled image shape."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.models import LinearMapEstimator
+    from keystone_tpu.ops import MaxClassifier
+    from keystone_tpu.ops.images import Convolver, _pick_conv_strategy
+    from keystone_tpu.workflow import transformer as transformer_fn
+
+    rng = np.random.default_rng(1)
+    n, hw, kf = 48, 16, 8
+    imgs = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    filters = rng.normal(size=(kf, 3, 3, 3)).astype(np.float32)
+    lab = rng.integers(0, 3, size=n)
+    y = -np.ones((n, 3), np.float32)
+    y[np.arange(n), lab] = 1.0
+
+    conv = Convolver(filters)  # strategy="auto"
+    assert conv.strategy == "auto"
+    pool = transformer_fn(lambda v: v.mean(axis=(1, 2)))
+    pipe = (
+        Pipeline.of(conv)
+        .and_then(pool)
+        .and_then(LinearMapEstimator(lam=1e-3), Dataset(imgs), Dataset(y))
+        .and_then(MaxClassifier())
+    )
+    with caplog.at_level(logging.INFO, "keystone_tpu.workflow.optimizer"):
+        fitted = pipe.fit()
+    choices = [r.message for r in caplog.records if "node choice" in r.message]
+    assert any("LocalLeastSquaresEstimator" in m for m in choices), choices
+    assert any("Convolver" in m for m in choices), choices
+    pred = fitted(Dataset(imgs)).get().numpy().ravel()[:n]
+    assert np.isfinite(pred).all()
+    # the pinning itself (auto -> measured concrete strategy):
+    sample = Dataset(imgs)
+    pinned = conv.choose_physical(sample)
+    assert pinned is not conv
+    assert pinned.strategy == _pick_conv_strategy(hw, hw, filters.shape, 1)
+    assert pinned.strategy in ("direct", "im2col")
+    # a pinned convolver does not re-pin
+    assert pinned.choose_physical(sample) is pinned

@@ -50,7 +50,9 @@ def test_stage_timings_synchronizes_fit_nodes():
     )
     result = pipe(x)
     timings = tracing.stage_timings(result)
-    fit_keys = [k for k in timings if "LinearMapEstimator" in k]
+    # NodeChoiceRule may legitimately swap the small problem to the
+    # local solve (r3); either physical form must appear in the timings
+    fit_keys = [k for k in timings if "LeastSquares" in k or "LinearMap" in k]
     assert fit_keys, f"fit node missing from timings: {list(timings)}"
     assert timings[fit_keys[0]] >= 0
 
