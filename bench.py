@@ -121,13 +121,14 @@ def flops_per_image() -> float:
     so the count is assembled per stage; elementwise work is ignored
     (<5% of total).  T = number of dense-SIFT descriptors per image.
     """
-    from keystone_tpu.ops.sift import sift_output_count
+    from keystone_tpu.ops.sift import _window_matrix, sift_output_count
 
     t = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, (4,))
     d_sift = 128
-    # SIFT: 8 orientation-plane separable triangular windows (2 passes of
-    # 16-tap 1-D convs over HxWx8) + gradient/orientation binning (~VPU)
-    sift = 2 * IMAGE_HW * IMAGE_HW * 8 * 16 * 2
+    # SIFT windowing (matmul path, the r3 default): two dense einsums —
+    # (P, H)×(H, W·8) then (Q, W)×(W, P·8), P = Q = 4·num_centers
+    p = _window_matrix(IMAGE_HW, SIFT_STEP, 4)[0].shape[0]
+    sift = 2 * p * IMAGE_HW * IMAGE_HW * 8 + 2 * p * IMAGE_HW * p * 8
     pca = 2 * t * d_sift * PCA_DIMS
     # FV kernel: 4 MXU contractions of T×D×K (x²·inv, x·μinv, γᵀx, γᵀx²)
     fv = 4 * 2 * t * PCA_DIMS * GMM_K

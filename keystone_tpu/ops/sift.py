@@ -4,12 +4,18 @@ Reference: nodes/images/external/SIFTExtractor.scala → JNI
 utils/external/VLFeat.scala (``vl_dsift_*`` C library; params: step,
 scales, bin size; returns 128 × #keypoints per image).  SURVEY.md §2.8
 calls for a first-class TPU-era equivalent; this is dense SIFT as
-vectorized JAX: gradient → 8-orientation soft binning → triangular
-spatial windowing as a depthwise conv → 4×4 bin grid gather → the
-standard SIFT normalize (L2, clamp 0.2, re-L2).  The whole extractor is
-one jitted program over the batch; per-image descriptor counts are fixed
-by the image size, so outputs are dense (n, K, 128) with an all-ones
-mask joining the ragged pipeline downstream.
+vectorized JAX: gradient → 8-orientation soft binning → then, by
+default ("matmul" windowing), triangular spatial windowing + 4×4 bin
+extraction as TWO dense MXU einsums over precomputed (centers·4, extent)
+window operators — the conv+strided-slice+transpose chain is a linear
+map, and running it as matmuls removes the depthwise convs and the
+layout copies the r2 trace showed at ~40% of headline device time.  The
+"conv" windowing (depthwise conv → strided bin slices) remains as the
+fallback and the parity reference.  Then the standard SIFT normalize
+(L2, clamp 0.2, re-L2).  The whole extractor is one jitted program over
+the batch; per-image descriptor counts are fixed by the image size, so
+outputs are dense (n, K, 128) with an all-ones mask joining the ragged
+pipeline downstream.
 """
 
 from __future__ import annotations
@@ -41,13 +47,18 @@ class SIFTExtractor(Transformer):
     # Class-level default so pipelines pickled before smoothing existed
     # unpickle to the behavior they were fitted with (no smoothing).
     smoothing_magnif = 0.0
+    # pre-windowing pickles ran the conv path
+    windowing = "conv"
 
     def __init__(
         self,
         step: int = 4,
         bin_sizes: Sequence[int] = (4,),
         smoothing_magnif: float = 6.0,
+        windowing: str = "matmul",
     ):
+        if windowing not in ("conv", "matmul"):
+            raise ValueError(f"unknown SIFT windowing {windowing!r}")
         #: VLFeat smoothing: before gradients, each scale's image is
         #: blurred with σ = √((bin/magnif)² − 0.25) (``vl_phow``'s
         #: convention; the −0.25 discounts the camera's implicit ~0.5px
@@ -56,9 +67,13 @@ class SIFTExtractor(Transformer):
         self.step = int(step)
         self.bin_sizes = tuple(int(b) for b in bin_sizes)
         self.smoothing_magnif = float(smoothing_magnif)
+        #: "matmul" (default): windowing + bin extraction as two MXU
+        #: einsums — measured ~2× the SIFT stage vs the depthwise-conv
+        #: path on v5 lite (BASELINE.md r3); "conv" keeps the r2 path.
+        self.windowing = windowing
 
     def params(self):
-        return (self.step, self.bin_sizes, self.smoothing_magnif)
+        return (self.step, self.bin_sizes, self.smoothing_magnif, self.windowing)
 
     def _sigma(self, bin_size: int) -> float:
         if self.smoothing_magnif <= 0:
@@ -79,6 +94,7 @@ class SIFTExtractor(Transformer):
                     b,
                     mxu=precision.matmul_mode(),
                     sigma=self._sigma(b),
+                    windowing=self.windowing,
                 )
             )
         out = jnp.concatenate(descs, axis=1)
@@ -95,6 +111,13 @@ def _triangular_kernel(bin_size: int) -> np.ndarray:
     return np.maximum(0.0, 1.0 - np.abs(r) / bin_size)
 
 
+def _bin_offsets(bin_size: int) -> np.ndarray:
+    """The 4 bin-center offsets.  Truncation toward zero for odd bin
+    sizes is part of the descriptor definition — the conv and matmul
+    windowing paths MUST share it or their parity silently breaks."""
+    return ((np.arange(_GRID) - (_GRID - 1) / 2.0) * bin_size).astype(np.int64)
+
+
 def _keypoint_grid(extent: int, step: int, bin_size: int) -> np.ndarray:
     """Descriptor-center coordinates along one axis.
 
@@ -109,8 +132,46 @@ def _keypoint_grid(extent: int, step: int, bin_size: int) -> np.ndarray:
     return np.arange(lo, hi, step, dtype=np.int32)
 
 
-@partial(jax.jit, static_argnames=("step", "bin_size", "mxu", "sigma"))
-def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
+def _window_matrix(
+    extent: int, step: int, bin_size: int
+) -> Tuple[np.ndarray, int]:
+    """Dense windowing operator A (num_centers·4, extent): row (c, b)
+    holds the triangular window centered at keypoint-center c plus bin
+    offset b, zero outside the image (== the SAME-padded conv).
+
+    The separable conv + strided slice + transpose chain is a LINEAR map
+    of the orientation planes, so it can run as ONE (P, extent) matmul
+    per axis on the MXU instead of a depthwise conv (VPU/bandwidth
+    bound) followed by slices and layout copies — the r2 trace showed
+    those fusions + copies at ~40% of headline device time."""
+    centers = _keypoint_grid(extent, step, bin_size)
+    if centers.size == 0:
+        return np.zeros((0, extent), np.float32), 0
+    offs = _bin_offsets(bin_size)
+    k1 = _triangular_kernel(bin_size)  # support 2*bin-1, centered
+    a = np.zeros((centers.size * _GRID, extent), np.float32)
+    half = bin_size - 1
+    for ci, c in enumerate(centers):
+        for bi, off in enumerate(offs):
+            mid = int(c + off)
+            lo, hi = mid - half, mid + half + 1
+            klo = max(0, -lo)
+            khi = k1.size - max(0, hi - extent)
+            a[ci * _GRID + bi, max(lo, 0) : min(hi, extent)] = k1[klo:khi]
+    return a, centers.size
+
+
+@partial(
+    jax.jit, static_argnames=("step", "bin_size", "mxu", "sigma", "windowing")
+)
+def _dsift(
+    imgs,
+    step,
+    bin_size,
+    mxu: str = "f32",
+    sigma: float = 0.0,
+    windowing: str = "matmul",
+):
     from keystone_tpu.ops.filters import separable_gaussian_blur
 
     n, h, w = imgs.shape
@@ -138,6 +199,30 @@ def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
         (bins == lo_bin[..., None]) * (1.0 - frac[..., None])
         + (bins == hi_bin[..., None]) * frac[..., None]
     )  # (n, h, w, 8)
+
+    if windowing == "matmul":
+        # --- windowing + bin extraction as two MXU matmuls ---
+        ay, ky = _window_matrix(h, step, bin_size)
+        ax, kx = _window_matrix(w, step, bin_size)
+        if ky == 0 or kx == 0:
+            return jnp.zeros((n, 0, _GRID * _GRID * o), jnp.float32)
+        ay_c, ax_c, omap_c = precision.fcast(
+            jnp.asarray(ay), jnp.asarray(ax), omap, mode=mxu
+        )
+        # contract image rows then columns; output arrives already in
+        # descriptor-major bins — no strided slices, no layout copies
+        r1 = jnp.einsum(
+            "ph,nhwo->npwo", ay_c, omap_c, preferred_element_type=jnp.float32
+        )
+        r1_c = precision.fcast(r1, mode=mxu)
+        g = jnp.einsum(
+            "qw,npwo->npqo", ax_c, r1_c, preferred_element_type=jnp.float32
+        )
+        g = g.reshape(n, ky, _GRID, kx, _GRID, o)
+        desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(
+            n, ky * kx, _GRID * _GRID * o
+        )
+        return _sift_normalize(desc)
 
     # --- spatial triangular windowing: separable depthwise conv ---
     k1 = jnp.asarray(_triangular_kernel(bin_size))
@@ -176,7 +261,7 @@ def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
     ky, kx = ys.shape[0], xs_.shape[0]
     if ky == 0 or kx == 0:  # scale too large for the image: no keypoints
         return jnp.zeros((n, 0, _GRID * _GRID * o), jnp.float32)
-    offs = ((np.arange(_GRID) - (_GRID - 1) / 2.0) * bin_size).astype(np.int64)
+    offs = _bin_offsets(bin_size)
 
     def bin_slices(arr, centers, axis):
         """(…, len(centers), _GRID, …): strided slice per bin offset."""
@@ -192,8 +277,12 @@ def _dsift(imgs, step, bin_size, mxu: str = "f32", sigma: float = 0.0):
     g = bin_slices(smoothed, ys, 1)  # (n, ky, 4, w, 8)
     g = bin_slices(g, xs_, 3)  # (n, ky, 4, kx, 4, 8)
     desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(n, ky * kx, _GRID * _GRID * o)
+    return _sift_normalize(desc)
 
-    # --- SIFT normalization: L2 -> clamp 0.2 -> L2 ---
+
+def _sift_normalize(desc):
+    """SIFT normalization: L2 -> clamp 0.2 -> L2."""
+
     def l2(v):
         return v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-8)
 

@@ -541,3 +541,25 @@ def test_hashing_tf_stable_across_process_hash_seeds():
     assert out.returncode == 0, out.stderr[-1000:]
     other = [int(i) for i in out.stdout.strip().split(",")]
     assert sorted(np.nonzero(here)[0].tolist()) == sorted(other)
+
+
+def test_sift_matmul_windowing_matches_conv():
+    """The MXU-matmul windowing path (r3 default) must reproduce the
+    depthwise-conv path to fp tolerance across shapes/scales/smoothing."""
+    from keystone_tpu.ops.sift import _dsift
+
+    rng = np.random.default_rng(0)
+    for hw, step, b, sigma in [(64, 4, 4, 0.0), (48, 6, 4, 0.55), (33, 5, 3, 0.0)]:
+        imgs = jnp.asarray(rng.uniform(size=(2, hw, hw)).astype(np.float32))
+        conv = np.asarray(_dsift(imgs, step, b, sigma=sigma, windowing="conv"))
+        mm = np.asarray(_dsift(imgs, step, b, sigma=sigma, windowing="matmul"))
+        assert conv.shape == mm.shape
+        np.testing.assert_allclose(mm, conv, atol=1e-6)
+
+
+def test_sift_old_pickle_defaults_to_conv_windowing():
+    from keystone_tpu.ops.sift import SIFTExtractor
+
+    s = SIFTExtractor.__new__(SIFTExtractor)
+    assert s.windowing == "conv"  # pre-windowing pickles keep their path
+    assert SIFTExtractor().windowing == "matmul"
