@@ -201,19 +201,23 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
     def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
         """Fit from an existing FeatureBlockStore (features never fully
-        resident in HBM; see _oc_bcd_fit)."""
+        resident in HBM; see _oc_bcd_fit).
+
+        Multi-process: ``store`` holds this process's row slice,
+        ``labels`` is the GLOBAL label Dataset (made via
+        ``multihost.make_global_dataset``); n checks and weighting use
+        the global row count."""
         from keystone_tpu.workflow.dataset import as_dataset
 
         labels = as_dataset(labels)
-        if labels.n != store.n:
-            raise ValueError(f"labels n={labels.n} != store n={store.n}")
+        _check_store_rows(store, labels)
         y = labels.array.astype(jnp.float32)
-        alpha = (jnp.arange(y.shape[0]) < store.n).astype(jnp.float32)
+        alpha = (jnp.arange(y.shape[0]) < labels.n).astype(jnp.float32)
         weights, xm, ym = _oc_bcd_fit(
             store,
             y,
             alpha,
-            float(store.n),
+            float(labels.n),
             self.lam,
             self.num_iter,
             self.fit_intercept,
@@ -443,6 +447,22 @@ def _oc_block_step(a_raw, xm_b, yc, sa, row_ok, p, wb, lam_n):
     return wb_new, p_new
 
 
+def _check_store_rows(store, labels) -> None:
+    """Single-process: store rows == label rows.  Multi-process: the
+    per-process slices must jointly cover the global labels."""
+    import jax
+
+    procs = jax.process_count()
+    if procs == 1:
+        if labels.n != store.n:
+            raise ValueError(f"labels n={labels.n} != store n={store.n}")
+    elif store.n * procs < labels.n:
+        raise ValueError(
+            f"{procs} per-process stores of {store.n} rows cannot cover "
+            f"{labels.n} global label rows"
+        )
+
+
 def _oc_bcd_fit(
     store,
     y,
@@ -460,6 +480,14 @@ def _oc_bcd_fit(
     per-example weights with zeros on padding rows.  Returns
     ``(weights (nb, bs, k), xm (nb*bs,), ym (k,))``.
 
+    Multi-process (pod) runs: ``store`` holds only THIS process's row
+    slice on local disk (equal slices per host, the
+    ``multihost.process_batch_slice`` convention) and blocks are staged
+    as global row-sharded arrays via
+    ``multihost.global_rows_from_local`` — no host ever materializes
+    the full matrix, matching the reference's per-executor spilled
+    feature partitions.
+
     With ``checkpoint_dir``, each completed epoch saves (epoch, W, P) and
     an interrupted fit resumes from the last epoch (fault-tolerance
     analogue of Spark lineage recompute, SURVEY.md §5).
@@ -468,7 +496,8 @@ def _oc_bcd_fit(
 
     import numpy as np
 
-    from keystone_tpu.parallel import mesh as _pmesh
+
+    from keystone_tpu.parallel import multihost as _mh
 
     nb, bs = store.num_blocks, store.block_size
     n_rows, k = y.shape
@@ -477,11 +506,12 @@ def _oc_bcd_fit(
     row_ok = (alpha > 0).astype(jnp.float32)
 
     def stage(blk):
-        a = _pmesh.shard_batch(blk)
+        a = _mh.global_rows_from_local(blk)
         if a.shape[0] != n_rows:
             raise ValueError(
                 f"store rows pad to {a.shape[0]} but labels have {n_rows}: "
-                "store.n must equal the label Dataset's n"
+                "store.n must equal the label Dataset's n (per-process "
+                "row slice in multi-process runs)"
             )
         # bf16 stores cross the host→device wire at half width; solver
         # math stays f32 — cast on DEVICE, after the transfer
@@ -516,25 +546,78 @@ def _oc_bcd_fit(
         # labels, weights (mixture), λ, or intercept setting must restart,
         # while a re-spill of IDENTICAL data to a new temp dir must still
         # resume — so hash content proxies, never the directory path.
+        # Per-process-sharded stores hold DIFFERENT rows, so the local
+        # store probe is allgathered (like fit_checkpointed's digests) —
+        # every process must compute the SAME fingerprint or a shared-dir
+        # checkpoint could only ever match on one of them.
+        local_probe = np.frombuffer(
+            hashlib.sha256(
+                np.asarray(store.read_block(0)[0]).tobytes()
+            ).digest()[:8],
+            np.uint64,
+        )
+        probes = tuple(_mh.gather_to_host(local_probe).ravel().tolist())
         fp = hashlib.sha256()
         fp.update(
             repr(
-                (store.n, store.d, bs, (n_rows, k), float(lam), n, bool(fit_intercept))
+                (
+                    store.n,
+                    store.d,
+                    bs,
+                    (n_rows, k),
+                    float(lam),
+                    n,
+                    bool(fit_intercept),
+                    probes,
+                )
             ).encode()
         )
-        fp.update(np.asarray(store.read_block(0)[0]).tobytes())
-        fp.update(np.asarray(y[0]).tobytes())
-        fp.update(np.asarray(alpha[: min(n_rows, 64)]).tobytes())
+        # gather_to_host, not np.asarray: y/alpha rows are sharded and
+        # a row's shard may be non-addressable from this process
+        fp.update(_mh.gather_to_host(y[:1]).tobytes())
+        fp.update(_mh.gather_to_host(alpha[: min(n_rows, 64)]).tobytes())
         problem = fp.hexdigest()
-        if os.path.exists(ckpt_path):
+
+        def _read_oc_checkpoint():
+            if not os.path.exists(ckpt_path):
+                return 0, None, None
             try:
                 with np.load(ckpt_path) as z:
                     if str(z["problem"]) == problem:
-                        start = int(z["epoch"]) + 1
-                        w = [jnp.asarray(z["w"][b]) for b in range(nb)]
-                        p = _pmesh.shard_batch(np.asarray(z["p"]))[:n_rows]
+                        return int(z["epoch"]) + 1, np.asarray(z["w"]), np.asarray(z["p"])
             except Exception:
-                start = 0  # unreadable checkpoint: fit from scratch
+                pass  # unreadable checkpoint: fit from scratch
+            return 0, None, None
+
+        if jax.process_count() > 1:
+            # every sweep runs collectives, so processes must enter the
+            # loop at the SAME iteration: process 0's resume decision is
+            # broadcast, never decided per-process — a silent local read
+            # failure would desynchronize and deadlock
+            from jax.experimental import multihost_utils
+
+            if jax.process_index() == 0:
+                start, w_h, p_h = _read_oc_checkpoint()
+            else:
+                start, w_h, p_h = 0, None, None
+            if w_h is None:
+                w_h = np.zeros((nb, bs, k), np.float32)
+                p_h = np.zeros(yc.shape, np.float32)
+                start = int(start)
+            start, w_h, p_h = multihost_utils.broadcast_one_to_all(
+                (np.int32(start), np.asarray(w_h), np.asarray(p_h))
+            )
+            start = int(start)
+            if start > 0:
+                w = [jnp.asarray(w_h[b]) for b in range(nb)]
+                p = _mh.global_from_host(p_h[: yc.shape[0]], yc.sharding)
+        else:
+            start, w_h, p_h = _read_oc_checkpoint()
+            if start > 0:
+                w = [jnp.asarray(w_h[b]) for b in range(nb)]
+                p = _mh.global_from_host(
+                    p_h[: yc.shape[0]], yc.sharding
+                )
 
     lam_n = jnp.float32(lam * n)
     order = [b for _ in range(start, num_iter) for b in range(nb)]
@@ -557,12 +640,14 @@ def _oc_bcd_fit(
         if (i + 1) % nb == 0:
             if ckpt_path is not None:
                 jax.block_until_ready(p)
-                tmp = ckpt_path + ".tmp.npz"
+                # per-process tmp names: concurrent writers on a shared
+                # dir must never truncate each other mid-write
+                tmp = f"{ckpt_path}.tmp.{jax.process_index()}.npz"
                 np.savez(
                     tmp,
                     epoch=epoch,
-                    w=np.stack([np.asarray(x) for x in w]),
-                    p=np.asarray(p),
+                    w=np.stack([_mh.gather_to_host(x) for x in w]),
+                    p=_mh.gather_to_host(p),
                     problem=problem,
                 )
                 os.replace(tmp, ckpt_path)

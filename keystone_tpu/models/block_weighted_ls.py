@@ -116,19 +116,22 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return fitted
 
     def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
-        from keystone_tpu.models.block_ls import _oc_bcd_fit, finish_block_model
+        from keystone_tpu.models.block_ls import (
+            _check_store_rows,
+            _oc_bcd_fit,
+            finish_block_model,
+        )
         from keystone_tpu.workflow.dataset import as_dataset
 
         labels = as_dataset(labels)
-        if labels.n != store.n:
-            raise ValueError(f"labels n={labels.n} != store n={store.n}")
+        _check_store_rows(store, labels)
         y = labels.array.astype(jnp.float32)
-        alpha = class_weights(y, jnp.float32(store.n), self.mixture_weight)
+        alpha = class_weights(y, jnp.float32(labels.n), self.mixture_weight)
         weights, xm, ym = _oc_bcd_fit(
             store,
             y,
             alpha,
-            float(store.n),
+            float(labels.n),
             self.lam,
             self.num_iter,
             self.fit_intercept,

@@ -138,6 +138,27 @@ def global_from_host(host_array, sharding):
     )
 
 
+def global_rows_from_local(x_local):
+    """Global row-sharded array from THIS process's row slice.
+
+    Every process contributes an equal-length slice (the
+    :func:`process_batch_slice` convention, padded identically); the
+    result is one global array whose row axis is sharded over 'data'
+    across all hosts.  Single-process: plain ``shard_batch``.  This is
+    the staging primitive for per-process-sharded out-of-core stores —
+    on a pod each host spills only ITS rows to local disk instead of
+    every host holding the full matrix."""
+    from keystone_tpu.parallel.mesh import current_mesh, data_sharding, shard_batch
+
+    if jax.process_count() == 1:
+        return shard_batch(x_local)
+    x_local = np.asarray(x_local)
+    mesh = current_mesh()
+    return jax.make_array_from_process_local_data(
+        data_sharding(mesh, x_local.ndim), x_local
+    )
+
+
 def make_global_dataset(host_array, global_n: Optional[int] = None):
     """Assemble a globally-sharded Dataset from per-host shards via
     jax.make_array_from_process_local_data (multi-host path), or a plain

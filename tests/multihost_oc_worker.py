@@ -1,0 +1,69 @@
+"""Worker for the per-process-sharded out-of-core store test.
+
+Two processes: each spills ONLY its row slice of a global feature
+matrix to a local-disk FeatureBlockStore, then the weighted BCD fit
+sweeps globally-staged blocks (multihost.global_rows_from_local) and
+must match the exact in-memory fit of the FULL data — no process ever
+holds the whole matrix (the pod analogue of per-executor spilled
+feature partitions).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_procs, pid, tmpdir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from keystone_tpu.parallel import multihost, set_mesh
+
+    multihost.initialize(
+        coordinator_address=coordinator, num_processes=num_procs, process_id=pid
+    )
+    import numpy as np
+
+    mesh = multihost.hybrid_mesh(model_parallelism=1)
+    set_mesh(mesh)
+
+    from keystone_tpu.models import BlockWeightedLeastSquaresEstimator
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    rng = np.random.default_rng(0)
+    n, d, k = 128, 48, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    lbl = rng.choice(k, size=n, p=[0.6, 0.2, 0.12, 0.08])
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), lbl] = 1.0
+
+    # each process spills ONLY its slice to its "local" disk
+    sl = multihost.process_batch_slice(n)
+    store = FeatureBlockStore.from_array(
+        os.path.join(tmpdir, f"shard{pid}"), x[sl], block_size=16
+    )
+    labels = multihost.make_global_dataset(y[sl], global_n=n)
+
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=16, num_iter=3, lam=1e-2, mixture_weight=0.5
+    )
+    oc = est.fit_store(store, labels)
+    ref = est.fit_arrays(x, y)  # in-memory fit of the FULL data
+    err = np.abs(
+        np.asarray(multihost.gather_to_host(oc.flat_weights))
+        - np.asarray(ref.flat_weights)
+    ).max()
+    assert err < 5e-4, f"sharded-store fit mismatch: {err}"
+    print(f"MULTIHOST_OC_OK pid={pid} err={err:.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,3 +59,42 @@ def test_two_process_solver_matches_exact():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed (rc={rc}):\n{err[-2000:]}"
         assert "MULTIHOST_OK" in out, f"missing OK marker:\n{out}\n{err[-1000:]}"
+
+
+def test_two_process_sharded_store_fit_matches_exact(tmp_path):
+    """Per-process-sharded FeatureBlockStore (pod out-of-core): each of
+    two processes spills only its row slice; the swept fit must match
+    the full-data in-memory fit."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(os.path.dirname(__file__), "multihost_oc_worker.py")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=cwd + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, "2", str(pid), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=cwd,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{err[-2000:]}"
+        assert "MULTIHOST_OC_OK" in out
