@@ -31,6 +31,8 @@ class BlockKernelMatrix:
         x: jnp.ndarray,
         block_size: int = 1024,
         cache_blocks: int = 8,
+        spill_dir: Optional[str] = None,
+        hbm_cols: int = 1,
     ):
         self.kernel_gen = kernel_gen
         self.x = jnp.asarray(x, jnp.float32)
@@ -43,6 +45,56 @@ class BlockKernelMatrix:
         # rereads columns across epochs, and re-concatenating tiles per
         # access would copy the full n² every epoch
         self._col_cache: "OrderedDict[int, jnp.ndarray]" = OrderedDict()
+        #: disk tier for K beyond HBM (the reference's cached blocks
+        #: spilled to executor disk): computed column blocks persist as
+        #: npy files; HBM holds an LRU of up to ``hbm_cols`` whole
+        #: columns, evicted columns reload from disk instead of
+        #: recomputing the gemm
+        self.spill_dir = spill_dir
+        self.hbm_cols = max(1, int(hbm_cols))
+        if spill_dir is not None:
+            self._init_spill_dir(spill_dir)
+
+    def _init_spill_dir(self, spill_dir: str) -> None:
+        """Create/validate the disk tier.  Spilled columns are only
+        valid for THIS (data, kernel, blocking) triple: a reused cache
+        dir from a different fit would silently serve a different
+        problem's kernel matrix, so the dir carries a content
+        fingerprint and is cleared on mismatch."""
+        import hashlib
+        import json
+        import os
+        import shutil
+
+        import numpy as np
+
+        probe = hashlib.sha256()
+        probe.update(
+            repr(
+                (
+                    self.n,
+                    self.block_size,
+                    float(getattr(self.kernel_gen, "gamma", 0.0)),
+                    tuple(self.x.shape),
+                )
+            ).encode()
+        )
+        # first/last rows pin the data identity (order-sensitive)
+        probe.update(np.asarray(self.x[:1]).tobytes())
+        probe.update(np.asarray(self.x[-1:]).tobytes())
+        fingerprint = probe.hexdigest()
+        meta_path = os.path.join(spill_dir, "kcache_meta.json")
+        if os.path.isdir(spill_dir):
+            try:
+                with open(meta_path) as f:
+                    if json.load(f).get("fingerprint") == fingerprint:
+                        return  # reusable: same problem
+            except Exception:
+                pass
+            shutil.rmtree(spill_dir, ignore_errors=True)
+        os.makedirs(spill_dir, exist_ok=True)
+        with open(meta_path, "w") as f:
+            json.dump({"fingerprint": fingerprint}, f)
 
     def _rows(self, b: int) -> jnp.ndarray:
         lo = b * self.block_size
@@ -65,7 +117,9 @@ class BlockKernelMatrix:
 
         Cached WHOLE (one (n, bs) gemm, reread free on later sweeps)
         when a full sweep's columns fit the budget (num_blocks² tiles ≤
-        cache_blocks ⇔ num_blocks columns); otherwise a sweep would
+        cache_blocks ⇔ num_blocks columns).  With ``spill_dir``, columns
+        beyond the HBM budget persist on disk and reload instead of
+        recomputing (K-beyond-HBM cached mode).  Otherwise a sweep would
         insert-then-evict every entry, so compute without caching."""
         if self.num_blocks == 0:
             return jnp.zeros((0, 0), jnp.float32)
@@ -79,12 +133,43 @@ class BlockKernelMatrix:
             else:
                 self._col_cache.move_to_end(j)
             return blk
+        if self.spill_dir is not None:
+            return self._column_via_disk(j)
         return self.kernel_gen(self.x, self._rows(j))
 
+    def _column_via_disk(self, j: int) -> jnp.ndarray:
+        """HBM-LRU → disk → compute-and-persist, in that order."""
+        import os
+
+        import numpy as np
+
+        blk = self._col_cache.get(j)
+        if blk is not None:
+            self._col_cache.move_to_end(j)
+            return blk
+        path = os.path.join(self.spill_dir, f"kcol_{j:05d}.npy")
+        if os.path.exists(path):
+            blk = jnp.asarray(np.load(path))
+        else:
+            blk = self.kernel_gen(self.x, self._rows(j))
+            # per-writer temp name: concurrent processes sharing a cache
+            # dir must never interleave into one file (.npy suffix so
+            # np.save won't append another)
+            tmp = f"{path}.tmp.{os.getpid()}.npy"
+            np.save(tmp, np.asarray(blk))
+            os.replace(tmp, path)
+        self._col_cache[j] = blk
+        if len(self._col_cache) > self.hbm_cols:
+            self._col_cache.popitem(last=False)  # evictee stays on disk
+        return blk
+
     def diag_block(self, j: int) -> jnp.ndarray:
-        """K[X_j, X_j]; reads through the column cache in the cached
-        regime so the SAME n² budget serves every access path."""
-        if self.num_blocks * self.num_blocks <= self._cache_blocks:
+        """K[X_j, X_j]; reads through the column cache in the cached and
+        disk-tier regimes so the SAME budget serves every access path."""
+        if (
+            self.num_blocks * self.num_blocks <= self._cache_blocks
+            or self.spill_dir is not None
+        ):
             lo = j * self.block_size
             return self.column_block(j)[lo : lo + self.block_size]
         return self.block(j, j)
@@ -97,7 +182,10 @@ class BlockKernelMatrix:
         otherwise streams column gemms without polluting the cache."""
         if self.num_blocks == 0:
             return jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
-        cached = self.num_blocks * self.num_blocks <= self._cache_blocks
+        cached = (
+            self.num_blocks * self.num_blocks <= self._cache_blocks
+            or self.spill_dir is not None  # disk tier: reread, not regen
+        )
         out = jnp.zeros((self.n,) + v.shape[1:], jnp.float32)
         for j in range(self.num_blocks):
             lo = j * self.block_size
