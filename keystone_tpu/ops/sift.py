@@ -68,8 +68,12 @@ class SIFTExtractor(Transformer):
         self.bin_sizes = tuple(int(b) for b in bin_sizes)
         self.smoothing_magnif = float(smoothing_magnif)
         #: "matmul" (default): windowing + bin extraction as two MXU
-        #: einsums — measured ~2× the SIFT stage vs the depthwise-conv
-        #: path on v5 lite (BASELINE.md r3); "conv" keeps the r2 path.
+        #: einsums.  Wall-clock is WITHIN NOISE of the conv path at the
+        #: headline config (BASELINE.md r3 A/B: both ~7 µs/image — the
+        #: conv windowing was device time already overlapped with other
+        #: stages); matmul stays default because it removes the
+        #: layout-copy stage from the graph and is exactly parity-tested.
+        #: "conv" keeps the r2 path.
         self.windowing = windowing
 
     def params(self):
