@@ -263,49 +263,14 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
 
     def fit_sparse(self, sp, y, n: Optional[int] = None):
         """Fit from a PaddedSparseRows or BucketedSparseRows matrix."""
-        import numpy as np
+        from keystone_tpu.ops.sparse import bucketize_with_labels
 
-        from keystone_tpu.ops.sparse import BucketedSparseRows, PaddedSparseRows
-        from keystone_tpu.parallel import mesh as _pmesh
-
-        if isinstance(sp, PaddedSparseRows):
-            sp = BucketedSparseRows(
-                [sp], np.arange(sp.n), sp.num_features, sp.n
-            )
-        n = sp.n if n is None else int(n)
-        y = np.asarray(y, np.float32)
-        if y.shape[0] < n:
-            raise ValueError(
-                f"labels have {y.shape[0]} rows but the sparse matrix has "
-                f"{n} true rows"
-            )
-        y = y[:n]
         d = sp.num_features
         intercept = bool(self.fit_intercept)
-        bidx, bvals, by = [], [], []
-        start = 0
-        for b in sp.buckets:
-            sel = sp.perm[start : start + b.n]
-            start += b.n
-            rows_b = int(b.indices.shape[0])  # mesh-padded row count
-            row_ok = (np.arange(rows_b) < b.n).astype(np.float32)
-            yb = np.zeros((rows_b, y.shape[1]), np.float32)
-            yb[: b.n] = y[sel]
-            idx, vals = b.indices, b.values * jnp.asarray(row_ok)[:, None]
-            if intercept:
-                # constant column: one extra entry per TRUE row at the
-                # augmented index d (padding rows get value 0)
-                idx = jnp.concatenate(
-                    [idx, jnp.full((rows_b, 1), d, jnp.int32)], axis=1
-                )
-                vals = jnp.concatenate(
-                    [vals, jnp.asarray(row_ok)[:, None]], axis=1
-                )
-            bidx.append(idx)
-            bvals.append(vals)
-            by.append(_pmesh.shard_batch(yb))
-        d_aug = d + 1 if intercept else d
-        k = y.shape[1]
+        bidx, bvals, by, n, d_aug, _row_ok = bucketize_with_labels(
+            sp, y, n=n, intercept=intercept
+        )
+        k = by[0].shape[1]
         # L-BFGS history is 2·m weight-sized buffers; at text-scale
         # (d=10⁶, k=147 → 0.6 GB per buffer) a fixed m=10 alone exceeds
         # HBM.  Cap m so the history fits in a fraction of the device,

@@ -66,28 +66,32 @@ class LogisticRegressionEstimator(LabelEstimator):
         if labels is None:
             raise ValueError("LogisticRegressionEstimator requires labels")
         # sparse text (MLlib's logreg consumed SparseVectors; same role):
-        # host CSR rows fit via gather/scatter gradients, never densified
-        from keystone_tpu.ops.sparse import PaddedSparseRows, is_scipy_sparse_rows
+        # host CSR rows fit via gather/scatter gradients, never
+        # densified; rows are nnz-BUCKETED so one dense document can't
+        # inflate the whole corpus's padding
+        from keystone_tpu.ops.sparse import (
+            BucketedSparseRows,
+            is_scipy_sparse_rows,
+        )
 
         if data.is_host and is_scipy_sparse_rows(data.items):
-            sp = PaddedSparseRows.from_scipy_rows(data.items)
+            sp = BucketedSparseRows.from_scipy_rows(data.items)
             return self.fit_sparse(sp, labels.array, n=data.n)
         return self._fit(data.array, labels.array, data.n)
 
     def fit_sparse(self, sp, y, n: Optional[int] = None):
-        """Fit from a PaddedSparseRows feature matrix."""
-        from keystone_tpu.ops.sparse import align_label_rows
+        """Fit from a PaddedSparseRows or BucketedSparseRows matrix."""
+        from keystone_tpu.ops.sparse import bucketize_with_labels, host_onehot
 
-        n = sp.n if n is None else int(n)
-        onehot = align_label_rows(
-            self._onehot(y), n, int(sp.indices.shape[0])
-        )
+        onehot = host_onehot(y, self.num_classes)
+        bidx, bvals, boh, n, d, brow_ok = bucketize_with_labels(sp, onehot, n=n)
         w = _logreg_fit_sparse(
-            sp.indices,
-            sp.values,
-            onehot,
+            bidx,
+            bvals,
+            boh,
+            brow_ok,
             jnp.float32(n),
-            sp.num_features,
+            d,
             self.lam,
             self.num_iters,
             self.history,
@@ -137,28 +141,32 @@ def _logreg_fit(x, onehot, n, lam, num_iters, history):
 
 
 @partial(jax.jit, static_argnames=("d", "num_iters", "history"))
-def _logreg_fit_sparse(idx, vals, onehot, n, d, lam, num_iters, history):
-    """Softmax CE on padded-COO features: forward = gather-matvec,
-    gradient = scatter-add (same sparse primitives as the LS solver).
-    Padding entries have value 0 and padding rows have zero one-hots, so
-    neither contributes to loss or gradient — EXCEPT the softmax's
-    normalizer, which is why padding rows are masked explicitly."""
+def _logreg_fit_sparse(bidx, bvals, bonehot, brow_ok, n, d, lam, num_iters, history):
+    """Softmax CE on bucketed COO features: forward = gather-matvec,
+    gradient = scatter-add (same sparse primitives as the LS solver),
+    summed over nnz buckets (row order is loss-irrelevant).  Padding
+    entries have value 0 and padding rows have zero one-hots, so neither
+    contributes to loss or gradient — EXCEPT the softmax's normalizer,
+    which is why padding rows are masked explicitly via ``brow_ok``, the
+    per-bucket valid-row masks (TRACED — counts must not recompile)."""
     from keystone_tpu.ops.sparse import sparse_grad, sparse_matmul
 
-    idx = constrain(idx, DATA_AXIS)
-    vals = constrain(vals, DATA_AXIS)
-    onehot = constrain(onehot, DATA_AXIS)
-    row_ok = (jnp.arange(idx.shape[0]) < n).astype(jnp.float32)
-    onehot = onehot * row_ok[:, None]
+    bidx = tuple(constrain(i, DATA_AXIS) for i in bidx)
+    bvals = tuple(constrain(v, DATA_AXIS) for v in bvals)
+    bonehot = tuple(constrain(o, DATA_AXIS) for o in bonehot)
+    row_oks = tuple(constrain(m, DATA_AXIS) for m in brow_ok)
 
     def value_and_grad(w):
-        logits = sparse_matmul(idx, vals, w)
-        lse = jax.scipy.special.logsumexp(logits, axis=1)
-        ll = jnp.sum(logits * onehot, axis=1) - lse * row_ok
-        f = -jnp.sum(ll) / n + 0.5 * lam * jnp.vdot(w, w)
-        p = jax.nn.softmax(logits, axis=1) * row_ok[:, None]
-        g = constrain(sparse_grad(idx, vals, p - onehot, d)) / n + lam * w
+        f = 0.5 * lam * jnp.vdot(w, w)
+        g = lam * w
+        for idx, vals, onehot, row_ok in zip(bidx, bvals, bonehot, row_oks):
+            logits = sparse_matmul(idx, vals, w)
+            lse = jax.scipy.special.logsumexp(logits, axis=1)
+            ll = jnp.sum(logits * onehot, axis=1) - lse * row_ok
+            f = f - jnp.sum(ll) / n
+            p = jax.nn.softmax(logits, axis=1) * row_ok[:, None]
+            g = g + constrain(sparse_grad(idx, vals, p - onehot, d)) / n
         return f, g
 
-    w0 = jnp.zeros((d, onehot.shape[1]), jnp.float32)
+    w0 = jnp.zeros((d, bonehot[0].shape[1]), jnp.float32)
     return lbfgs_minimize(value_and_grad, w0, max_iter=num_iters, history=history)

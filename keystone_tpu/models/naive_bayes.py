@@ -54,28 +54,29 @@ class NaiveBayesEstimator(LabelEstimator):
     def fit_dataset(self, data: Dataset, labels: Optional[Dataset] = None):
         if labels is None:
             raise ValueError("NaiveBayesEstimator requires labels")
-        # sparse counts: the sufficient statistic onehotᵀX is one
-        # scatter-add over the COO entries — never densify n×d
+        # sparse counts: the sufficient statistic onehotᵀX is a
+        # scatter-add over the COO entries — never densify n×d.  Rows
+        # are nnz-BUCKETED so one dense document doesn't inflate the
+        # whole corpus's padding (the count sum is row-permutation
+        # invariant, so summing per-bucket contributions is exact).
         from keystone_tpu.ops.sparse import (
-            PaddedSparseRows,
-            align_label_rows,
+            BucketedSparseRows,
+            bucketize_with_labels,
             is_scipy_sparse_rows,
         )
 
         if data.is_host and is_scipy_sparse_rows(data.items):
-            sp = PaddedSparseRows.from_scipy_rows(data.items)
-            onehot = align_label_rows(
-                _to_onehot(labels.array, self.num_classes),
-                data.n,
-                int(sp.indices.shape[0]),
+            from keystone_tpu.ops.sparse import host_onehot
+
+            sp = BucketedSparseRows.from_scipy_rows(data.items)
+            # host one-hot: labels get permuted in numpy next, so a
+            # device one-hot would round-trip the tunnel for nothing
+            onehot = host_onehot(labels.numpy(), self.num_classes)
+            bidx, bvals, boh, n, d, _row_ok = bucketize_with_labels(
+                sp, onehot, n=data.n
             )
             lp, lc = _nb_fit_sparse(
-                sp.indices,
-                sp.values,
-                onehot,
-                jnp.float32(data.n),
-                sp.num_features,
-                self.lam,
+                bidx, bvals, boh, jnp.float32(n), d, self.lam
             )
             return NaiveBayesModel(lp, lc)
         return self._fit(data.array, labels.array, data.n)
@@ -97,17 +98,23 @@ def _to_onehot(y, k):
 
 
 @partial(jax.jit, static_argnames=("d",))
-def _nb_fit_sparse(idx, vals, onehot, n, d, lam):
+def _nb_fit_sparse(bidx, bvals, bonehot, n, d, lam):
     """Sparse multinomial NB: feat_counts = (Xᵀ·onehot)ᵀ via scatter-add
-    on the padded-COO entries (sparse_grad); identical math to _nb_fit."""
+    on bucketed COO entries (sparse_grad per bucket, summed — bucket
+    values/labels are pre-zeroed on padding rows); identical math to
+    _nb_fit."""
     from keystone_tpu.ops.sparse import sparse_grad
 
-    idx = constrain(idx, DATA_AXIS)
-    vals = constrain(vals, DATA_AXIS)
-    row_ok = (jnp.arange(idx.shape[0]) < n).astype(jnp.float32)
-    onehot = onehot * row_ok[:, None]
-    class_counts = constrain(jnp.sum(onehot, axis=0))  # (K,)
-    feat_counts = constrain(sparse_grad(idx, vals, onehot, d)).T  # (K, d)
+    class_counts = jnp.zeros((bonehot[0].shape[1],), jnp.float32)
+    feat_counts = jnp.zeros((bonehot[0].shape[1], d), jnp.float32)
+    for idx, vals, onehot in zip(bidx, bvals, bonehot):
+        idx = constrain(idx, DATA_AXIS)
+        vals = constrain(vals, DATA_AXIS)
+        onehot = constrain(onehot, DATA_AXIS)
+        class_counts = class_counts + jnp.sum(onehot, axis=0)
+        feat_counts = feat_counts + sparse_grad(idx, vals, onehot, d).T
+    class_counts = constrain(class_counts)
+    feat_counts = constrain(feat_counts)
     return _nb_finish(class_counts, feat_counts, n, lam)
 
 

@@ -486,3 +486,78 @@ def test_node_choice_fires_through_optimizer_pipeline(caplog):
     assert pinned.strategy in ("direct", "im2col")
     # a pinned convolver does not re-pin
     assert pinned.choose_physical(sample) is pinned
+
+
+def test_nb_and_logistic_bucketed_heavy_tailed_match_dense():
+    """NB and logistic now route through the bucketed representation:
+    a corpus with one near-dense document must fit cheaply and match
+    the dense fits (counts and CE loss are row-permutation invariant)."""
+    from keystone_tpu.models import LogisticRegressionEstimator, NaiveBayesEstimator
+
+    rng = np.random.default_rng(11)
+    n, d, k = 96, 500, 3
+    nnz = np.full(n, 6)
+    nnz[0] = 400  # the dense-ish document
+    rows = _random_csr_rows(rng, n, d, nnz)
+    # make values positive (NB counts)
+    for r in rows:
+        r.data = np.abs(r.data) + 0.5
+    dense = np.concatenate([r.toarray() for r in rows]).astype(np.float32)
+    lab = rng.integers(0, k, size=n).astype(np.int32)
+
+    nb_sp = NaiveBayesEstimator(k, lam=1.0).fit_dataset(
+        Dataset(rows), Dataset(lab)
+    )
+    nb_d = NaiveBayesEstimator(k, lam=1.0).fit_arrays(dense, lab)
+    np.testing.assert_allclose(
+        np.asarray(nb_sp.log_cond), np.asarray(nb_d.log_cond), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(nb_sp.log_prior), np.asarray(nb_d.log_prior), atol=1e-5
+    )
+
+    lr_sp = LogisticRegressionEstimator(k, lam=1e-2, num_iters=40).fit_dataset(
+        Dataset(rows), Dataset(lab)
+    )
+    lr_d = LogisticRegressionEstimator(k, lam=1e-2, num_iters=40).fit_arrays(
+        dense, lab
+    )
+    np.testing.assert_allclose(
+        np.asarray(lr_sp.weights), np.asarray(lr_d.weights), atol=5e-3
+    )
+
+
+def test_bucketize_handles_padded_dataset_rows():
+    """A host Dataset may carry padding rows beyond its true n; rows past
+    n must be excluded from masks/labels, not crash or train (review
+    finding: the old padded paths masked these, the bucketed path must
+    too)."""
+    import scipy.sparse as sp_
+
+    from keystone_tpu.models import NaiveBayesEstimator
+    from keystone_tpu.ops.sparse import BucketedSparseRows, bucketize_with_labels
+
+    rng = np.random.default_rng(0)
+    rows = _random_csr_rows(rng, 12, 30, np.full(12, 4))
+    for r in rows:
+        r.data = np.abs(r.data) + 0.5
+    n_true = 9  # last 3 rows are Dataset padding
+    lab = rng.integers(0, 3, size=n_true).astype(np.int32)
+
+    sp_m = BucketedSparseRows.from_scipy_rows(rows)
+    y = np.zeros((n_true, 3), np.float32)
+    y[np.arange(n_true), lab] = 1.0
+    bidx, bvals, by, n, d, brow_ok = bucketize_with_labels(sp_m, y, n=n_true)
+    assert n == n_true
+    assert sum(float(np.asarray(m).sum()) for m in brow_ok) == n_true
+
+    # end to end: NB over the padded host Dataset matches the dense fit
+    # restricted to the true rows
+    ds = Dataset(rows)
+    ds.n = n_true
+    nb_sp = NaiveBayesEstimator(3, lam=1.0).fit_dataset(ds, Dataset(lab))
+    dense = np.concatenate([r.toarray() for r in rows[:n_true]]).astype(np.float32)
+    nb_d = NaiveBayesEstimator(3, lam=1.0).fit_arrays(dense, lab)
+    np.testing.assert_allclose(
+        np.asarray(nb_sp.log_cond), np.asarray(nb_d.log_cond), atol=1e-5
+    )
