@@ -36,9 +36,37 @@ class CifarLoader:
             raise ValueError(f"{path}: size {raw.size} not a multiple of {RECORD}")
         recs = raw.reshape(-1, RECORD)
         labels = recs[:, 0].astype(np.int32)
-        pixels = recs[:, 1:].reshape(-1, C, H, W).transpose(0, 2, 3, 1)
         return LabeledData(
-            Dataset(pixels.astype(np.float32) / 255.0, name=name),
+            Dataset(_decode_records(recs), name=name),
+            Dataset(labels, name=name + "-labels"),
+        )
+
+    @staticmethod
+    def stream(path: str, batch_size: int = 1024, prefetch: int = 2) -> LabeledData:
+        """Out-of-core loader: fixed-size binary records make this the
+        simplest streaming format — one cheap size check fixes ``n``,
+        labels come from a single strided read of the first record
+        bytes, pixels re-read from disk in ``batch_size``-record chunks
+        per sweep."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        size = os.path.getsize(path)
+        if size % RECORD != 0:
+            raise ValueError(f"{path}: size {size} not a multiple of {RECORD}")
+        n = size // RECORD
+        if n == 0:  # np.memmap refuses empty files; match load()'s result
+            return CifarLoader.load(path)
+        mm = np.memmap(path, dtype=np.uint8, mode="r").reshape(-1, RECORD)
+        labels = np.array(mm[:, 0], np.int32)  # 1 byte/record: stays in RAM
+
+        def batches():
+            m = np.memmap(path, dtype=np.uint8, mode="r").reshape(-1, RECORD)
+            for i in range(0, n, batch_size):
+                yield _decode_records(np.asarray(m[i : i + batch_size]))
+
+        name = f"cifar-stream:{os.path.abspath(path)}:b{batch_size}"
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
             Dataset(labels, name=name + "-labels"),
         )
 
@@ -64,3 +92,13 @@ class CifarLoader:
             Dataset(np.clip(x, 0, 1), name=name),
             Dataset(labels.astype(np.int32), name=name + "-labels"),
         )
+
+
+def _decode_records(recs: np.ndarray) -> np.ndarray:
+    """(m, RECORD) uint8 records → (m, H, W, C) float32 in [0,1] —
+    shared by load()'s fallback and stream() so the two paths cannot
+    drift."""
+    return (
+        recs[:, 1:].reshape(-1, C, H, W).transpose(0, 2, 3, 1).astype(np.float32)
+        / 255.0
+    )

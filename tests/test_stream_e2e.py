@@ -271,3 +271,43 @@ def test_cli_stream_flag(tmp_path, mesh, capsys):
     )
     assert rc == 0
     assert "ImageNetSiftLcsFV" in capsys.readouterr().out
+
+
+def test_cifar_stream_matches_load(tmp_path, mesh):
+    from keystone_tpu.loaders.cifar import RECORD, CifarLoader
+
+    rng = np.random.default_rng(0)
+    recs = rng.integers(0, 255, size=(37, RECORD)).astype(np.uint8)
+    recs[:, 0] = rng.integers(0, 10, size=37)
+    path = str(tmp_path / "batch.bin")
+    recs.tofile(path)
+    mem = CifarLoader.load(path)
+    st = CifarLoader.stream(path, batch_size=8)
+    assert st.data.n == 37
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_allclose(
+        np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
+
+
+def test_imagenet_stream_undecodable_member_substitutes_zero(tmp_path, caplog):
+    """An undecodable tar member must keep its label slot as a zero
+    image (the index pass fixed the row/label alignment), with a
+    warning — unlike load(), which may skip it."""
+    import logging
+    import tarfile
+
+    root = _write_jpeg_tars(str(tmp_path / "tars"), num_tars=1, per_tar=3)
+    tar = os.path.join(root, os.listdir(root)[0])
+    with tarfile.open(tar, "a") as tf:
+        bad = b"not a jpeg at all"
+        info = tarfile.TarInfo(name="broken.JPEG")
+        info.size = len(bad)
+        tf.addfile(info, io.BytesIO(bad))
+    st = ImageNetLoader.stream(root, size=(48, 48), batch_size=4)
+    assert st.data.n == 4  # index counts all members
+    with caplog.at_level(logging.WARNING, "keystone_tpu.loaders.imagenet"):
+        imgs = np.concatenate(list(st.data.batches()))
+    assert imgs.shape[0] == 4
+    assert (imgs[-1] == 0).all()  # the broken member became a zero image
+    assert any("undecodable" in r.message for r in caplog.records)
