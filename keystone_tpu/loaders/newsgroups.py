@@ -47,6 +47,48 @@ class NewsgroupsDataLoader:
         )
 
     @staticmethod
+    def stream(
+        root: str,
+        groups: Optional[Sequence[str]] = None,
+        batch_size: int = 512,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: one cheap directory walk fixes the file
+        list and labels; document TEXTS re-read from disk in
+        ``batch_size`` chunks per sweep through a HOST StreamDataset —
+        the raw corpus never materializes in RAM."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        groups = list(groups) if groups is not None else sorted(os.listdir(root))
+        paths: List[str] = []
+        labels: List[int] = []
+        for gi, g in enumerate(groups):
+            gdir = os.path.join(root, g)
+            if not os.path.isdir(gdir):
+                continue
+            for fname in sorted(os.listdir(gdir)):
+                paths.append(os.path.join(gdir, fname))
+                labels.append(gi)
+        n = len(paths)
+
+        def batches():
+            for i in range(0, n, batch_size):
+                chunk = []
+                for p in paths[i : i + batch_size]:
+                    try:
+                        with open(p, "r", errors="replace") as f:
+                            chunk.append(f.read())
+                    except OSError:
+                        chunk.append("")  # keep row/label alignment
+                yield chunk
+
+        name = f"newsgroups-stream:{os.path.abspath(root)}:b{batch_size}"
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch, host=True),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )
+
+    @staticmethod
     def synthetic(
         n: int = 400, num_classes: int = 4, seed: int = 0
     ) -> LabeledData:

@@ -153,6 +153,23 @@ class CommonSparseFeaturesModel(Transformer):
         return row
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(ds, StreamDataset) and ds.is_host:
+            # text stream: featurize batch-by-batch, keeping the stream
+            # lazy.  Sparse output stays a HOST stream of CSR rows
+            # (small; downstream fits collect them); dense output
+            # becomes a DEVICE stream so array consumers keep working.
+            if self.sparse_output:
+                return ds.map_batches(
+                    lambda batch, _m: [self.apply_one(d) for d in batch]
+                )
+            return ds.map_batches(
+                lambda batch, _m: np.stack(
+                    [self.apply_one(d) for d in batch]
+                ),
+                host=False,
+            )
         if self.sparse_output:
             return ds.with_items([self.apply_one(d) for d in ds.items])
         rows = np.stack([self.apply_one(d) for d in ds.items])
@@ -173,12 +190,24 @@ class CommonSparseFeatures(Estimator):
         return (self.num_features, self.sparse_output)
 
     def fit_dataset(self, data: Dataset) -> CommonSparseFeaturesModel:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(data, StreamDataset) and data.is_host:
+            # streaming document-frequency pass: one sweep, Counter-sized
+            # state — the raw corpus never materializes (fit_arrays
+            # consumes any iterable, so feed it the stream lazily)
+            return self.fit_arrays(
+                d for batch in data.batches() for d in batch
+            )
         return self.fit_arrays(data.items)
 
     def fit_arrays(self, docs: Iterable[Dict]) -> CommonSparseFeaturesModel:
         df: Counter = Counter()
         for d in docs:
             df.update(set(d.keys()))
+        return self._from_df(df)
+
+    def _from_df(self, df: Counter) -> CommonSparseFeaturesModel:
         top = [t for t, _ in df.most_common(self.num_features)]
         vocab = {t: i for i, t in enumerate(top)}
         return CommonSparseFeaturesModel(
@@ -238,6 +267,19 @@ class HashingTF(Transformer):
         return row
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(ds, StreamDataset) and ds.is_host:
+            if self.sparse_output:
+                return ds.map_batches(
+                    lambda batch, _m: [self.apply_one(d) for d in batch]
+                )
+            return ds.map_batches(
+                lambda batch, _m: np.stack(
+                    [self.apply_one(d) for d in batch]
+                ),
+                host=False,
+            )
         if self.sparse_output:
             return ds.with_items([self.apply_one(d) for d in ds.items])
         rows = np.stack([self.apply_one(d) for d in ds.items])

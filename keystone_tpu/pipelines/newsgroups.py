@@ -39,6 +39,11 @@ class Config:
     num_classes: int = 4
     synthetic_n: int = 400
     model_path: Optional[str] = None
+    # out-of-core: stream raw document texts from the directory tree per
+    # sweep (host StreamDataset); requires test_path, since the
+    # train/test split of a stream is the caller's responsibility
+    stream: bool = False
+    stream_batch_size: int = 512
 
 
 class NewsgroupsPipeline:
@@ -90,7 +95,35 @@ class NewsgroupsPipeline:
     def run(config: Config) -> dict:
         # train/test come from ONE load+split, so the load stays eager
         # (the test half is always needed, even for saved-model runs)
-        if config.data_path:
+        if config.stream and config.data_path:
+            if not config.test_path:
+                raise ValueError(
+                    "--stream needs --test-path: a streamed train tree "
+                    "cannot be split in place"
+                )
+            import os
+
+            # ONE group→label mapping from the TRAIN tree, shared with
+            # the test load — independently-derived mappings would
+            # silently misalign labels when the trees' group sets differ
+            groups = sorted(os.listdir(config.data_path))
+            train = NewsgroupsDataLoader.stream(
+                config.data_path,
+                groups=groups,
+                batch_size=config.stream_batch_size,
+            )
+            test = NewsgroupsDataLoader.load(config.test_path, groups=groups)
+            config = dataclasses.replace(config, num_classes=len(groups))
+        elif config.data_path and config.test_path:
+            # explicit test tree: no split; labels share the train
+            # tree's group mapping
+            import os
+
+            groups = sorted(os.listdir(config.data_path))
+            train = NewsgroupsDataLoader.load(config.data_path, groups=groups)
+            test = NewsgroupsDataLoader.load(config.test_path, groups=groups)
+            config = dataclasses.replace(config, num_classes=len(groups))
+        elif config.data_path:
             data = NewsgroupsDataLoader.load(config.data_path)
             num_classes = int(data.labels.numpy().max()) + 1
             config = dataclasses.replace(config, num_classes=num_classes)
@@ -131,17 +164,30 @@ class NewsgroupsPipeline:
 def main(argv=None):
     p = argparse.ArgumentParser(description=NewsgroupsPipeline.name)
     p.add_argument("--data-path")
+    p.add_argument("--test-path")
     p.add_argument("--num-features", type=int, default=100000)
     p.add_argument("--head", choices=["nb", "ls"], default="nb")
     p.add_argument("--synthetic-n", type=int, default=400)
     p.add_argument("--model-path")
+    p.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help="stream raw document texts from the train tree per sweep "
+        "(requires --test-path)",
+    )
+    p.add_argument("--stream-batch-size", type=int, default=512)
     a = p.parse_args(argv)
     cfg = Config(
         data_path=a.data_path,
+        test_path=a.test_path,
         num_features=a.num_features,
         head=a.head,
         synthetic_n=a.synthetic_n,
         model_path=a.model_path,
+        stream=a.stream,
+        stream_batch_size=a.stream_batch_size,
     )
     print(NewsgroupsPipeline.run(cfg))
 

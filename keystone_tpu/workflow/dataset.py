@@ -135,19 +135,33 @@ class StreamDataset(Dataset):
     onto a background thread that stays ``prefetch`` batches ahead of
     the consumer (loaders pass their decode cost through this).
 
+    ``host=True`` marks a stream of HOST-object batches (lists of
+    texts, term dicts, CSR rows — the text pipelines' payloads before
+    featurization): host transformers map over it item-by-item per
+    batch, and nothing touches a device until a featurizer produces
+    arrays or CSR.  This is how a raw corpus larger than host RAM
+    streams through tokenize→n-gram→vocab→CSR (the CSR output is
+    orders of magnitude smaller and is collected normally).
+
     Estimators without a streaming fit path fall back to
-    :attr:`array`, which materializes the whole stream into device
-    memory (with a warning) — correctness is preserved everywhere, the
+    :attr:`array` / :attr:`items`, which materialize the whole stream
+    (with a warning) — correctness is preserved everywhere, the
     out-of-core guarantee only where implemented.
     """
 
     def __init__(
-        self, source, n: int, name: Optional[str] = None, prefetch: int = 0
+        self,
+        source,
+        n: int,
+        name: Optional[str] = None,
+        prefetch: int = 0,
+        host: bool = False,
     ):
         self.name = name
         self.n = int(n)
         self._host = None
         self._array = None
+        self._host_stream = bool(host)
         self.mask = None
         if not callable(source) and iter(source) is source:
             # A one-shot iterator would be shared (and interleaved!) by
@@ -162,21 +176,41 @@ class StreamDataset(Dataset):
 
             source = prefetched(source, prefetch=prefetch)
 
-        def gen():
-            src = source() if callable(source) else iter(source)
-            for batch in src:
-                arr, mask = batch if isinstance(batch, tuple) else (batch, None)
-                yield jnp.asarray(arr), (None if mask is None else jnp.asarray(mask))
+        if host:
+
+            def gen():
+                src = source() if callable(source) else iter(source)
+                for batch in src:
+                    yield list(batch), None
+
+        else:
+
+            def gen():
+                src = source() if callable(source) else iter(source)
+                for batch in src:
+                    arr, mask = (
+                        batch if isinstance(batch, tuple) else (batch, None)
+                    )
+                    yield jnp.asarray(arr), (
+                        None if mask is None else jnp.asarray(mask)
+                    )
 
         self._gen = gen
 
+    @property
+    def is_host(self) -> bool:
+        return self._host_stream
+
     @classmethod
-    def _wrap(cls, gen, n: int, name: Optional[str] = None) -> "StreamDataset":
+    def _wrap(
+        cls, gen, n: int, name: Optional[str] = None, host: bool = False
+    ) -> "StreamDataset":
         d = cls.__new__(cls)
         d.name = name
         d.n = int(n)
         d._host = None
         d._array = None
+        d._host_stream = bool(host)
         d.mask = None
         d._gen = gen
         return d
@@ -199,13 +233,16 @@ class StreamDataset(Dataset):
         return self._peek_shape
 
     def batches(self):
-        """Iterate host (numpy) batches of the mapped values."""
+        """Iterate host batches of the mapped values (numpy for device
+        streams, lists for host streams)."""
         for arr, _ in self._gen():
-            yield np.asarray(arr)
+            yield arr if self._host_stream else np.asarray(arr)
 
-    def map_batches(self, fn) -> "StreamDataset":
-        """Lazily compose a per-batch device function ``fn(arr, mask)``
-        (returning an array or an (array, mask) pair) over the stream."""
+    def map_batches(self, fn, host: Optional[bool] = None) -> "StreamDataset":
+        """Lazily compose a per-batch function ``fn(batch, mask)``
+        (returning an array/list or an (array, mask) pair) over the
+        stream.  ``host`` sets the CHILD stream's payload kind; default:
+        same as this stream."""
         parent = self._gen
 
         def gen():
@@ -216,7 +253,11 @@ class StreamDataset(Dataset):
                 else:
                     yield out, None
 
-        return StreamDataset._wrap(gen, self.n)
+        return StreamDataset._wrap(
+            gen,
+            self.n,
+            host=self._host_stream if host is None else host,
+        )
 
     @staticmethod
     def zip_concat(streams: Sequence["StreamDataset"]) -> "StreamDataset":
@@ -240,6 +281,10 @@ class StreamDataset(Dataset):
     def array(self) -> jnp.ndarray:
         """Materialize the stream into one sharded device array (escape
         hatch for consumers without a streaming path; defeats out-of-core)."""
+        if self._host_stream:
+            raise TypeError(
+                "host-payload StreamDataset has no array; featurize it first"
+            )
         if self._array is None:
             import logging
 
@@ -262,6 +307,20 @@ class StreamDataset(Dataset):
 
     @property
     def items(self) -> list:
+        if self._host_stream:
+            # collecting a host stream is often BY DESIGN small (CSR
+            # rows after featurization); log at debug, not warning
+            if self._host is None:
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "collecting host StreamDataset (n=%d) items", self.n
+                )
+                out: list = []
+                for batch, _ in self._gen():
+                    out.extend(batch)
+                self._host = out
+            return self._host
         self.array
         return [np.asarray(self._array[i]) for i in range(self.n)]
 
@@ -271,7 +330,8 @@ class StreamDataset(Dataset):
         return self
 
     def __repr__(self):
-        return f"StreamDataset(n={self.n})"
+        kind = "host, " if self._host_stream else ""
+        return f"StreamDataset({kind}n={self.n})"
 
 
 def _all_arrays(seq) -> bool:

@@ -247,6 +247,18 @@ def _truncate_datasets(graph: G.Graph, k: int) -> G.Graph:
                 # truncated PROFILING graph only
                 import numpy as np
 
+                if ds.is_host:
+                    items, got2 = [], 0
+                    for batch, _m in ds.device_batches():
+                        items.extend(batch)
+                        got2 += len(batch)
+                        if got2 >= k:
+                            break
+                    if items:
+                        graph = graph.set_operator(
+                            n, G.DatasetOperator(Dataset(items[:k]))
+                        )
+                    continue
                 parts, masks, got = [], [], 0
                 for arr, mask in ds.device_batches():
                     parts.append(np.asarray(arr))

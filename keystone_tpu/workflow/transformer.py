@@ -74,6 +74,17 @@ class Transformer(Chainable):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(ds, StreamDataset):
+            if ds.is_host:
+                if not self.is_host:
+                    raise TypeError(
+                        f"{self.label} is a device transformer; this stream "
+                        "carries host objects. Featurize to arrays first."
+                    )
+                # host transformer over a host stream: map items lazily,
+                # batch by batch — the raw corpus never materializes
+                return ds.map_batches(
+                    lambda batch, _mask: [self.apply_one(x) for x in batch]
+                )
             if self.is_host:
                 raise TypeError(
                     f"{self.label} is a host transformer; streams carry device "

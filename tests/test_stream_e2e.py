@@ -311,3 +311,112 @@ def test_imagenet_stream_undecodable_member_substitutes_zero(tmp_path, caplog):
     assert imgs.shape[0] == 4
     assert (imgs[-1] == 0).all()  # the broken member became a zero image
     assert any("undecodable" in r.message for r in caplog.records)
+
+
+# ----------------------------------------------------- host text streams
+
+
+def test_newsgroups_text_stream_matches_inmemory(tmp_path, mesh):
+    """Host-stage text streaming: raw documents stream from disk through
+    tokenize→n-gram→tf→vocab-fit→CSR→sparse solver without the corpus
+    ever materializing; predictions must match the in-memory fit on the
+    SAME training tree."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_accuracy import _write_newsgroups_fixture
+
+    from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+    from keystone_tpu.pipelines.newsgroups import Config, NewsgroupsPipeline
+
+    train_root = _write_newsgroups_fixture(
+        str(tmp_path / "train"), num_classes=3, docs_per_class=40, seed=0
+    )
+    test_root = _write_newsgroups_fixture(
+        str(tmp_path / "test"), num_classes=3, docs_per_class=10, seed=1
+    )
+    out_stream = NewsgroupsPipeline.run(
+        Config(
+            data_path=train_root,
+            test_path=test_root,
+            head="ls",
+            ls_lam=1e-2,
+            num_features=16384,  # engages the real sparse route
+            stream=True,
+            stream_batch_size=16,
+        )
+    )
+    # reference: in-memory fit on the SAME training tree, same test tree
+    train = NewsgroupsDataLoader.load(train_root)
+    test = NewsgroupsDataLoader.load(test_root)
+    cfg = Config(head="ls", ls_lam=1e-2, num_features=16384, num_classes=3)
+    fitted = NewsgroupsPipeline.build(cfg, train.data, train.labels).fit()
+    preds = fitted(test.data).get().numpy().ravel()[: test.labels.n]
+    acc_mem = float((preds == test.labels.numpy()).mean())
+    assert abs(out_stream["accuracy"] - acc_mem) < 1e-6, (
+        out_stream["accuracy"],
+        acc_mem,
+    )
+
+
+def test_host_stream_never_materializes_through_featurizer(mesh):
+    """The raw-text stream must stay lazy through the host transformer
+    chain: only the featurized CSR rows may be collected."""
+    from keystone_tpu.ops.nlp import (
+        CommonSparseFeatures,
+        LowerCase,
+        Tokenizer,
+    )
+
+    reads = []
+
+    def batches():
+        for i in range(0, 30, 10):
+            reads.append(i)
+            yield [f"word{j} word{j} common" for j in range(i, i + 10)]
+
+    ds = StreamDataset(batches, n=30, host=True)
+    assert ds.is_host
+    mapped = Tokenizer().apply_dataset(LowerCase().apply_dataset(ds))
+    assert isinstance(mapped, StreamDataset) and mapped.is_host
+    assert reads == []  # nothing consumed yet: lazy end to end
+    csf = CommonSparseFeatures(8, sparse_output=True)
+    from keystone_tpu.ops.nlp import TermFrequency, log_tf
+
+    tf = TermFrequency(log_tf).apply_dataset(mapped)
+    model = csf.fit_dataset(tf)  # ONE streaming df sweep
+    assert reads == [0, 10, 20]
+    rows_stream = model.apply_dataset(tf)
+    assert isinstance(rows_stream, StreamDataset)
+    rows = rows_stream.items  # CSR collection is the intended small sink
+    assert len(rows) == 30 and hasattr(rows[0], "tocoo")
+
+
+def test_newsgroups_text_stream_dense_nb_head(tmp_path, mesh):
+    """Dense featurizer output (num_features < sparse threshold) over a
+    text stream must become a DEVICE stream the NB head can consume
+    (review finding: it used to dead-end as a host stream)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_accuracy import _write_newsgroups_fixture
+
+    from keystone_tpu.pipelines.newsgroups import Config, NewsgroupsPipeline
+
+    train_root = _write_newsgroups_fixture(
+        str(tmp_path / "train"), num_classes=3, docs_per_class=25, seed=0
+    )
+    test_root = _write_newsgroups_fixture(
+        str(tmp_path / "test"), num_classes=3, docs_per_class=8, seed=1
+    )
+    out = NewsgroupsPipeline.run(
+        Config(
+            data_path=train_root,
+            test_path=test_root,
+            head="nb",
+            num_features=512,  # dense route
+            stream=True,
+            stream_batch_size=16,
+        )
+    )
+    assert out["accuracy"] > 0.5  # learnable; must not crash
