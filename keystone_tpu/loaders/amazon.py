@@ -33,6 +33,50 @@ class AmazonReviewsDataLoader:
         )
 
     @staticmethod
+    def stream(
+        path: str,
+        threshold: float = 3.5,
+        batch_size: int = 1024,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: one pass parses only the ratings (labels,
+        4 bytes/review); review TEXTS re-parse from the JSON-lines file
+        in ``batch_size`` chunks per sweep through a host StreamDataset."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        labels = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                rating = float(rec.get("overall", rec.get("rating", 0.0)))
+                labels.append(1 if rating > threshold else 0)
+        n = len(labels)
+
+        def batches():
+            chunk = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    chunk.append(rec.get("reviewText", rec.get("text", "")))
+                    if len(chunk) == batch_size:
+                        yield chunk
+                        chunk = []
+            if chunk:
+                yield chunk
+
+        name = f"amazon-stream:{os.path.abspath(path)}:t{threshold}:b{batch_size}"
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch, host=True),
+            Dataset(np.asarray(labels, np.int32), name=name + "-labels"),
+        )
+
+    @staticmethod
     def synthetic(n: int = 600, seed: int = 0) -> LabeledData:
         rng = np.random.default_rng(seed)
         pos = ["great", "excellent", "love", "perfect", "amazing", "best"]

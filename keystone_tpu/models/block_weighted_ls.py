@@ -90,6 +90,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(data, StreamDataset):
+            if data.is_host:
+                raise TypeError(
+                    "host-payload stream reached a block solver; "
+                    "featurize to arrays (or CSR) before the fit"
+                )
             return self.fit_stream_dataset(data, labels)
         return self._fit(data.array, labels.array, data.n)
 

@@ -127,6 +127,11 @@ class LinearMapEstimator(LabelEstimator):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(data, StreamDataset):
+            if data.is_host:
+                raise TypeError(
+                    "host-payload stream reached the exact solver with "
+                    "non-CSR items; featurize to arrays (or CSR) first"
+                )
             # out-of-core: labels are (n, k) and stay in memory; features
             # stream past the sufficient-statistic accumulators
             import numpy as np

@@ -156,24 +156,27 @@ class CommonSparseFeaturesModel(Transformer):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(ds, StreamDataset) and ds.is_host:
-            # text stream: featurize batch-by-batch, keeping the stream
-            # lazy.  Sparse output stays a HOST stream of CSR rows
-            # (small; downstream fits collect them); dense output
-            # becomes a DEVICE stream so array consumers keep working.
-            if self.sparse_output:
-                return ds.map_batches(
-                    lambda batch, _m: [self.apply_one(d) for d in batch]
-                )
-            return ds.map_batches(
-                lambda batch, _m: np.stack(
-                    [self.apply_one(d) for d in batch]
-                ),
-                host=False,
-            )
+            return _featurize_host_stream(self, ds)
         if self.sparse_output:
             return ds.with_items([self.apply_one(d) for d in ds.items])
         rows = np.stack([self.apply_one(d) for d in ds.items])
         return Dataset(rows)
+
+
+def _featurize_host_stream(model, ds):
+    """Shared host-stream featurization for the sparse-capable text
+    featurizers: sparse output stays a lazy HOST stream of CSR rows
+    (small; downstream fits collect them — Transformer's generic
+    host-item mapping), dense output becomes a DEVICE stream so array
+    consumers keep working."""
+    from keystone_tpu.workflow.transformer import Transformer
+
+    if model.sparse_output:
+        return Transformer.apply_dataset(model, ds)
+    return ds.map_batches(
+        lambda batch, _m: np.stack([model.apply_one(d) for d in batch]),
+        host=False,
+    )
 
 
 class CommonSparseFeatures(Estimator):
@@ -270,16 +273,7 @@ class HashingTF(Transformer):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(ds, StreamDataset) and ds.is_host:
-            if self.sparse_output:
-                return ds.map_batches(
-                    lambda batch, _m: [self.apply_one(d) for d in batch]
-                )
-            return ds.map_batches(
-                lambda batch, _m: np.stack(
-                    [self.apply_one(d) for d in batch]
-                ),
-                host=False,
-            )
+            return _featurize_host_stream(self, ds)
         if self.sparse_output:
             return ds.with_items([self.apply_one(d) for d in ds.items])
         rows = np.stack([self.apply_one(d) for d in ds.items])

@@ -35,6 +35,11 @@ class Config:
     num_iters: int = 40
     synthetic_n: int = 600
     model_path: Optional[str] = None
+    # out-of-core: stream review texts from the JSON-lines file per
+    # sweep (host StreamDataset); requires test_path
+    test_path: Optional[str] = None
+    stream: bool = False
+    stream_batch_size: int = 1024
 
 
 class AmazonReviewsPipeline:
@@ -71,7 +76,21 @@ class AmazonReviewsPipeline:
     def run(config: Config) -> dict:
         # train/test come from ONE load+split, so the load stays eager
         # (the test half is always needed, even for saved-model runs)
-        if config.data_path:
+        if config.stream and config.data_path:
+            if not config.test_path:
+                raise ValueError(
+                    "--stream needs --test-path: a streamed JSON-lines "
+                    "file cannot be split in place"
+                )
+            train = AmazonReviewsDataLoader.stream(
+                config.data_path, batch_size=config.stream_batch_size
+            )
+            test = AmazonReviewsDataLoader.load(config.test_path)
+        elif config.data_path and config.test_path:
+            # explicit test file: honor it, no split
+            train = AmazonReviewsDataLoader.load(config.data_path)
+            test = AmazonReviewsDataLoader.load(config.test_path)
+        elif config.data_path:
             data = AmazonReviewsDataLoader.load(config.data_path)
             train, test = data.split(0.8, seed=0)
         else:
@@ -103,14 +122,27 @@ class AmazonReviewsPipeline:
 def main(argv=None):
     p = argparse.ArgumentParser(description=AmazonReviewsPipeline.name)
     p.add_argument("--data-path")
+    p.add_argument("--test-path")
     p.add_argument("--num-features", type=int, default=16384)
     p.add_argument("--synthetic-n", type=int, default=600)
     p.add_argument("--model-path")
+    p.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help="stream review texts from the JSON-lines file per sweep "
+        "(requires --test-path)",
+    )
+    p.add_argument("--stream-batch-size", type=int, default=1024)
     a = p.parse_args(argv)
     print(
         AmazonReviewsPipeline.run(
             Config(
                 data_path=a.data_path,
+                test_path=a.test_path,
+                stream=a.stream,
+                stream_batch_size=a.stream_batch_size,
                 num_features=a.num_features,
                 synthetic_n=a.synthetic_n,
                 model_path=a.model_path,

@@ -95,32 +95,33 @@ class NewsgroupsPipeline:
     def run(config: Config) -> dict:
         # train/test come from ONE load+split, so the load stays eager
         # (the test half is always needed, even for saved-model runs)
-        if config.stream and config.data_path:
-            if not config.test_path:
-                raise ValueError(
-                    "--stream needs --test-path: a streamed train tree "
-                    "cannot be split in place"
-                )
-            import os
-
-            # ONE group→label mapping from the TRAIN tree, shared with
-            # the test load — independently-derived mappings would
-            # silently misalign labels when the trees' group sets differ
-            groups = sorted(os.listdir(config.data_path))
-            train = NewsgroupsDataLoader.stream(
-                config.data_path,
-                groups=groups,
-                batch_size=config.stream_batch_size,
+        if config.stream and config.data_path and not config.test_path:
+            raise ValueError(
+                "--stream needs --test-path: a streamed train tree "
+                "cannot be split in place"
             )
-            test = NewsgroupsDataLoader.load(config.test_path, groups=groups)
-            config = dataclasses.replace(config, num_classes=len(groups))
-        elif config.data_path and config.test_path:
-            # explicit test tree: no split; labels share the train
-            # tree's group mapping
+        if config.data_path and config.test_path:
             import os
 
-            groups = sorted(os.listdir(config.data_path))
-            train = NewsgroupsDataLoader.load(config.data_path, groups=groups)
+            # ONE group→label mapping from the TRAIN tree's group DIRS,
+            # shared with the test load — independently-derived mappings
+            # would silently misalign labels when the trees differ, and
+            # stray files must not become phantom classes
+            groups = sorted(
+                g
+                for g in os.listdir(config.data_path)
+                if os.path.isdir(os.path.join(config.data_path, g))
+            )
+            if config.stream:
+                train = NewsgroupsDataLoader.stream(
+                    config.data_path,
+                    groups=groups,
+                    batch_size=config.stream_batch_size,
+                )
+            else:
+                train = NewsgroupsDataLoader.load(
+                    config.data_path, groups=groups
+                )
             test = NewsgroupsDataLoader.load(config.test_path, groups=groups)
             config = dataclasses.replace(config, num_classes=len(groups))
         elif config.data_path:

@@ -420,3 +420,49 @@ def test_newsgroups_text_stream_dense_nb_head(tmp_path, mesh):
         )
     )
     assert out["accuracy"] > 0.5  # learnable; must not crash
+
+
+def test_amazon_text_stream_matches_inmemory(tmp_path, mesh):
+    """Amazon reviews: JSON-lines texts stream through HashingTF (host
+    stream, no vocab fit needed) into the sparse logistic head; stream
+    predictions match the in-memory fit on the same file."""
+    import json as json_mod
+
+    from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader
+    from keystone_tpu.pipelines.amazon_reviews import (
+        AmazonReviewsPipeline,
+        Config,
+    )
+
+    def write_jsonl(path, n, seed):
+        data = AmazonReviewsDataLoader.synthetic(n, seed=seed)
+        with open(path, "w") as f:
+            for text, lab in zip(data.data.items, data.labels.numpy()):
+                f.write(
+                    json_mod.dumps(
+                        {"reviewText": text, "overall": 5.0 if lab else 1.0}
+                    )
+                    + "\n"
+                )
+        return path
+
+    train_path = write_jsonl(str(tmp_path / "train.jsonl"), 120, 1)
+    test_path = write_jsonl(str(tmp_path / "test.jsonl"), 40, 2)
+    out = AmazonReviewsPipeline.run(
+        Config(
+            data_path=train_path,
+            test_path=test_path,
+            stream=True,
+            stream_batch_size=32,
+            num_features=16384,
+            num_iters=30,
+        )
+    )
+    # reference: in-memory fit on the SAME file
+    train = AmazonReviewsDataLoader.load(train_path)
+    test = AmazonReviewsDataLoader.load(test_path)
+    cfg = Config(num_features=16384, num_iters=30)
+    fitted = AmazonReviewsPipeline.build(cfg, train.data, train.labels).fit()
+    preds = fitted(test.data).get().numpy().ravel()[: test.labels.n]
+    acc_mem = float((preds == test.labels.numpy()).mean())
+    assert abs(out["accuracy"] - acc_mem) < 1e-6, (out["accuracy"], acc_mem)
