@@ -62,8 +62,10 @@ _BF16_EFFECTIVE_PEAK = 1.97e14  # TPU v5 lite bf16-grade MXU peak (~197 Tf/s);
 # is the honest utilization denominator for the matmul-dense stages
 N_LEGS = int(os.environ.get("BENCH_LEGS", "3"))  # ≥3 resynced samples
 _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
-# bump whenever the methodology or config changes so stale caches die
-_BASELINE_VERSION = 4
+# bump whenever the methodology, config, or the measured PROGRAM changes
+# so stale caches die (v5: SIFT windowing default moved to the matmul
+# path — the CPU leg must run the same program as the TPU leg)
+_BASELINE_VERSION = 5
 
 
 def build_forward():

@@ -30,29 +30,14 @@ class VOCLoader:
         size: Tuple[int, int] = (256, 256),
         limit: Optional[int] = None,
     ) -> LabeledData:
-        from keystone_tpu.loaders.imagenet import _decode_jpeg
-
-        cls_index = {c: i for i, c in enumerate(VOC_CLASSES)}
-        images, labels = [], []
-        for fname in sorted(os.listdir(annotations_dir)):
-            if not fname.endswith(".xml"):
-                continue
-            stem = os.path.splitext(fname)[0]
-            jpg = os.path.join(images_dir, stem + ".jpg")
-            if not os.path.exists(jpg):
-                continue
-            tree = ET.parse(os.path.join(annotations_dir, fname))
-            multilabel = np.zeros((NUM_CLASSES,), np.float32)
-            for obj in tree.findall(".//object/name"):
-                idx = cls_index.get(obj.text)
-                if idx is not None:
-                    multilabel[idx] = 1.0
-            with open(jpg, "rb") as f:
-                images.append(_decode_jpeg(f.read(), size))
-            labels.append(multilabel)
-            if limit is not None and len(images) >= limit:
-                break
-        x = np.stack(images) if images else np.zeros((0, *size, 3), np.uint8)
+        paths, labels = _index(images_dir, annotations_dir)
+        if limit is not None:
+            paths, labels = paths[:limit], labels[:limit]
+        x = (
+            _decode_paths(paths, size)
+            if paths
+            else np.zeros((0, *size, 3), np.uint8)
+        )
         y = np.stack(labels) if labels else np.zeros((0, NUM_CLASSES), np.float32)
         name = (
             f"voc:{os.path.abspath(images_dir)}:{os.path.abspath(annotations_dir)}"
@@ -60,6 +45,41 @@ class VOCLoader:
         )
         return LabeledData(
             Dataset(x, name=name), Dataset(y, name=name + "-labels")
+        )
+
+    @staticmethod
+    def stream(
+        images_dir: str,
+        annotations_dir: str,
+        size: Tuple[int, int] = (256, 256),
+        batch_size: int = 64,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Out-of-core loader: one cheap XML pass fixes the file list and
+        multilabels; JPEGs re-decode from disk in ``batch_size`` chunks
+        per sweep on a prefetch thread."""
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        paths, labels = _index(images_dir, annotations_dir)
+        n = len(paths)
+
+        def batches():
+            for i in range(0, n, batch_size):
+                yield _decode_paths(paths[i : i + batch_size], size)
+
+        name = (
+            f"voc-stream:{os.path.abspath(images_dir)}"
+            f":{os.path.abspath(annotations_dir)}:{size[0]}x{size[1]}"
+            f":b{batch_size}"
+        )
+        y = (
+            np.stack(labels)
+            if labels
+            else np.zeros((0, NUM_CLASSES), np.float32)
+        )
+        return LabeledData(
+            StreamDataset(batches, n, name=name, prefetch=prefetch),
+            Dataset(y, name=name + "-labels"),
         )
 
     @staticmethod
@@ -81,3 +101,65 @@ class VOCLoader:
             base.data,
             Dataset(multi, name=f"voc-synth-multilabels-n{n}-s{seed}"),
         )
+
+
+def _index(
+    images_dir: str, annotations_dir: str
+) -> Tuple[List[str], List[np.ndarray]]:
+    """One XML pass shared by load() and stream(): (jpg paths,
+    multilabels), in sorted-annotation order."""
+    cls_index = {c: i for i, c in enumerate(VOC_CLASSES)}
+    paths: List[str] = []
+    labels: List[np.ndarray] = []
+    for fname in sorted(os.listdir(annotations_dir)):
+        if not fname.endswith(".xml"):
+            continue
+        stem = os.path.splitext(fname)[0]
+        jpg = os.path.join(images_dir, stem + ".jpg")
+        if not os.path.exists(jpg):
+            continue
+        tree = ET.parse(os.path.join(annotations_dir, fname))
+        multilabel = np.zeros((NUM_CLASSES,), np.float32)
+        for obj in tree.findall(".//object/name"):
+            idx = cls_index.get(obj.text)
+            if idx is not None:
+                multilabel[idx] = 1.0
+        paths.append(jpg)
+        labels.append(multilabel)
+    return paths, labels
+
+
+def _decode_paths(paths: List[str], size: Tuple[int, int]) -> np.ndarray:
+    """Batch-decode JPEG files, shared by load() and stream() so their
+    pixels cannot drift: threaded libjpeg when the native library is
+    present, PIL fallback; an undecodable file becomes a zero image with
+    a warning (the index already fixed row/label alignment)."""
+    import logging
+
+    from keystone_tpu import native
+    from keystone_tpu.loaders.imagenet import _decode_jpeg
+
+    blobs = []
+    for p in paths:
+        with open(p, "rb") as f:
+            blobs.append(f.read())
+    out = np.zeros((len(paths), *size, 3), np.uint8)
+    decoded = native.decode_jpegs(blobs, size)
+    if decoded is not None:
+        imgs, ok = decoded
+        for j, p in enumerate(paths):
+            if ok[j]:
+                out[j] = imgs[j]
+            else:
+                logging.getLogger(__name__).warning(
+                    "undecodable JPEG %s; substituting a zero image", p
+                )
+        return out
+    for j, p in enumerate(paths):
+        try:
+            out[j] = _decode_jpeg(blobs[j], size)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "undecodable JPEG %s; substituting a zero image", p
+            )
+    return out

@@ -466,3 +466,21 @@ def test_amazon_text_stream_matches_inmemory(tmp_path, mesh):
     preds = fitted(test.data).get().numpy().ravel()[: test.labels.n]
     acc_mem = float((preds == test.labels.numpy()).mean())
     assert abs(out["accuracy"] - acc_mem) < 1e-6, (out["accuracy"], acc_mem)
+
+
+def test_voc_stream_matches_load(tmp_path, mesh):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_accuracy import _write_voc_fixture
+
+    from keystone_tpu.loaders.voc import VOCLoader
+
+    img_dir, ann_dir = _write_voc_fixture(str(tmp_path / "voc"), n=15)
+    mem = VOCLoader.load(img_dir, ann_dir, size=(48, 48))
+    st = VOCLoader.stream(img_dir, ann_dir, size=(48, 48), batch_size=4)
+    assert st.data.n == mem.data.n == 15
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_array_equal(
+        np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
