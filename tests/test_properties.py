@@ -325,3 +325,74 @@ def test_padded_sparse_rows_roundtrip_property(rows, d, seed):
     )
     sp = PaddedSparseRows.from_dense(x)
     np.testing.assert_allclose(sp.toarray(), x, atol=1e-6)
+
+
+# ----------------------------------------------------- bucketed sparse ops
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    nnz_counts=st.lists(st.integers(1, 60), min_size=4, max_size=24),
+    seed=st.integers(0, 2**16),
+)
+def test_bucketed_sparse_matmul_equals_dense(nnz_counts, seed):
+    """For ANY nnz profile (uniform, heavy-tailed, constant), bucketed
+    matmul must equal the dense product in the original row order."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.ops.sparse import BucketedSparseRows
+
+    rng = np.random.default_rng(seed)
+    d, k = 80, 3
+    rows = []
+    for nz in nnz_counts:
+        cols = rng.choice(d, size=min(nz, d), replace=False)
+        vals = rng.normal(size=cols.size).astype(np.float32)
+        rows.append(
+            sp.csr_matrix((vals, ([0] * cols.size, cols)), shape=(1, d))
+        )
+    bk = BucketedSparseRows.from_scipy_rows(rows)
+    dense = np.concatenate([r.toarray() for r in rows]).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    np.testing.assert_allclose(bk.matmul(w), dense @ w, atol=5e-4)
+    # permutation is a true permutation of all original indices
+    assert sorted(bk.perm.tolist()) == list(range(len(rows)))
+    # bucket caps are powers of two and every row's nnz fits its cap
+    start = 0
+    for b in bk.buckets:
+        cap = b.indices.shape[1]
+        assert cap & (cap - 1) == 0
+        for orig in bk.perm[start : start + b.n]:
+            assert min(nnz_counts[orig], d) <= cap
+        start += b.n
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    chunk=st.sampled_from([16, 32, 48, 64, 128]),  # bounded compile count
+    seed=st.integers(0, 2**16),
+)
+def test_chunked_sparse_ops_chunk_invariant(chunk, seed):
+    """sparse_matmul / sparse_grad results must not depend on the chunk
+    size (the scan restructuring is purely an execution strategy).
+    Generalizes tests/test_sparse.py::test_chunked_ops_match_unchunked,
+    which stays as the fast fixed-chunk smoke variant."""
+    import keystone_tpu.ops.sparse as sparse_mod
+
+    rng = np.random.default_rng(seed)
+    rows, nnz, d, k = 200, 9, 50, 4
+    idx = rng.integers(0, d, size=(rows, nnz)).astype(np.int32)
+    vals = rng.normal(size=(rows, nnz)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    r = rng.normal(size=(rows, k)).astype(np.float32)
+    ref_mm = np.asarray(sparse_mod.sparse_matmul(idx, vals, w))
+    ref_g = np.asarray(sparse_mod.sparse_grad(idx, vals, r, d))
+    orig = sparse_mod._auto_chunk
+    sparse_mod._auto_chunk = lambda *a: chunk
+    try:
+        got_mm = np.asarray(sparse_mod.sparse_matmul(idx, vals, w))
+        got_g = np.asarray(sparse_mod.sparse_grad(idx, vals, r, d))
+    finally:
+        sparse_mod._auto_chunk = orig
+    np.testing.assert_allclose(got_mm, ref_mm, atol=1e-5)
+    np.testing.assert_allclose(got_g, ref_g, atol=1e-4)
