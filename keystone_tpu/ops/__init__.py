@@ -12,7 +12,10 @@ from keystone_tpu.ops.stats import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
-from keystone_tpu.ops.sparse import PaddedSparseRows  # noqa: F401
+from keystone_tpu.ops.sparse import (  # noqa: F401
+    BucketedSparseRows,
+    PaddedSparseRows,
+)
 from keystone_tpu.ops.util import (  # noqa: F401
     ClassLabelIndicators,
     Densify,
