@@ -21,6 +21,13 @@ class MnistLoader:
         return CsvDataLoader.load(path, label_col=0)
 
     @staticmethod
+    def stream(path: str, batch_size: int = 4096, prefetch: int = 2) -> LabeledData:
+        """Out-of-core: CSV rows re-parse per sweep (CsvDataLoader.stream)."""
+        return CsvDataLoader.stream(
+            path, label_col=0, batch_size=batch_size, prefetch=prefetch
+        )
+
+    @staticmethod
     def synthetic(n: int = 2048, seed: int = 0) -> LabeledData:
         """Class-dependent blobs in 784-d pixel space, scaled like MNIST
         (pixels in [0, 255])."""

@@ -96,3 +96,20 @@ def prefetched(
             stop.set()
 
     return gen
+
+
+def stream_labeled(labeled, batch_size: int, prefetch: int = 0):
+    """Wrap an in-memory LabeledData's features as a StreamDataset (the
+    demo/test path apps use for --stream without real files): memory
+    still holds the source array, but the streaming fit paths engage."""
+    from keystone_tpu.loaders.labeled import LabeledData
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    return LabeledData(
+        StreamDataset(
+            batched(labeled.data.numpy(), batch_size),
+            n=labeled.data.n,
+            prefetch=prefetch,
+        ),
+        labeled.labels,
+    )

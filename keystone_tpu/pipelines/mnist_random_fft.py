@@ -35,6 +35,10 @@ class Config:
     # persist/reuse the fitted pipeline (the reference's serializable
     # PipelineModel flow): fit once, save; later runs load and only score
     model_path: Optional[str] = None
+    # out-of-core: re-parse the training CSV per sweep; the exact solver
+    # accumulates sufficient statistics batch-by-batch
+    stream: bool = False
+    stream_batch_size: int = 4096
 
 
 class MnistRandomFFT:
@@ -43,7 +47,12 @@ class MnistRandomFFT:
 
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
-        dim = train_x.array.shape[1]
+        from keystone_tpu.workflow.dataset import StreamDataset
+
+        if isinstance(train_x, StreamDataset):
+            (dim,) = train_x.peek_shape()  # one batch, not the stream
+        else:
+            dim = train_x.array.shape[1]
         branches = [
             Pipeline.of(RandomSignNode.init(dim, seed=config.seed + i))
             .and_then(PaddedFFT())
@@ -62,6 +71,11 @@ class MnistRandomFFT:
 
     @staticmethod
     def run(config: Config) -> dict:
+        if config.stream and config.train_path and not config.test_path:
+            raise ValueError(
+                "--stream needs --test-path: evaluating on the training "
+                "CSV would eagerly load the file streaming exists to avoid"
+            )
         if config.train_path:
             test = MnistLoader.load(config.test_path or config.train_path)
         else:
@@ -70,8 +84,20 @@ class MnistRandomFFT:
         def build():
             # training data loads ONLY when a fit is actually needed —
             # scoring runs with a saved model skip it entirely
-            if config.train_path:
+            if config.stream and config.train_path:
+                train = MnistLoader.stream(
+                    config.train_path, batch_size=config.stream_batch_size
+                )
+            elif config.train_path:
                 train = MnistLoader.load(config.train_path)
+            elif config.stream:
+                # demo/test path: stream the synthetic rows in batches
+                from keystone_tpu.loaders.stream import stream_labeled
+
+                train = stream_labeled(
+                    MnistLoader.synthetic(config.synthetic_n, seed=1),
+                    config.stream_batch_size,
+                )
             else:
                 train = MnistLoader.synthetic(config.synthetic_n, seed=1)
             return MnistRandomFFT.build(config, train.data, train.labels)
@@ -108,10 +134,21 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=2048)
     p.add_argument("--model-path")
+    p.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help="re-parse the training CSV per sweep; the exact solver "
+        "accumulates sufficient statistics out-of-core",
+    )
+    p.add_argument("--stream-batch-size", type=int, default=4096)
     a = p.parse_args(argv)
     cfg = Config(
         a.train_path, a.test_path, a.num_ffts, a.lam, a.seed, a.synthetic_n,
         model_path=a.model_path,
+        stream=a.stream,
+        stream_batch_size=a.stream_batch_size,
     )
     print(MnistRandomFFT.run(cfg))
 

@@ -112,19 +112,10 @@ class TimitPipeline:
                     if config.stream:
                         # demo/test path: stream the synthetic frames in
                         # batches so the out-of-core fit path engages
-                        from keystone_tpu.loaders.stream import batched
-                        from keystone_tpu.loaders.labeled import LabeledData
-                        from keystone_tpu.workflow.dataset import StreamDataset
+                        from keystone_tpu.loaders.stream import stream_labeled
 
-                        synth = LabeledData(
-                            StreamDataset(
-                                batched(
-                                    synth.data.numpy(),
-                                    config.stream_batch_size,
-                                ),
-                                n=synth.data.n,
-                            ),
-                            synth.labels,
+                        synth = stream_labeled(
+                            synth, config.stream_batch_size
                         )
                     _train_cache.append(synth)
             return _train_cache[0]

@@ -484,3 +484,56 @@ def test_voc_stream_matches_load(tmp_path, mesh):
     np.testing.assert_array_equal(
         np.concatenate(list(st.data.batches())), mem.data.numpy()
     )
+
+
+def test_mnist_app_stream_matches_inmemory(tmp_path, mesh):
+    """MnistRandomFFT --stream: CSV rows re-parse per sweep; the exact
+    solver's streaming sufficient statistics must reproduce the
+    in-memory fit through the app entry point."""
+    from keystone_tpu.loaders.mnist import MnistLoader
+    from keystone_tpu.pipelines.mnist_random_fft import Config, MnistRandomFFT
+
+    # write a small CSV in the app's format (label, 784 pixels)
+    synth = MnistLoader.synthetic(192, seed=3)
+    mat = np.column_stack(
+        [synth.labels.numpy().astype(np.float32), synth.data.numpy()]
+    )
+    train_csv = str(tmp_path / "train.csv")
+    np.savetxt(train_csv, mat, delimiter=",", fmt="%.4f")
+    test_synth = MnistLoader.synthetic(64, seed=4)
+    test_csv = str(tmp_path / "test.csv")
+    np.savetxt(
+        test_csv,
+        np.column_stack(
+            [test_synth.labels.numpy().astype(np.float32), test_synth.data.numpy()]
+        ),
+        delimiter=",",
+        fmt="%.4f",
+    )
+    base = dict(
+        train_path=train_csv, test_path=test_csv, num_ffts=2, lam=1e-2
+    )
+    out_stream = MnistRandomFFT.run(
+        Config(**base, stream=True, stream_batch_size=48)
+    )
+    out_mem = MnistRandomFFT.run(Config(**base))
+    assert abs(out_stream["accuracy"] - out_mem["accuracy"]) < 0.02, (
+        out_stream["accuracy"],
+        out_mem["accuracy"],
+    )
+
+
+def test_timit_stream_csv_features(tmp_path, mesh):
+    """TIMIT stream's CSV branch (the npy branch is covered above)."""
+    rng = np.random.default_rng(1)
+    feats = rng.normal(size=(25, 6)).astype(np.float32)
+    labs = rng.integers(0, 4, size=25)
+    fp, lp = str(tmp_path / "f.csv"), str(tmp_path / "l.txt")
+    np.savetxt(fp, feats, delimiter=",", fmt="%.6f")
+    np.savetxt(lp, labs, fmt="%d")
+    mem = TimitFeaturesDataLoader.load(fp, lp)
+    st = TimitFeaturesDataLoader.stream(fp, lp, batch_size=7)
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_allclose(
+        np.concatenate(list(st.data.batches())), mem.data.numpy(), rtol=1e-5
+    )
