@@ -113,3 +113,41 @@ def stream_labeled(labeled, batch_size: int, prefetch: int = 0):
         ),
         labeled.labels,
     )
+
+
+def require_stream_test_path(config) -> None:
+    """Apps with --stream must be given an explicit test set: evaluating
+    on the training source would eagerly load the data streaming exists
+    to avoid."""
+    if config.stream and config.train_path and not config.test_path:
+        raise ValueError(
+            "--stream needs --test-path: evaluating on the training "
+            "source would eagerly load the data streaming exists to avoid"
+        )
+
+
+def resolve_train_source(config, load, stream, synthetic):
+    """The 4-way train-source selection shared by the --stream apps:
+    real+stream, real, synthetic-as-stream (demo path), synthetic."""
+    if config.stream and config.train_path:
+        return stream(config.train_path, batch_size=config.stream_batch_size)
+    if config.train_path:
+        return load(config.train_path)
+    if config.stream:
+        return stream_labeled(synthetic(), config.stream_batch_size)
+    return synthetic()
+
+
+def add_stream_args(parser, default_batch_size: int, noun: str) -> None:
+    """The --stream/--stream-batch-size argparse block the apps share."""
+    parser.add_argument(
+        "--stream",
+        "--out-of-core",
+        action="store_true",
+        dest="stream",
+        help=f"re-read {noun} from disk per sweep (requires --test-path); "
+        "fits run out-of-core",
+    )
+    parser.add_argument(
+        "--stream-batch-size", type=int, default=default_batch_size
+    )

@@ -22,6 +22,10 @@ class Config:
     lam: float = 1e-3
     synthetic_n: int = 1024
     model_path: Optional[str] = None
+    # out-of-core: re-read CIFAR records from disk per sweep; the exact
+    # solver accumulates sufficient statistics batch-by-batch
+    stream: bool = False
+    stream_batch_size: int = 1024
 
 
 class LinearPixels:
@@ -39,6 +43,9 @@ class LinearPixels:
 
     @staticmethod
     def run(config: Config) -> dict:
+        from keystone_tpu.loaders.stream import require_stream_test_path
+
+        require_stream_test_path(config)
         if config.train_path:
             test = CifarLoader.load(config.test_path or config.train_path)
         else:
@@ -46,10 +53,15 @@ class LinearPixels:
 
         def build():
             # train loads ONLY when a fit is needed (saved-model runs skip it)
-            train = (
-                CifarLoader.load(config.train_path)
-                if config.train_path
-                else CifarLoader.synthetic(config.synthetic_n, seed=1)
+            from keystone_tpu.loaders.stream import resolve_train_source
+
+            train = resolve_train_source(
+                config,
+                load=CifarLoader.load,
+                stream=CifarLoader.stream,
+                synthetic=lambda: CifarLoader.synthetic(
+                    config.synthetic_n, seed=1
+                ),
             )
             return LinearPixels.build(config, train.data, train.labels)
 
@@ -81,10 +93,15 @@ def main(argv=None):
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--synthetic-n", type=int, default=1024)
     p.add_argument("--model-path")
+    from keystone_tpu.loaders.stream import add_stream_args
+
+    add_stream_args(p, default_batch_size=1024, noun="CIFAR records")
     a = p.parse_args(argv)
     print(LinearPixels.run(Config(
         a.train_path, a.test_path, a.lam, a.synthetic_n,
         model_path=a.model_path,
+        stream=a.stream,
+        stream_batch_size=a.stream_batch_size,
     )))
 
 

@@ -47,12 +47,7 @@ class MnistRandomFFT:
 
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
-        from keystone_tpu.workflow.dataset import StreamDataset
-
-        if isinstance(train_x, StreamDataset):
-            (dim,) = train_x.peek_shape()  # one batch, not the stream
-        else:
-            dim = train_x.array.shape[1]
+        (dim,) = train_x.item_shape  # stream-safe (peeks one batch)
         branches = [
             Pipeline.of(RandomSignNode.init(dim, seed=config.seed + i))
             .and_then(PaddedFFT())
@@ -71,11 +66,9 @@ class MnistRandomFFT:
 
     @staticmethod
     def run(config: Config) -> dict:
-        if config.stream and config.train_path and not config.test_path:
-            raise ValueError(
-                "--stream needs --test-path: evaluating on the training "
-                "CSV would eagerly load the file streaming exists to avoid"
-            )
+        from keystone_tpu.loaders.stream import require_stream_test_path
+
+        require_stream_test_path(config)
         if config.train_path:
             test = MnistLoader.load(config.test_path or config.train_path)
         else:
@@ -84,22 +77,16 @@ class MnistRandomFFT:
         def build():
             # training data loads ONLY when a fit is actually needed —
             # scoring runs with a saved model skip it entirely
-            if config.stream and config.train_path:
-                train = MnistLoader.stream(
-                    config.train_path, batch_size=config.stream_batch_size
-                )
-            elif config.train_path:
-                train = MnistLoader.load(config.train_path)
-            elif config.stream:
-                # demo/test path: stream the synthetic rows in batches
-                from keystone_tpu.loaders.stream import stream_labeled
+            from keystone_tpu.loaders.stream import resolve_train_source
 
-                train = stream_labeled(
-                    MnistLoader.synthetic(config.synthetic_n, seed=1),
-                    config.stream_batch_size,
-                )
-            else:
-                train = MnistLoader.synthetic(config.synthetic_n, seed=1)
+            train = resolve_train_source(
+                config,
+                load=MnistLoader.load,
+                stream=MnistLoader.stream,
+                synthetic=lambda: MnistLoader.synthetic(
+                    config.synthetic_n, seed=1
+                ),
+            )
             return MnistRandomFFT.build(config, train.data, train.labels)
 
         from keystone_tpu.workflow.pipeline import (
@@ -134,15 +121,9 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=2048)
     p.add_argument("--model-path")
-    p.add_argument(
-        "--stream",
-        "--out-of-core",
-        action="store_true",
-        dest="stream",
-        help="re-parse the training CSV per sweep; the exact solver "
-        "accumulates sufficient statistics out-of-core",
-    )
-    p.add_argument("--stream-batch-size", type=int, default=4096)
+    from keystone_tpu.loaders.stream import add_stream_args
+
+    add_stream_args(p, default_batch_size=4096, noun="the training CSV")
     a = p.parse_args(argv)
     cfg = Config(
         a.train_path, a.test_path, a.num_ffts, a.lam, a.seed, a.synthetic_n,

@@ -50,12 +50,7 @@ class TimitPipeline:
 
     @staticmethod
     def build(config: Config, train_x: Dataset, train_labels: Dataset) -> Pipeline:
-        from keystone_tpu.workflow.dataset import StreamDataset
-
-        if isinstance(train_x, StreamDataset):
-            (dim,) = train_x.peek_shape()  # one batch, not the stream
-        else:
-            dim = train_x.array.shape[1]
+        (dim,) = train_x.item_shape  # stream-safe (peeks one batch)
         num_blocks = max(1, config.num_cosine_features // config.cosine_block_size)
         branches = [
             Pipeline.of(

@@ -77,6 +77,12 @@ class Dataset:
         """Unpadded host copy."""
         return np.asarray(self.array)[: self.n]
 
+    @property
+    def item_shape(self) -> tuple:
+        """Per-item shape — StreamDataset overrides via peek_shape so
+        pipelines can derive feature dims without materializing."""
+        return tuple(self.array.shape[1:])
+
     def __len__(self) -> int:
         return self.n
 
@@ -226,11 +232,15 @@ class StreamDataset(Dataset):
         stream (costs one batch's host work on first call)."""
         if not hasattr(self, "_peek_shape"):
             for arr, _ in self._gen():
-                self._peek_shape = tuple(arr.shape[1:])
+                self._peek_shape = tuple(np.shape(arr)[1:])
                 break
             else:
                 raise ValueError("empty stream")
         return self._peek_shape
+
+    @property
+    def item_shape(self) -> tuple:
+        return self.peek_shape()
 
     def batches(self):
         """Iterate host batches of the mapped values (numpy for device

@@ -537,3 +537,36 @@ def test_timit_stream_csv_features(tmp_path, mesh):
     np.testing.assert_allclose(
         np.concatenate(list(st.data.batches())), mem.data.numpy(), rtol=1e-5
     )
+
+
+def test_linear_pixels_app_stream_matches_inmemory(tmp_path, mesh):
+    """LinearPixels --stream: CIFAR records re-read per sweep through
+    ImageVectorizer into the exact solver's streaming fit."""
+    from keystone_tpu.loaders.cifar import RECORD
+    from keystone_tpu.pipelines.linear_pixels import Config, LinearPixels
+
+    def write_records(path, n, seed):
+        r = np.random.default_rng(seed)
+        recs = r.integers(0, 255, size=(n, RECORD)).astype(np.uint8)
+        recs[:, 0] = r.integers(0, 10, size=n)
+        # class-dependent brightness so the baseline is learnable
+        recs[:, 1:] = np.clip(
+            recs[:, 1:] // 4 + recs[:, :1] * 20, 0, 255
+        ).astype(np.uint8)
+        recs.tofile(path)
+        return path
+
+    train_bin = write_records(str(tmp_path / "train.bin"), 160, 1)
+    test_bin = write_records(str(tmp_path / "test.bin"), 48, 2)
+    base = dict(train_path=train_bin, test_path=test_bin, lam=1e-3)
+    out_stream = LinearPixels.run(
+        Config(**base, stream=True, stream_batch_size=32)
+    )
+    out_mem = LinearPixels.run(Config(**base))
+    assert abs(out_stream["accuracy"] - out_mem["accuracy"]) < 0.03, (
+        out_stream["accuracy"],
+        out_mem["accuracy"],
+    )
+    # --stream without --test-path must refuse rather than eagerly load
+    with pytest.raises(ValueError, match="test-path"):
+        LinearPixels.run(Config(train_path=train_bin, stream=True))
