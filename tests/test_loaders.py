@@ -354,3 +354,72 @@ def test_stream_dataset_prefetch_param(mesh):
     # re-iterable through the prefetch wrapper too
     out2 = np.concatenate(list(ds.batches()))
     np.testing.assert_allclose(out2, data)
+
+
+def test_stream_app_helpers(mesh):
+    """The shared --stream app plumbing: guard, 4-way source selection,
+    argparse block."""
+    import argparse
+    import dataclasses
+
+    from keystone_tpu.loaders.labeled import LabeledData
+    from keystone_tpu.loaders.stream import (
+        add_stream_args,
+        require_stream_test_path,
+        resolve_train_source,
+        stream_labeled,
+    )
+    from keystone_tpu.workflow import Dataset, StreamDataset
+
+    @dataclasses.dataclass
+    class Cfg:
+        train_path: str = None
+        test_path: str = None
+        stream: bool = False
+        stream_batch_size: int = 8
+
+    # guard fires only for stream+train without test
+    require_stream_test_path(Cfg())
+    require_stream_test_path(Cfg(train_path="x", test_path="y", stream=True))
+    with pytest.raises(ValueError, match="test-path"):
+        require_stream_test_path(Cfg(train_path="x", stream=True))
+
+    calls = []
+    synth = LabeledData(
+        Dataset(np.arange(12, dtype=np.float32).reshape(6, 2)),
+        Dataset(np.arange(6, dtype=np.int32)),
+    )
+
+    def load(p):
+        calls.append(("load", p))
+        return synth
+
+    def stream(p, batch_size):
+        calls.append(("stream", p, batch_size))
+        return synth
+
+    out = resolve_train_source(
+        Cfg(train_path="t", stream=True), load, stream, lambda: synth
+    )
+    assert calls[-1] == ("stream", "t", 8) and out is synth
+    out = resolve_train_source(Cfg(train_path="t"), load, stream, lambda: synth)
+    assert calls[-1] == ("load", "t")
+    out = resolve_train_source(Cfg(stream=True), load, stream, lambda: synth)
+    assert isinstance(out.data, StreamDataset)  # synthetic-as-stream
+    np.testing.assert_allclose(
+        np.concatenate(list(out.data.batches())), synth.data.numpy()
+    )
+    out = resolve_train_source(Cfg(), load, stream, lambda: synth)
+    assert out is synth
+
+    p = argparse.ArgumentParser()
+    add_stream_args(p, default_batch_size=77, noun="things")
+    a = p.parse_args(["--out-of-core"])
+    assert a.stream and a.stream_batch_size == 77
+
+    # stream_labeled preserves n and labels
+    wrapped = stream_labeled(synth, batch_size=4)
+    assert wrapped.data.n == 6 and wrapped.labels is synth.labels
+    # item_shape: stream-safe dim derivation
+    assert wrapped.data.item_shape == (2,)
+    assert synth.data.item_shape == (2,)
