@@ -60,21 +60,56 @@ class BlockKernelMatrix:
         valid for THIS (data, kernel, blocking) triple: a reused cache
         dir from a different fit would silently serve a different
         problem's kernel matrix, so the dir carries a content
-        fingerprint and is cleared on mismatch."""
+        fingerprint.  On mismatch only files this cache owns
+        (``kcol_*.npy`` + ``kcache_meta.json``) are removed; a directory
+        holding anything else is refused rather than clobbered.
+
+        Concurrency contract: multiple processes may share a spill dir
+        only for the SAME problem (same fingerprint — the pid-suffixed
+        temp + ``os.replace`` writers in :meth:`_column_via_disk` make
+        that safe).  Concurrent fits of *different* problems must use
+        distinct dirs: this init clears on mismatch without a lock."""
         import hashlib
         import json
         import os
-        import shutil
 
         import numpy as np
 
         probe = hashlib.sha256()
+        # the kernel identity is the generator's type + ALL its scalar
+        # parameters, not just gamma: a different generator reusing the
+        # dir must not pass validation.  Collected explicitly (dataclass
+        # fields, else public scalar attrs incl. class-level defaults) —
+        # default object repr is id-based and would break cross-process
+        # reuse of the spill dir
+        import dataclasses as _dc
+
+        kg = self.kernel_gen
+        if _dc.is_dataclass(kg):
+            kern_params = tuple(sorted(_dc.asdict(kg).items()))
+        else:
+            import numbers
+
+            kp = {}
+            for src in (vars(type(kg)), getattr(kg, "__dict__", {})):
+                for pk, pv in src.items():
+                    if pk.startswith("_"):
+                        continue
+                    if isinstance(pv, (str, tuple)):
+                        kp[pk] = pv
+                    elif isinstance(pv, numbers.Number):
+                        # coerce so np.float32(0.1) and 0.1 fingerprint
+                        # identically — and so numpy scalars are not
+                        # silently EXCLUDED from the kernel identity
+                        kp[pk] = float(pv)
+            kern_params = tuple(sorted(kp.items()))
         probe.update(
             repr(
                 (
                     self.n,
                     self.block_size,
-                    float(getattr(self.kernel_gen, "gamma", 0.0)),
+                    type(kg).__name__,
+                    kern_params,
                     tuple(self.x.shape),
                 )
             ).encode()
@@ -91,7 +126,31 @@ class BlockKernelMatrix:
                         return  # reusable: same problem
             except Exception:
                 pass
-            shutil.rmtree(spill_dir, ignore_errors=True)
+            entries = os.listdir(spill_dir)
+            owned = [
+                e
+                for e in entries
+                if e == "kcache_meta.json"
+                or (e.startswith("kcol_") and e.endswith(".npy"))
+            ]
+            # dotfiles (.nfsXXXX silly-renames, .DS_Store) are OS
+            # artifacts, not user data: left alone, never grounds for
+            # refusing an otherwise-dedicated cache dir
+            foreign = [
+                e for e in entries if e not in owned and not e.startswith(".")
+            ]
+            if foreign:
+                raise ValueError(
+                    f"kernel spill dir {spill_dir!r} (kernel_cache_dir at the "
+                    f"estimator level) holds files this cache does not own "
+                    f"({foreign[:5]}{'...' if len(foreign) > 5 else ''}); "
+                    "refusing to clear it — pass an empty or dedicated directory"
+                )
+            for e in owned:
+                # a surviving stale kcol under a fresh fingerprint would
+                # be trusted by _column_via_disk — failed removal must
+                # abort, not degrade to silent cache corruption
+                os.remove(os.path.join(spill_dir, e))
         os.makedirs(spill_dir, exist_ok=True)
         with open(meta_path, "w") as f:
             json.dump({"fingerprint": fingerprint}, f)

@@ -335,13 +335,23 @@ class ColumnSampler(Transformer):
         self.seed = int(seed)
 
     def params(self):
-        return (self.num_samples, self.seed)
+        # "fold_in-v1" versions the per-item key derivation (fold_in of
+        # the global index, batching-invariant); bumping it invalidates
+        # saved-state/CSE matches from the pre-fold_in derivation, whose
+        # output differs for the same (num_samples, seed)
+        return (self.num_samples, self.seed, "fold_in-v1")
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
         from keystone_tpu.workflow.dataset import StreamDataset
 
         key = jax.random.PRNGKey(self.seed)
         if isinstance(ds, StreamDataset):
+            if ds.is_host:
+                raise TypeError(
+                    "ColumnSampler stream path needs device descriptor "
+                    "batches, but this StreamDataset carries host "
+                    "objects. Featurize to arrays first."
+                )
             # Out-of-core path: sample each descriptor batch as it
             # streams past and keep only the (small) samples.  Keys are
             # derived from the GLOBAL item index, so the sample is

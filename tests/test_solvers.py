@@ -477,3 +477,65 @@ def test_krr_cached_disk_tier_matches_recompute(monkeypatch, tmp_path):
     np.testing.assert_allclose(
         np.asarray(cached2.alpha), np.asarray(ref2.alpha), atol=2e-4
     )
+
+
+def test_kernel_spill_dir_refuses_foreign_files(tmp_path):
+    """A stale cache dir is cleared file-by-file (only kcol_*.npy +
+    kcache_meta.json); a dir holding ANYTHING else is refused, never
+    rmtree'd (ADVICE r3 medium: data-loss hazard on a reused user
+    directory)."""
+    import os
+
+    from keystone_tpu.models.kernel_matrix import BlockKernelMatrix
+    from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    kern = GaussianKernelGenerator(gamma=0.1)
+
+    d = tmp_path / "user_dir"
+    d.mkdir()
+    (d / "precious.txt").write_text("do not delete")
+    with pytest.raises(ValueError, match="does not own"):
+        BlockKernelMatrix(kern, x, block_size=16, spill_dir=str(d))
+    assert (d / "precious.txt").read_text() == "do not delete"
+
+    # a dir holding ONLY cache-owned files from a stale fit is cleared
+    # per-file and reused
+    d2 = tmp_path / "stale"
+    d2.mkdir()
+    (d2 / "kcol_00000.npy").write_bytes(b"stale")
+    (d2 / "kcache_meta.json").write_text("{}")
+    BlockKernelMatrix(kern, x, block_size=16, spill_dir=str(d2))
+    assert not (d2 / "kcol_00000.npy").exists()
+    assert (d2 / "kcache_meta.json").exists()
+
+    # the fingerprint keys the FULL kernel identity: same gamma attr on
+    # a different generator type must invalidate, not pass validation
+    class OtherKernel:
+        gamma = 0.1
+
+        def __call__(self, a, b):  # pragma: no cover - never sampled
+            return np.zeros((a.shape[0], b.shape[0]), np.float32)
+
+    BlockKernelMatrix(OtherKernel(), x, block_size=16, spill_dir=str(d2))
+    import json
+
+    # the fingerprint must be STABLE across instances (no id-based
+    # default repr leaking in) — a fresh instance of the same plain
+    # class must validate, not clear, the dir
+    meta1 = json.load(open(d2 / "kcache_meta.json"))
+    BlockKernelMatrix(OtherKernel(), x, block_size=16, spill_dir=str(d2))
+    assert json.load(open(d2 / "kcache_meta.json")) == meta1
+
+    # OS dotfile artifacts (.nfsXXXX, .DS_Store) are tolerated, not
+    # treated as foreign user data
+    (d2 / ".nfs0000deadbeef").write_bytes(b"")
+    BlockKernelMatrix(kern, x, block_size=16, spill_dir=str(d2))
+    assert (d2 / ".nfs0000deadbeef").exists()
+
+    # and re-instantiating with the original generator re-fingerprints
+    # (round-trip sanity: validation is on content, not mtime)
+    assert meta1["fingerprint"] != json.load(
+        open(d2 / "kcache_meta.json")
+    )["fingerprint"]

@@ -143,6 +143,17 @@ def test_column_sampler_stream_matches_inmemory(mesh):
     np.testing.assert_allclose(st.numpy(), mem.numpy(), rtol=1e-6)
 
 
+def test_column_sampler_host_stream_raises_typeerror(mesh):
+    """A host-payload stream (text docs) must fail with the descriptive
+    'featurize first' TypeError, not an AttributeError on list.ndim
+    (ADVICE r3 low)."""
+    from keystone_tpu.ops import ColumnSampler
+
+    host = StreamDataset([["a doc", "b doc"]], n=2, host=True)
+    with pytest.raises(TypeError, match="[Ff]eaturize to arrays"):
+        ColumnSampler(4, seed=0).apply_dataset(host)
+
+
 # ------------------------------------------------- end-to-end app parity
 
 
