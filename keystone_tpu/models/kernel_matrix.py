@@ -83,26 +83,44 @@ class BlockKernelMatrix:
         # default object repr is id-based and would break cross-process
         # reuse of the spill dir
         import dataclasses as _dc
+        import numbers
 
         kg = self.kernel_gen
         if _dc.is_dataclass(kg):
-            kern_params = tuple(sorted(_dc.asdict(kg).items()))
+            raw = _dc.asdict(kg)
+            strict = True  # every declared field IS a kernel parameter
         else:
-            import numbers
-
-            kp = {}
-            for src in (vars(type(kg)), getattr(kg, "__dict__", {})):
-                for pk, pv in src.items():
-                    if pk.startswith("_"):
-                        continue
-                    if isinstance(pv, (str, tuple)):
-                        kp[pk] = pv
-                    elif isinstance(pv, numbers.Number):
-                        # coerce so np.float32(0.1) and 0.1 fingerprint
-                        # identically — and so numpy scalars are not
-                        # silently EXCLUDED from the kernel identity
-                        kp[pk] = float(pv)
-            kern_params = tuple(sorted(kp.items()))
+            raw = {}
+            # reversed MRO so leaf-class overrides win over base-class
+            # defaults; instance attrs win over both
+            for klass in reversed(type(kg).__mro__):
+                for pk, pv in vars(klass).items():
+                    if not pk.startswith("_") and not callable(pv):
+                        raw[pk] = pv
+            for pk, pv in getattr(kg, "__dict__", {}).items():
+                if not pk.startswith("_"):
+                    raw[pk] = pv
+            strict = False  # duck-typed attrs may include non-params
+        kp = {}
+        for pk, pv in raw.items():
+            if isinstance(pv, (str, tuple)):
+                kp[pk] = pv
+            elif isinstance(pv, numbers.Number):
+                # coerce THROUGH f32: the device computes the kernel in
+                # f32, so np.float32(0.02) and 0.02 are the same kernel
+                # even though float(np.float32(0.02)) != 0.02 — and numpy
+                # scalars must not be silently EXCLUDED from the identity
+                kp[pk] = float(np.float32(pv))
+            elif strict:
+                # silently dropping a declared field would let two
+                # different kernels fingerprint identically — refuse
+                raise TypeError(
+                    f"kernel generator field {pk!r} ({type(pv).__name__}) "
+                    "cannot be fingerprinted for the spill dir; use "
+                    "scalar/str/tuple fields or manage the cache dir "
+                    "per problem"
+                )
+        kern_params = tuple(sorted(kp.items()))
         probe.update(
             repr(
                 (

@@ -92,6 +92,22 @@ class KMeansPlusPlusEstimator(Estimator):
         )
 
 
+def _row_at(x, idx):
+    """``x[idx]`` for row-sharded x WITHOUT gathering x: a one-hot
+    contraction over the sharded row axis, which XLA lowers to an
+    all-reduce of one (d,) row — O(d) on the interconnect where a
+    dynamic_slice on sharded rows all-gathers the full (n, d) matrix
+    (caught by tests/test_sharding_gate.py).  Exact: every non-selected
+    term is 0.0, and the pass is solver-grade so the selected row is not
+    bf16-truncated."""
+    from keystone_tpu.utils.precision import sdot
+
+    onehot = constrain(
+        (jnp.arange(x.shape[0]) == idx).astype(x.dtype), DATA_AXIS
+    )
+    return constrain(sdot(onehot, x))
+
+
 @partial(jax.jit, static_argnames=("k", "iters"))
 def _kmeans_fit(x, row_ok, k, iters, key):
     """row_ok: (n_rows,) 1.0 for real rows, 0.0 for padding/invalid."""
@@ -101,7 +117,7 @@ def _kmeans_fit(x, row_ok, k, iters, key):
     # --- k-means++ seeding: sample propto min squared distance ---
     key, k0 = jax.random.split(key)
     first = jax.random.categorical(k0, jnp.log(row_ok + 1e-30))
-    centers0 = jnp.zeros((k, x.shape[1]), jnp.float32).at[0].set(x[first])
+    centers0 = jnp.zeros((k, x.shape[1]), jnp.float32).at[0].set(_row_at(x, first))
 
     def seed_step(i, carry):
         centers, key = carry
@@ -111,7 +127,7 @@ def _kmeans_fit(x, row_ok, k, iters, key):
         d = jnp.maximum(jnp.min(dists, axis=1), 0.0) * row_ok
         key, sk = jax.random.split(key)
         idx = jax.random.categorical(sk, jnp.log(d + 1e-30))
-        return centers.at[i].set(x[idx]), key
+        return centers.at[i].set(_row_at(x, idx)), key
 
     centers, key = lax.fori_loop(1, k, seed_step, (centers0, key))
 
