@@ -90,15 +90,23 @@ class BlockKernelMatrix:
             raw = _dc.asdict(kg)
             strict = True  # every declared field IS a kernel parameter
         else:
+            # dir() + getattr: covers instance attrs, class-level
+            # defaults anywhere in the MRO, AND property-backed params
+            # (a vars() scan silently drops properties — two kernels
+            # differing only in a property value must not fingerprint
+            # identically)
             raw = {}
-            # reversed MRO so leaf-class overrides win over base-class
-            # defaults; instance attrs win over both
-            for klass in reversed(type(kg).__mro__):
-                for pk, pv in vars(klass).items():
-                    if not pk.startswith("_") and not callable(pv):
-                        raw[pk] = pv
+            for pk in dir(type(kg)):
+                if pk.startswith("_"):
+                    continue
+                try:
+                    pv = getattr(kg, pk)
+                except Exception:
+                    continue
+                if not callable(pv):
+                    raw[pk] = pv
             for pk, pv in getattr(kg, "__dict__", {}).items():
-                if not pk.startswith("_"):
+                if not pk.startswith("_") and not callable(pv):
                     raw[pk] = pv
             strict = False  # duck-typed attrs may include non-params
         kp = {}
