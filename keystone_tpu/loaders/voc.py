@@ -7,7 +7,7 @@ from __future__ import annotations
 import os
 import tarfile
 import xml.etree.ElementTree as ET
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -24,13 +24,30 @@ NUM_CLASSES = len(VOC_CLASSES)
 
 class VOCLoader:
     @staticmethod
+    def index(
+        images_dir: str, annotations_dir: str
+    ) -> Tuple[List[str], List[np.ndarray]]:
+        """The cheap XML pass: (jpg paths, multilabels) in sorted
+        annotation order.  Callers doing a train/test index split pass
+        the result back to :meth:`load`/:meth:`stream` via ``index=`` so
+        the directory is parsed exactly once."""
+        return _index(images_dir, annotations_dir)
+
+    @staticmethod
     def load(
         images_dir: str,
         annotations_dir: str,
         size: Tuple[int, int] = (256, 256),
         limit: Optional[int] = None,
+        indices: Optional[Sequence[int]] = None,
+        index=None,
     ) -> LabeledData:
-        paths, labels = _index(images_dir, annotations_dir)
+        paths, labels = index if index is not None else _index(
+            images_dir, annotations_dir
+        )
+        if indices is not None:
+            paths = [paths[i] for i in indices]
+            labels = [labels[i] for i in indices]
         if limit is not None:
             paths, labels = paths[:limit], labels[:limit]
         x = (
@@ -39,9 +56,12 @@ class VOCLoader:
             else np.zeros((0, *size, 3), np.uint8)
         )
         y = np.stack(labels) if labels else np.zeros((0, NUM_CLASSES), np.float32)
+        # the subset is part of the dataset IDENTITY: names feed CSE and
+        # saved-state keys, and two subsets of one directory must never
+        # alias (stream() carries the same tag)
         name = (
             f"voc:{os.path.abspath(images_dir)}:{os.path.abspath(annotations_dir)}"
-            f":{size[0]}x{size[1]}:lim{limit}"
+            f":{size[0]}x{size[1]}:lim{limit}{_idx_tag(indices, len(paths))}"
         )
         return LabeledData(
             Dataset(x, name=name), Dataset(y, name=name + "-labels")
@@ -54,13 +74,23 @@ class VOCLoader:
         size: Tuple[int, int] = (256, 256),
         batch_size: int = 64,
         prefetch: int = 2,
+        indices: Optional[Sequence[int]] = None,
+        index=None,
     ) -> LabeledData:
         """Out-of-core loader: one cheap XML pass fixes the file list and
         multilabels; JPEGs re-decode from disk in ``batch_size`` chunks
-        per sweep on a prefetch thread."""
+        per sweep on a prefetch thread.  ``indices`` selects a subset of
+        the sorted annotation order (the app's train/test split streams
+        the train rows while the eager test load takes the complement);
+        ``index`` reuses a precomputed :meth:`index` result."""
         from keystone_tpu.workflow.dataset import StreamDataset
 
-        paths, labels = _index(images_dir, annotations_dir)
+        paths, labels = index if index is not None else _index(
+            images_dir, annotations_dir
+        )
+        if indices is not None:
+            paths = [paths[i] for i in indices]
+            labels = [labels[i] for i in indices]
         n = len(paths)
 
         def batches():
@@ -70,7 +100,7 @@ class VOCLoader:
         name = (
             f"voc-stream:{os.path.abspath(images_dir)}"
             f":{os.path.abspath(annotations_dir)}:{size[0]}x{size[1]}"
-            f":b{batch_size}"
+            f":b{batch_size}{_idx_tag(indices, n)}"
         )
         y = (
             np.stack(labels)
@@ -89,18 +119,60 @@ class VOCLoader:
         from keystone_tpu.loaders.imagenet import ImageNetLoader
 
         base = ImageNetLoader.synthetic(n=n, num_classes=NUM_CLASSES, size=size, seed=seed)
-        single = base.labels.numpy()
-        multi = np.zeros((n, NUM_CLASSES), np.float32)
-        multi[np.arange(n), single] = 1.0
-        # occasionally add a second label, as VOC images are multilabel
-        rng = np.random.default_rng(seed + 1)
-        extra = rng.integers(0, NUM_CLASSES, size=n)
-        mask = rng.random(n) < 0.3
-        multi[np.arange(n)[mask], extra[mask]] = 1.0
+        multi = _synthetic_multilabels(base.labels.numpy(), n, seed)
         return LabeledData(
             base.data,
             Dataset(multi, name=f"voc-synth-multilabels-n{n}-s{seed}"),
         )
+
+    @staticmethod
+    def synthetic_stream(
+        n: int = 48,
+        size: Tuple[int, int] = (64, 64),
+        seed: int = 0,
+        batch_size: int = 32,
+        prefetch: int = 2,
+    ) -> LabeledData:
+        """Streaming variant of :meth:`synthetic` — pixel- and
+        label-identical to it for the same (n, size, seed); images
+        materialize ``batch_size`` at a time (the stream==in-memory
+        parity convention every loader follows)."""
+        from keystone_tpu.loaders.imagenet import ImageNetLoader
+
+        base = ImageNetLoader.synthetic_stream(
+            n=n,
+            num_classes=NUM_CLASSES,
+            size=size,
+            seed=seed,
+            batch_size=batch_size,
+            prefetch=prefetch,
+        )
+        multi = _synthetic_multilabels(base.labels.numpy(), n, seed)
+        return LabeledData(
+            base.data,
+            Dataset(multi, name=f"voc-synth-stream-multilabels-n{n}-s{seed}"),
+        )
+
+
+def _idx_tag(indices, n: int) -> str:
+    """Subset identity tag for Dataset names.  ``hash`` on an int tuple
+    is deterministic across processes (no PYTHONHASHSEED effect)."""
+    if indices is None:
+        return ""
+    return f":idx{n}-{hash(tuple(int(i) for i in indices)) & 0xFFFFFFFF:08x}"
+
+
+def _synthetic_multilabels(single: np.ndarray, n: int, seed: int) -> np.ndarray:
+    """Multilabels from per-image class ids, shared by synthetic() and
+    synthetic_stream() so the two are label-identical."""
+    multi = np.zeros((n, NUM_CLASSES), np.float32)
+    multi[np.arange(n), single] = 1.0
+    # occasionally add a second label, as VOC images are multilabel
+    rng = np.random.default_rng(seed + 1)
+    extra = rng.integers(0, NUM_CLASSES, size=n)
+    mask = rng.random(n) < 0.3
+    multi[np.arange(n)[mask], extra[mask]] = 1.0
+    return multi
 
 
 def _index(

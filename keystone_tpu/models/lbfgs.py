@@ -229,7 +229,14 @@ def lbfgs_minimize_resumable(
         loaded = load_cb()
         if loaded is not None:
             start, host_carry = loaded
-            carry = tuple(jnp.asarray(a) for a in host_carry)
+            if start > max_iter:
+                # a COMPLETED longer fit's checkpoint: resuming would
+                # silently return more-iterated weights for a shorter
+                # requested fit — refit from scratch instead (start ==
+                # max_iter is fine: same fit re-requested, reuse it)
+                start, host_carry = 0, None
+            if host_carry is not None:
+                carry = tuple(jnp.asarray(a) for a in host_carry)
     if carry is None:
         start = 0
         carry = jax.jit(init)(data, jnp.asarray(x0).reshape(-1))

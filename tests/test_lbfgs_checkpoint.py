@@ -138,6 +138,29 @@ def test_checkpoint_rejected_for_different_problem(tmp_path, mesh):
     )
 
 
+def test_completed_checkpoint_not_reused_for_shorter_fit(tmp_path, mesh):
+    """A completed 16-iteration fit leaves its carry on disk; a later
+    8-iteration request on the same problem must refit from scratch,
+    never silently return the more-iterated weights."""
+    x, y = _dense_problem()
+    long = DenseLBFGSwithL2(lam=1e-3, num_iterations=16, history=4)
+    long_model = long.fit_checkpointed(
+        Dataset(x), Dataset(y), checkpoint_dir=str(tmp_path), checkpoint_every=4
+    )
+    short = DenseLBFGSwithL2(lam=1e-3, num_iterations=8, history=4)
+    fresh = short.fit_dataset(Dataset(x), Dataset(y))
+    got = short.fit_checkpointed(
+        Dataset(x), Dataset(y), checkpoint_dir=str(tmp_path), checkpoint_every=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.weights), np.asarray(fresh.weights), atol=2e-4
+    )
+    # and the 8-iter weights genuinely differ from the 16-iter ones
+    assert np.abs(
+        np.asarray(got.weights) - np.asarray(long_model.weights)
+    ).max() > 1e-6
+
+
 def test_sparse_checkpointed_vocab_scale_resumes(tmp_path, mesh):
     """Sparse path at vocab scale (d=50k here; the pattern is the 1M
     fit): interrupted fit resumes from the saved carry and matches the

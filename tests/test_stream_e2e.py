@@ -245,6 +245,59 @@ def test_imagenet_fv_app_from_tar_fixture_stream(tmp_path, mesh):
     assert out["accuracy"] > 0.9
 
 
+def test_voc_synthetic_stream_matches_synthetic(mesh):
+    """Loader-level: VOC's synthetic stream is pixel- and label-identical
+    to the in-memory synthetic set (the parity convention every loader
+    follows)."""
+    from keystone_tpu.loaders.voc import VOCLoader
+
+    mem = VOCLoader.synthetic(18, size=(48, 48), seed=1)
+    st = VOCLoader.synthetic_stream(18, size=(48, 48), seed=1, batch_size=5)
+    np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
+    np.testing.assert_array_equal(
+        np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
+
+
+def test_voc_app_stream_matches_inmemory(mesh):
+    """VOCSIFTFisher --stream (the last of the eight apps, VERDICT r3
+    weak-4): the streamed fit produces the in-memory fit's scores."""
+    from keystone_tpu.pipelines.voc_sift_fisher import Config, VOCSIFTFisher
+
+    base = dict(
+        synthetic_n=18,
+        image_size=48,
+        gmm_k=4,
+        pca_dims=16,
+        descriptor_samples_per_image=16,
+        solver_block_size=64,
+        num_epochs=2,
+    )
+    out_mem = VOCSIFTFisher.run(Config(**base))
+    out_st = VOCSIFTFisher.run(
+        Config(**base, stream=True, stream_batch_size=5)
+    )
+    assert out_st["pipeline"] == "VOCSIFTFisher"
+    # identical training pixels + deterministic fit → identical mAP
+    np.testing.assert_allclose(out_st["mean_ap"], out_mem["mean_ap"], atol=1e-6)
+
+
+def test_imagenet_augmented_eval_composes_with_stream(mesh):
+    """--augmented-eval × --stream (VERDICT r3 next-6): the 10-view
+    augmented evaluation must run against a model fit from the streamed
+    loader, matching the in-memory augmented run."""
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetSiftLcsFV
+
+    out_mem = ImageNetSiftLcsFV.run(_fv_config(stream=False, augmented_eval=True))
+    out_st = ImageNetSiftLcsFV.run(_fv_config(stream=True, augmented_eval=True))
+    np.testing.assert_allclose(
+        out_st["top5_error"], out_mem["top5_error"], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        out_st["accuracy"], out_mem["accuracy"], atol=1e-6
+    )
+
+
 def test_timit_app_stream_matches_inmemory(mesh):
     from keystone_tpu.pipelines.timit import Config, TimitPipeline
 
@@ -494,6 +547,22 @@ def test_voc_stream_matches_load(tmp_path, mesh):
     np.testing.assert_array_equal(st.labels.numpy(), mem.labels.numpy())
     np.testing.assert_array_equal(
         np.concatenate(list(st.data.batches())), mem.data.numpy()
+    )
+
+    # index-subset loads: rows/labels follow the subset, and the Dataset
+    # NAMES are distinct per subset — names feed CSE/saved-state keys,
+    # so train/test subsets of one directory must never alias
+    idx = VOCLoader.index(img_dir, ann_dir)
+    a = VOCLoader.load(img_dir, ann_dir, size=(48, 48), indices=[0, 2, 4], index=idx)
+    b = VOCLoader.load(img_dir, ann_dir, size=(48, 48), indices=[1, 3], index=idx)
+    np.testing.assert_array_equal(a.data.numpy(), mem.data.numpy()[[0, 2, 4]])
+    np.testing.assert_array_equal(b.labels.numpy(), mem.labels.numpy()[[1, 3]])
+    assert a.data.name != b.data.name != mem.data.name
+    sa = VOCLoader.stream(
+        img_dir, ann_dir, size=(48, 48), batch_size=2, indices=[0, 2, 4], index=idx
+    )
+    np.testing.assert_array_equal(
+        np.concatenate(list(sa.data.batches())), mem.data.numpy()[[0, 2, 4]]
     )
 
 
