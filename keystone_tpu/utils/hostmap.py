@@ -1,0 +1,163 @@
+"""Parallel host-side per-item maps — the text analogue of the native
+threaded JPEG decode tier (``ks_decode_jpegs``).
+
+The host text stage (tokenize → n-gram → tf → featurize) is pure
+Python, so THREADS cannot parallelize it — the GIL serializes them;
+libjpeg could use threads only because C decode releases the GIL.
+Workers here are processes, with two deliberate choices:
+
+- **forkserver start method** (spawn fallback): plain ``fork`` from a
+  jax-threaded parent is a documented deadlock hazard (jax's runtime
+  threads hold locks across the fork).  The forkserver's server process
+  is fresh and this module imports nothing heavy, so workers never
+  inherit jax state; jax only enters a worker if the mapped callable's
+  module imports it during unpickling (import only — no backend init,
+  no tunnel contact).
+- **one PERSISTENT pool per process**, not a pool per call: streaming
+  sweeps call host_map once per stage per batch, and per-call pools
+  would pay worker startup (python + module imports) thousands of
+  times.  Tasks carry the pickled callable each time (cheap for
+  tokenizers; ~MBs for a vocab model, amortized against ~100x more
+  batch work) and workers cache the unpickled callable by digest.
+
+Sizing: ``KEYSTONE_HOST_WORKERS`` overrides; default is the CPU count.
+With 1 worker (or small inputs, or an unpicklable callable) the map is
+plain sequential — zero overhead on single-core hosts.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import os
+import pickle
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+_EXECUTOR = None
+#: host_map is called from stream prefetch threads as well as the main
+#: thread; the lock keeps two racing callers from each building (and
+#: one orphaning) a worker pool
+_EXECUTOR_LOCK = threading.Lock()
+_POOL_WARNED = False
+
+#: worker-side: digest → unpickled callable (so the vocab model
+#: unpickles once per worker, not once per batch).  Bounded: a sweep of
+#: many fitted models must not grow worker RSS without limit.
+_FN_CACHE: Dict[bytes, Callable] = {}
+_FN_CACHE_CAP = 8
+
+
+def host_workers() -> int:
+    env = os.environ.get("KEYSTONE_HOST_WORKERS")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KEYSTONE_HOST_WORKERS=%r is not an integer; using 1", env
+            )
+            return 1
+    return os.cpu_count() or 1
+
+
+def _run_task(digest: bytes, fn_bytes: bytes, chunk: list) -> list:
+    fn = _FN_CACHE.get(digest)
+    if fn is None:
+        fn = pickle.loads(fn_bytes)
+        while len(_FN_CACHE) >= _FN_CACHE_CAP:
+            _FN_CACHE.pop(next(iter(_FN_CACHE)))  # FIFO eviction
+        _FN_CACHE[digest] = fn
+    return [fn(x) for x in chunk]
+
+
+def _get_executor(workers: int):
+    global _EXECUTOR, _POOL_WARNED
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            methods = mp.get_all_start_methods()
+            method = "forkserver" if "forkserver" in methods else "spawn"
+            try:
+                _EXECUTOR = ProcessPoolExecutor(
+                    max_workers=workers, mp_context=mp.get_context(method)
+                )
+            except Exception:
+                if not _POOL_WARNED:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "host_map worker pool unavailable; mapping "
+                        "sequentially",
+                        exc_info=True,
+                    )
+                    _POOL_WARNED = True
+                return None
+            atexit.register(shutdown)
+        return _EXECUTOR
+
+
+def shutdown() -> None:
+    """Stop the worker pool (idempotent; a later host_map restarts it)."""
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is not None:
+            _EXECUTOR.shutdown(wait=False, cancel_futures=True)
+            _EXECUTOR = None
+
+
+def host_map(
+    fn: Callable,
+    items: Sequence,
+    workers: Optional[int] = None,
+    min_items: int = 512,
+) -> List:
+    """``[fn(x) for x in items]``, parallelized over the persistent
+    worker pool when the input is large enough to amortize task
+    overhead.  Order is preserved; results are identical to the
+    sequential map (pinned by tests/test_hostmap.py).  Falls back to
+    sequential for small inputs, single-core hosts, unpicklable
+    callables, and pool-infrastructure failures.  An exception raised
+    by ``fn`` itself propagates unchanged, exactly as the sequential
+    map would raise it — data errors must not be retried or demoted."""
+    items = items if isinstance(items, list) else list(items)
+    w = host_workers() if workers is None else max(1, int(workers))
+    if w <= 1 or len(items) < max(min_items, 2):
+        return [fn(x) for x in items]
+    try:
+        fn_bytes = pickle.dumps(fn)
+    except Exception:
+        # closures/lambdas: sequential rather than failing the map
+        return [fn(x) for x in items]
+    ex = _get_executor(w)
+    if ex is None:
+        return [fn(x) for x in items]
+    from concurrent.futures.process import BrokenProcessPool
+
+    digest = hashlib.blake2b(fn_bytes, digest_size=16).digest()
+    # ~2 chunks per worker: smooths stragglers without multiplying the
+    # per-task fn_bytes transfer
+    chunk = max(1, -(-len(items) // (w * 2)))
+    chunks = [items[i : i + chunk] for i in range(0, len(items), chunk)]
+    try:
+        futures = [ex.submit(_run_task, digest, fn_bytes, c) for c in chunks]
+        out: List = []
+        for f in futures:
+            out.extend(f.result())
+        return out
+    except BrokenProcessPool:
+        # infrastructure failure (a worker died): this call completes
+        # sequentially; the dead pool is torn down so the NEXT call
+        # builds a fresh one
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "host_map worker pool broke; completing this map "
+            "sequentially and rebuilding the pool on next use"
+        )
+        shutdown()
+        return [fn(x) for x in items]

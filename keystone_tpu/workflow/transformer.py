@@ -42,6 +42,9 @@ class Chainable:
 class Transformer(Chainable):
     #: True for ops that run on host Python objects (e.g. tokenizers).
     is_host: bool = False
+    #: host ops whose per-item work is trivial (a str method) opt OUT of
+    #: the host_map worker pool — IPC would dwarf the work
+    parallel_host: bool = True
 
     @property
     def label(self) -> str:
@@ -81,7 +84,17 @@ class Transformer(Chainable):
                         "carries host objects. Featurize to arrays first."
                     )
                 # host transformer over a host stream: map items lazily,
-                # batch by batch — the raw corpus never materializes
+                # batch by batch — the raw corpus never materializes.
+                # host_map fans large batches over worker processes on
+                # multi-core hosts (raise stream_batch_size to engage
+                # it); small batches, single-core hosts, and trivial ops
+                # (parallel_host=False) map sequentially
+                if self.parallel_host:
+                    from keystone_tpu.utils.hostmap import host_map
+
+                    return ds.map_batches(
+                        lambda batch, _mask: host_map(self.apply_one, batch)
+                    )
                 return ds.map_batches(
                     lambda batch, _mask: [self.apply_one(x) for x in batch]
                 )
@@ -92,7 +105,15 @@ class Transformer(Chainable):
                 )
             return ds.map_batches(self._apply_batch_jitted)
         if ds.is_host or self.is_host:
-            out = [self.apply_one(x) for x in ds.items]
+            if self.is_host and self.parallel_host:
+                # pure-Python host op: worker-pool for large inputs
+                # (device transformers stay sequential — worker
+                # processes must never run device code)
+                from keystone_tpu.utils.hostmap import host_map
+
+                out = host_map(self.apply_one, ds.items)
+            else:
+                out = [self.apply_one(x) for x in ds.items]
             if out and isinstance(out[0], (jnp.ndarray,)) or _stackable(out):
                 try:
                     return ds.with_array(jnp.stack([jnp.asarray(o) for o in out]))
