@@ -26,9 +26,22 @@ execution stream on the axon backend — round-1's 746k ips headline and
 its apparent 2.6× large-batch decay were partly artifacts of that; see
 BASELINE.md "Round-2 re-measurement".
 
+Since r4 the one JSON line also carries the two first-class companion
+metrics the reference's published story is about (VERDICT r3 item 1):
+``fit``        — end-to-end north-star FIT (two-branch featurize →
+                 weighted BCD; synthetic ImageNet config n=2048@128px,
+                 K=64, 64 classes): fit_seconds / fit_images_per_sec
+                 with bands, plus the solver-phase TFLOP/s measured
+                 standalone at the post-featurize shape.
+``multiscale`` — forward throughput at the densest config the
+                 reference ran (vl_phow bins (4,6,8,10) + per-scale
+                 smoothing, T=2520 descriptors/image).
+
 Usage: python bench.py           # TPU (or default backend) + cached CPU leg
        python bench.py --cpu     # CPU-baseline leg only
        python bench.py --sweep   # batch sweep (prints one line per batch)
+       python bench.py --leg-fit # one fit+solver leg (one JSON line)
+       python bench.py --leg-ms  # one multi-scale forward leg
 """
 
 from __future__ import annotations
@@ -50,6 +63,22 @@ NUM_CLASSES = 1000
 WARMUP = 3
 RUN_LENGTHS = (10, 25, 40, 60, 80)
 REPS = 3
+
+# --- multi-scale leg: the densest config the reference's ImageNet
+# pipeline ran (vl_phow bins + per-scale smoothing; SURVEY §2.3,
+# BASELINE.md "Multi-scale reference config") — T=2520 descriptors/image
+MS_BATCH = 64
+MS_BIN_SIZES = (4, 6, 8, 10)
+MS_SMOOTHING = 6.0
+
+# --- fit leg: the end-to-end north-star FIT (two-branch featurize →
+# weighted BCD) on the synthetic ImageNet config BASELINE.md has tracked
+# since r1 (n=2048 at 128px, K=64, 64 classes)
+FIT_N = 2048
+FIT_CLASSES = 64
+FIT_GMM_K = 64
+FIT_EPOCHS = 2
+FIT_SOLVER_BLOCK = 4096
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -68,7 +97,7 @@ _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.j
 _BASELINE_VERSION = 5
 
 
-def build_forward():
+def build_forward(bin_sizes=(4,), smoothing_magnif: float = 0.0):
     import jax.numpy as jnp
 
     from keystone_tpu.models.block_ls import BlockLinearMapper
@@ -83,7 +112,9 @@ def build_forward():
     from keystone_tpu.ops.fisher import FisherVector
 
     rng = np.random.default_rng(0)
-    sift = SIFTExtractor(step=SIFT_STEP, bin_sizes=(4,))
+    sift = SIFTExtractor(
+        step=SIFT_STEP, bin_sizes=bin_sizes, smoothing_magnif=smoothing_magnif
+    )
     pca = PCATransformer(
         jnp.asarray(np.linalg.qr(rng.normal(size=(128, PCA_DIMS)))[0], jnp.float32),
         mean=jnp.zeros((128,), jnp.float32),
@@ -143,10 +174,14 @@ def measure_ips(
     run_lengths=RUN_LENGTHS,
     reps: int = REPS,
     warmup: int = WARMUP,
+    bin_sizes=(4,),
+    smoothing_magnif: float = 0.0,
 ) -> float:
     import jax
 
-    forward = jax.jit(build_forward())
+    forward = jax.jit(
+        build_forward(bin_sizes=bin_sizes, smoothing_magnif=smoothing_magnif)
+    )
     images = np.random.default_rng(1).uniform(
         0, 1, (batch, IMAGE_HW, IMAGE_HW, 3)
     ).astype(np.float32)
@@ -194,6 +229,103 @@ def measure_ips(
             "bench: slope estimator degenerate; reporting sync-dominated mean\n"
         )
     return batch / per_iter
+
+
+def measure_fit() -> dict:
+    """One end-to-end north-star FIT leg: synthetic ImageNet config
+    through the REAL app build (two FV branches with in-graph
+    PCA/GMM vocabulary fits, CSE-merged featurize, weighted BCD solve),
+    honestly blocked at the end.  Data generation happens OUTSIDE the
+    timer — it is loader cost, not fit cost."""
+    import time as _time
+
+    from keystone_tpu.loaders.imagenet import ImageNetLoader
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        Config,
+        ImageNetSiftLcsFV,
+    )
+
+    cfg = Config(
+        num_classes=FIT_CLASSES,
+        synthetic_n=FIT_N,
+        image_size=IMAGE_HW,
+        gmm_k=FIT_GMM_K,
+        pca_dims=PCA_DIMS,
+        num_epochs=FIT_EPOCHS,
+        solver_block_size=FIT_SOLVER_BLOCK,
+    )
+    train = ImageNetLoader.synthetic(
+        FIT_N, FIT_CLASSES, size=(IMAGE_HW, IMAGE_HW), seed=1
+    )
+    from keystone_tpu.workflow import Dataset
+
+    t0 = _time.perf_counter()
+    fitted = (
+        ImageNetSiftLcsFV.build(cfg, train.data, train.labels)
+        .fit()
+        .block_until_ready()
+    )
+    # REAL device→host read as the run-end sync: block_until_ready does
+    # not drain the execution stream on the axon backend, and reading a
+    # prediction forces everything it depends on (the solve included)
+    probe = fitted(Dataset(train.data.array[:1])).get().numpy()
+    assert np.all(np.isfinite(np.asarray(probe, np.float64)))
+    dt = _time.perf_counter() - t0
+    del fitted
+    return {"fit_seconds": dt, "fit_images_per_sec": FIT_N / dt}
+
+
+def solver_flops(n: int, d: int, k: int, bs: int, epochs: int) -> float:
+    """Analytic FLOPs of the weighted-BCD solve (2·MACs): per epoch and
+    block — Gramian AᵀA (2·n·w²), Aᵀtarget (2·n·w·k), the target and
+    residual updates (≈4·n·w·k) — summed over blocks with the LAST
+    block's true width w (not bs: charging a ragged tail as a full
+    block would inflate the reported TFLOP/s); the w×w Cholesky factors
+    are negligible at these shapes."""
+    per_epoch = 0
+    for lo in range(0, d, bs):
+        w = min(bs, d - lo)
+        per_epoch += 2 * n * w * w + 6 * n * w * k
+    return float(epochs * per_epoch)
+
+
+def measure_solver() -> dict:
+    """Solver-phase TFLOP/s: the weighted-BCD fit alone on synthetic
+    features at exactly the north-star post-featurize shape
+    (n=FIT_N, d = two branches × 2·K·D, k=FIT_CLASSES)."""
+    import time as _time
+
+    import jax
+
+    from keystone_tpu.models.block_weighted_ls import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    n, k = FIT_N, FIT_CLASSES
+    d = 2 * (2 * FIT_GMM_K * PCA_DIMS)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = -np.ones((n, k), np.float32)
+    y[np.arange(n), rng.integers(0, k, size=n)] = 1.0
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=FIT_SOLVER_BLOCK,
+        num_iter=FIT_EPOCHS,
+        lam=1e-4,
+        mixture_weight=0.25,
+    )
+    import jax.numpy as jnp
+
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    model = est.fit_arrays(xd, yd)  # warmup leg pays the compile
+    np.asarray(model.flat_weights[:1, :1])
+    t0 = _time.perf_counter()
+    model = est.fit_arrays(xd, yd)
+    # REAL device→host read as the sync (block_until_ready does not
+    # drain the stream on the axon backend)
+    np.asarray(model.flat_weights[:1, :1])
+    dt = _time.perf_counter() - t0
+    tf = solver_flops(n, d, k, FIT_SOLVER_BLOCK, FIT_EPOCHS) / dt / 1e12
+    return {"solver_seconds": dt, "solver_tflops": tf}
 
 
 def cpu_baseline_ips() -> float:
@@ -261,51 +393,118 @@ def main():
         print(json.dumps({"leg_ips": measure_ips(BATCH)}))
         return
 
-    # The headline is a MEDIAN over ≥3 process-level legs, with the
+    if "--leg-ms" in sys.argv:
+        print(
+            json.dumps(
+                {
+                    "leg_ips": measure_ips(
+                        MS_BATCH,
+                        run_lengths=(10, 25, 40),
+                        reps=2,
+                        bin_sizes=MS_BIN_SIZES,
+                        smoothing_magnif=MS_SMOOTHING,
+                    )
+                }
+            )
+        )
+        return
+
+    if "--leg-fit" in sys.argv:
+        out = measure_fit()
+        out.update(measure_solver())
+        print(json.dumps(out))
+        return
+
+    # Every metric is a MEDIAN over ≥3 process-level legs, with the
     # min/max band in the JSON — a single invocation's number can sit
-    # anywhere in a ±25% band (VERDICT r2 item 7).  The first leg runs
-    # in-process (it also pays any compile); later legs ride the
-    # compilation cache.
-    samples = [measure_ips(BATCH)]
-    for _ in range(max(0, N_LEGS - 1)):
+    # anywhere in a ±25% band (VERDICT r2 item 7).  The first leg of
+    # each runs in-process (it also pays any compile); later legs ride
+    # the compilation cache.
+    def subprocess_leg(flag: str):
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--leg"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
             text=True,
             timeout=3600,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         try:
-            line = proc.stdout.strip().splitlines()[-1]
-            samples.append(float(json.loads(line)["leg_ips"]))
+            return json.loads(proc.stdout.strip().splitlines()[-1])
         except Exception:
-            sys.stderr.write(f"bench leg failed: {proc.stderr[-300:]}\n")
+            sys.stderr.write(
+                f"bench leg {flag} failed: {proc.stderr[-300:]}\n"
+            )
+            return None
+
+    def band(vals):
+        return {
+            "min": round(min(vals), 2),
+            "max": round(max(vals), 2),
+            "n_legs": len(vals),
+        }
+
+    samples = [measure_ips(BATCH)]
+    for _ in range(max(0, N_LEGS - 1)):
+        leg = subprocess_leg("--leg")
+        if leg:
+            samples.append(float(leg["leg_ips"]))
     ips = float(np.median(samples))
     tf = ips * flops_per_image() / 1e12
+
+    # fit + multi-scale legs, same band discipline (all subprocess legs:
+    # the in-process device state is already warm from the forward
+    # samples, and a fit leg wants the cold-ish process the driver sees)
+    fit_legs = [lg for lg in (subprocess_leg("--leg-fit") for _ in range(N_LEGS)) if lg]
+    ms_legs = [lg for lg in (subprocess_leg("--leg-ms") for _ in range(N_LEGS)) if lg]
+
     cpu_ips = cpu_baseline_ips()
     vs = ips / cpu_ips if cpu_ips > 0 else None
-    print(
-        json.dumps(
-            {
-                "metric": "imagenet_fv_pipeline_throughput",
-                "value": round(ips, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(vs, 2) if vs else None,
-                "band": {
-                    "min": round(min(samples), 2),
-                    "max": round(max(samples), 2),
-                    "n_legs": len(samples),
-                },
-                "tflops": round(tf, 2),
-                "mfu_f32": round(tf * 1e12 / _f32_peak(), 3),
-                "mfu_bf16_eff": round(tf * 1e12 / _BF16_EFFECTIVE_PEAK, 3),
-                "config": {
-                    "batch": BATCH, "image_hw": IMAGE_HW, "sift_step": SIFT_STEP,
-                    "gmm_k": GMM_K, "pca_dims": PCA_DIMS, "classes": NUM_CLASSES,
-                },
-            }
-        )
-    )
+    out = {
+        "metric": "imagenet_fv_pipeline_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 2) if vs else None,
+        "band": band(samples),
+        "tflops": round(tf, 2),
+        "mfu_f32": round(tf * 1e12 / _f32_peak(), 3),
+        "mfu_bf16_eff": round(tf * 1e12 / _BF16_EFFECTIVE_PEAK, 3),
+        "config": {
+            "batch": BATCH, "image_hw": IMAGE_HW, "sift_step": SIFT_STEP,
+            "gmm_k": GMM_K, "pca_dims": PCA_DIMS, "classes": NUM_CLASSES,
+        },
+    }
+    if fit_legs:
+        fit_s = [float(lg["fit_seconds"]) for lg in fit_legs]
+        out["fit"] = {
+            "fit_seconds": round(float(np.median(fit_s)), 2),
+            "fit_images_per_sec": round(
+                float(np.median([lg["fit_images_per_sec"] for lg in fit_legs])), 1
+            ),
+            "band_seconds": band(fit_s),
+            "solver_tflops": round(
+                float(np.median([lg["solver_tflops"] for lg in fit_legs])), 2
+            ),
+            "solver_band_tflops": band(
+                [float(lg["solver_tflops"]) for lg in fit_legs]
+            ),
+            "config": {
+                "n": FIT_N, "image_hw": IMAGE_HW, "gmm_k": FIT_GMM_K,
+                "classes": FIT_CLASSES, "epochs": FIT_EPOCHS,
+                "solver_block": FIT_SOLVER_BLOCK,
+            },
+        }
+    if ms_legs:
+        ms = [float(lg["leg_ips"]) for lg in ms_legs]
+        out["multiscale"] = {
+            "images_per_sec": round(float(np.median(ms)), 1),
+            "band": band(ms),
+            "config": {
+                "batch": MS_BATCH,
+                "bin_sizes": list(MS_BIN_SIZES),
+                "smoothing_magnif": MS_SMOOTHING,
+            },
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

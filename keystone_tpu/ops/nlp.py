@@ -254,7 +254,7 @@ def _csr_row(cols, vals, num_features: int):
 #: bounds memory on adversarial vocabularies — once full, new terms hash
 #: uncached (the hot head is already resident).
 _TERM_HASH_MEMO: Dict = {}
-_TERM_HASH_MEMO_CAP = 1 << 20
+_TERM_HASH_MEMO_CAP = 1 << 17
 
 
 def stable_term_hash(term) -> int:

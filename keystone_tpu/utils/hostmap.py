@@ -39,6 +39,7 @@ _EXECUTOR = None
 #: thread; the lock keeps two racing callers from each building (and
 #: one orphaning) a worker pool
 _EXECUTOR_LOCK = threading.Lock()
+_EXECUTOR_WORKERS = 0
 _POOL_WARNED = False
 
 #: worker-side: digest → unpickled callable (so the vocab model
@@ -74,7 +75,11 @@ def _run_task(digest: bytes, fn_bytes: bytes, chunk: list) -> list:
 
 
 def _get_executor(workers: int):
-    global _EXECUTOR, _POOL_WARNED
+    """(executor, actual_worker_count) — or (None, 0) when unavailable.
+    The pool is created ONCE per process; a later caller requesting a
+    different size reuses the existing pool (logged once) rather than
+    churning worker startup."""
+    global _EXECUTOR, _EXECUTOR_WORKERS, _POOL_WARNED
     with _EXECUTOR_LOCK:
         if _EXECUTOR is None:
             import multiprocessing as mp
@@ -96,9 +101,20 @@ def _get_executor(workers: int):
                         exc_info=True,
                     )
                     _POOL_WARNED = True
-                return None
+                return None, 0
+            _EXECUTOR_WORKERS = workers
             atexit.register(shutdown)
-        return _EXECUTOR
+        elif workers != _EXECUTOR_WORKERS and not _POOL_WARNED:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "host_map pool already sized at %d workers; request for "
+                "%d reuses it (pools are per-process singletons)",
+                _EXECUTOR_WORKERS,
+                workers,
+            )
+            _POOL_WARNED = True
+        return _EXECUTOR, _EXECUTOR_WORKERS
 
 
 def shutdown() -> None:
@@ -133,15 +149,18 @@ def host_map(
     except Exception:
         # closures/lambdas: sequential rather than failing the map
         return [fn(x) for x in items]
-    ex = _get_executor(w)
+    ex, pool_w = _get_executor(w)
     if ex is None:
         return [fn(x) for x in items]
+    from concurrent.futures import CancelledError
     from concurrent.futures.process import BrokenProcessPool
 
     digest = hashlib.blake2b(fn_bytes, digest_size=16).digest()
-    # ~2 chunks per worker: smooths stragglers without multiplying the
-    # per-task fn_bytes transfer
-    chunk = max(1, -(-len(items) // (w * 2)))
+    # ~2 chunks per worker (the pool's ACTUAL size — it is created once
+    # per process and a later caller's `workers` cannot resize it):
+    # smooths stragglers without multiplying the per-task fn_bytes
+    # transfer
+    chunk = max(1, -(-len(items) // (pool_w * 2)))
     chunks = [items[i : i + chunk] for i in range(0, len(items), chunk)]
     try:
         futures = [ex.submit(_run_task, digest, fn_bytes, c) for c in chunks]
@@ -149,10 +168,16 @@ def host_map(
         for f in futures:
             out.extend(f.result())
         return out
-    except BrokenProcessPool:
-        # infrastructure failure (a worker died): this call completes
-        # sequentially; the dead pool is torn down so the NEXT call
-        # builds a fresh one
+    except (BrokenProcessPool, CancelledError, RuntimeError) as e:
+        # infrastructure failure: a worker died, OR a concurrent caller
+        # observed the same broken pool first and already shut it down
+        # (submit then raises RuntimeError / pending futures cancel).
+        # Either way this call completes sequentially and the dead pool
+        # is torn down so the NEXT call builds a fresh one.  A
+        # RuntimeError raised by fn ITSELF is a data error and must
+        # propagate unchanged (sequential semantics).
+        if isinstance(e, RuntimeError) and "schedule new futures" not in str(e):
+            raise
         import logging
 
         logging.getLogger(__name__).warning(
