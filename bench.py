@@ -93,11 +93,16 @@ N_LEGS = int(os.environ.get("BENCH_LEGS", "3"))  # ≥3 resynced samples
 _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.json")
 # bump whenever the methodology, config, or the measured PROGRAM changes
 # so stale caches die (v5: SIFT windowing default moved to the matmul
-# path — the CPU leg must run the same program as the TPU leg)
-_BASELINE_VERSION = 5
+# path; v6: kills any cache written in the window where r4's first cut
+# accidentally benchmarked with SIFT smoothing disabled)
+_BASELINE_VERSION = 6
 
 
-def build_forward(bin_sizes=(4,), smoothing_magnif: float = 0.0):
+def build_forward(bin_sizes=(4,), smoothing_magnif: float = 6.0):
+    # smoothing default matches SIFTExtractor's constructor (6.0): the
+    # headline program has included the per-scale smoothing since r1,
+    # and r4's first cut accidentally disabled it (making the headline
+    # incomparable to r2/r3 and to the cached CPU baseline)
     import jax.numpy as jnp
 
     from keystone_tpu.models.block_ls import BlockLinearMapper
@@ -174,14 +179,20 @@ def measure_ips(
     run_lengths=RUN_LENGTHS,
     reps: int = REPS,
     warmup: int = WARMUP,
-    bin_sizes=(4,),
-    smoothing_magnif: float = 0.0,
+    bin_sizes=None,
+    smoothing_magnif: float | None = None,
 ) -> float:
     import jax
 
-    forward = jax.jit(
-        build_forward(bin_sizes=bin_sizes, smoothing_magnif=smoothing_magnif)
-    )
+    # None → build_forward's own defaults.  Duplicating those defaults
+    # here is what broke the r4 headline (a 0.0 copy silently overrode
+    # the restored 6.0): forward ONLY what the caller explicitly set.
+    kw = {}
+    if bin_sizes is not None:
+        kw["bin_sizes"] = bin_sizes
+    if smoothing_magnif is not None:
+        kw["smoothing_magnif"] = smoothing_magnif
+    forward = jax.jit(build_forward(**kw))
     images = np.random.default_rng(1).uniform(
         0, 1, (batch, IMAGE_HW, IMAGE_HW, 3)
     ).astype(np.float32)
@@ -420,7 +431,7 @@ def main():
     # anywhere in a ±25% band (VERDICT r2 item 7).  The first leg of
     # each runs in-process (it also pays any compile); later legs ride
     # the compilation cache.
-    def subprocess_leg(flag: str):
+    def subprocess_leg(flag: str, required=("leg_ips",)):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
@@ -429,10 +440,15 @@ def main():
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         try:
-            return json.loads(proc.stdout.strip().splitlines()[-1])
-        except Exception:
+            leg = json.loads(proc.stdout.strip().splitlines()[-1])
+            # one malformed leg (e.g. a stray JSON log line on stdout)
+            # must skip, not crash the whole multi-leg run
+            if not isinstance(leg, dict) or any(k not in leg for k in required):
+                raise ValueError(f"leg output missing {required}: {leg!r}")
+            return leg
+        except Exception as e:
             sys.stderr.write(
-                f"bench leg {flag} failed: {proc.stderr[-300:]}\n"
+                f"bench leg {flag} failed ({e}): {proc.stderr[-300:]}\n"
             )
             return None
 
@@ -454,7 +470,17 @@ def main():
     # fit + multi-scale legs, same band discipline (all subprocess legs:
     # the in-process device state is already warm from the forward
     # samples, and a fit leg wants the cold-ish process the driver sees)
-    fit_legs = [lg for lg in (subprocess_leg("--leg-fit") for _ in range(N_LEGS)) if lg]
+    fit_legs = [
+        lg
+        for lg in (
+            subprocess_leg(
+                "--leg-fit",
+                required=("fit_seconds", "fit_images_per_sec", "solver_tflops"),
+            )
+            for _ in range(N_LEGS)
+        )
+        if lg
+    ]
     ms_legs = [lg for lg in (subprocess_leg("--leg-ms") for _ in range(N_LEGS)) if lg]
 
     cpu_ips = cpu_baseline_ips()

@@ -41,6 +41,7 @@ _EXECUTOR = None
 _EXECUTOR_LOCK = threading.Lock()
 _EXECUTOR_WORKERS = 0
 _POOL_WARNED = False
+_POOL_SIZE_NOTED = False
 
 #: worker-side: digest → unpickled callable (so the vocab model
 #: unpickles once per worker, not once per batch).  Bounded: a sweep of
@@ -79,7 +80,7 @@ def _get_executor(workers: int):
     The pool is created ONCE per process; a later caller requesting a
     different size reuses the existing pool (logged once) rather than
     churning worker startup."""
-    global _EXECUTOR, _EXECUTOR_WORKERS, _POOL_WARNED
+    global _EXECUTOR, _EXECUTOR_WORKERS, _POOL_WARNED, _POOL_SIZE_NOTED
     with _EXECUTOR_LOCK:
         if _EXECUTOR is None:
             import multiprocessing as mp
@@ -104,7 +105,9 @@ def _get_executor(workers: int):
                 return None, 0
             _EXECUTOR_WORKERS = workers
             atexit.register(shutdown)
-        elif workers != _EXECUTOR_WORKERS and not _POOL_WARNED:
+        elif workers != _EXECUTOR_WORKERS and not _POOL_SIZE_NOTED:
+            # separate flag from _POOL_WARNED: this notice must not
+            # suppress (or be suppressed by) the pool-unavailable warning
             import logging
 
             logging.getLogger(__name__).info(
@@ -113,7 +116,7 @@ def _get_executor(workers: int):
                 _EXECUTOR_WORKERS,
                 workers,
             )
-            _POOL_WARNED = True
+            _POOL_SIZE_NOTED = True
         return _EXECUTOR, _EXECUTOR_WORKERS
 
 
@@ -176,7 +179,13 @@ def host_map(
         # is torn down so the NEXT call builds a fresh one.  A
         # RuntimeError raised by fn ITSELF is a data error and must
         # propagate unchanged (sequential semantics).
-        if isinstance(e, RuntimeError) and "schedule new futures" not in str(e):
+        if (
+            not isinstance(e, (BrokenProcessPool, CancelledError))
+            # BrokenProcessPool IS a RuntimeError subclass — check it
+            # first or the fallback below is unreachable for the exact
+            # failure it exists for (a killed worker)
+            and "schedule new futures" not in str(e)
+        ):
             raise
         import logging
 

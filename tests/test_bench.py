@@ -73,6 +73,11 @@ def test_solver_flops_matches_hand_count():
     nb = 3
     want = e * (2 * n * bs * bs * nb + 6 * n * bs * k * nb)
     assert bench.solver_flops(n, d, k, bs, e) == want
+    # ragged tail: d=80 → blocks (32, 32, 16); the last block must be
+    # charged its TRUE width, not bs (the docstring's honesty guard)
+    n, d = 64, 80
+    want = e * sum(2 * n * w * w + 6 * n * w * k for w in (32, 32, 16))
+    assert bench.solver_flops(n, d, k, bs, e) == want
 
 
 def test_measure_solver_runs_on_cpu(monkeypatch):

@@ -119,6 +119,35 @@ def test_host_map_fn_error_propagates():
     assert hostmap._EXECUTOR is not None
 
 
+def test_host_map_broken_pool_falls_back_sequentially(monkeypatch):
+    """BrokenProcessPool IS a RuntimeError subclass — the data-error
+    re-raise filter must not swallow the broken-pool fallback (a killed
+    worker must complete the map sequentially and tear the pool down
+    for rebuild on next use)."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    from keystone_tpu.utils import hostmap
+
+    class _DeadFuture:
+        def result(self):
+            raise BrokenProcessPool(
+                "A process in the process pool was terminated abruptly"
+            )
+
+    class _DeadPool:
+        def submit(self, *a, **k):
+            return _DeadFuture()
+
+        def shutdown(self, **k):
+            pass
+
+    monkeypatch.setattr(
+        hostmap, "_get_executor", lambda w: (_DeadPool(), w)
+    )
+    out = host_map(_boom, [0, 1, 2], workers=2, min_items=2)
+    assert out == [0, 2, 4]  # completed sequentially in THIS process
+
+
 def test_trivial_host_ops_opt_out_of_pool(monkeypatch):
     """Trimmer/LowerCase (one str method per item) must not ship the
     corpus through IPC — parallel_host=False keeps them sequential."""
