@@ -252,7 +252,9 @@ def _csr_row(cols, vals, num_features: int):
 #: term → hash memo.  The corpus term distribution is zipfian, so a plain
 #: dict (5.5x blake2b re-hashing, measured) almost always hits; the cap
 #: bounds memory on adversarial vocabularies — once full, new terms hash
-#: uncached (the hot head is already resident).
+#: uncached (the hot head is already resident).  2^17 (~25 MB of tuple
+#: keys at typical n-gram sizes, not 2^20's ~200 MB): the memo is
+#: per-process, and host_map worker processes each hold their own copy.
 _TERM_HASH_MEMO: Dict = {}
 _TERM_HASH_MEMO_CAP = 1 << 17
 

@@ -93,7 +93,10 @@ def hlo_stage_cost(fn, *avals) -> Optional[dict]:
 
 
 def profile_graph(
-    graph: G.Graph, sample_size: int = 64, static_cost: bool = False
+    graph: G.Graph,
+    sample_size: int = 64,
+    static_cost: bool = False,
+    targets=None,
 ) -> Dict[G.NodeId, NodeProfile]:
     """Run every reachable transformer node on truncated dataset literals,
     recording wall time and output size (the reference's sampling pass).
@@ -101,7 +104,16 @@ def profile_graph(
     With ``static_cost=True``, additionally price each device transformer
     at FULL batch size from its compiled HLO (hlo_stage_cost) — sampled
     runs still provide shapes and output sizes, but the seconds estimate
-    comes from XLA's own cost counters instead of extrapolated wall time."""
+    comes from XLA's own cost counters instead of extrapolated wall time.
+
+    ``targets`` restricts profiling to a node subset (their sampled
+    ancestors still execute, memoized, to produce inputs).  The cache rule
+    passes the SHARED nodes here: they are the only ones whose profiles
+    the placement decision reads, and pricing only them avoids compiling
+    every stage at full batch size and avoids sampled execution of
+    subgraphs (e.g. the solver's) that no shared output depends on —
+    measured 4 shared of 23 profilable on the north-star fit, where the
+    unrestricted pass was ~60% of total fit wall-clock."""
     from keystone_tpu.workflow.executor import DatasetExpr, GraphExecutor
 
     full_n = max(
@@ -118,6 +130,8 @@ def profile_graph(
     for n in truncated.topological_nodes():
         op = truncated.operators[n]
         if not isinstance(op, (G.TransformerOperator, G.GatherOperator)):
+            continue
+        if targets is not None and n not in targets:
             continue
         try:
             expr = ex.execute(n)
@@ -209,14 +223,26 @@ class ProfilingAutoCacheRule(Rule):
         self.static_cost = bool(static_cost)
 
     def apply(self, graph: G.Graph) -> G.Graph:
-        profiles = profile_graph(graph, self.sample_size, static_cost=self.static_cost)
-        seconds = _comparable_seconds(profiles)
         shared = [
             n
             for n in graph.topological_nodes()
             if isinstance(graph.operators.get(n), (G.TransformerOperator, G.GatherOperator))
             and len([d for d in graph.dependents(n) if not isinstance(d, G.SinkId)]) > 1
         ]
+        if not shared:  # nothing to place — skip the sampling pass entirely
+            return graph
+        import os
+
+        # debug/A-B knob: profile every node like the pre-r4 rule did
+        # (measured ~60% of north-star fit wall-clock; BASELINE.md r4)
+        profile_all = os.environ.get("KEYSTONE_CACHE_PROFILE_ALL", "") == "1"
+        profiles = profile_graph(
+            graph,
+            self.sample_size,
+            static_cost=self.static_cost,
+            targets=None if profile_all else frozenset(shared),
+        )
+        seconds = _comparable_seconds(profiles)
         # most compute saved per byte pinned, first
         shared.sort(
             key=lambda n: (

@@ -88,6 +88,49 @@ def test_profiling_autocache_rule_within_budget():
     assert len(cachers) == 1  # the shared Expensive output got pinned
 
 
+def test_profile_graph_targets_restricts_profiled_nodes():
+    """targets= limits profiling to the given nodes (ancestors still
+    execute, memoized, to produce their inputs) — the cache rule passes
+    the shared set here so the sampling pass doesn't price (or run)
+    subgraphs the placement decision never reads."""
+    from keystone_tpu.workflow.profiling import profile_graph
+
+    p = Pipeline.gather([Expensive("x") | AddC(1.0), Expensive("x") | AddC(2.0)])
+    lazy = p(Dataset(np.ones((64, 8), np.float32)))
+    all_profiles = profile_graph(lazy.graph, sample_size=16)
+    target = next(iter(all_profiles))
+    only = profile_graph(lazy.graph, sample_size=16, targets=frozenset([target]))
+    assert set(only) == {target}
+    assert only[target].output_bytes == all_profiles[target].output_bytes
+
+
+def test_profiling_autocache_skips_sampling_without_shared_nodes():
+    """A linear pipeline has nothing to place — the rule must return the
+    graph untouched WITHOUT running the sampled profiling pass (it was
+    ~60% of north-star fit wall-clock before r4's shared-only restriction)."""
+    import keystone_tpu.workflow.profiling as prof_mod
+    from keystone_tpu.workflow.profiling import ProfilingAutoCacheRule
+
+    calls = {"n": 0}
+    orig = prof_mod.profile_graph
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    p = Expensive("lin") | AddC(1.0) | AddC(2.0)
+    lazy = p(Dataset(np.ones((32, 4), np.float32)))
+    prof_mod.profile_graph = counting
+    try:
+        g2 = ProfilingAutoCacheRule(budget_bytes=1 << 30, sample_size=16).apply(
+            lazy.graph
+        )
+    finally:
+        prof_mod.profile_graph = orig
+    assert calls["n"] == 0
+    assert g2.operators.keys() == lazy.graph.operators.keys()
+
+
 def test_profiling_autocache_over_budget_sets_no_memoize():
     from keystone_tpu.workflow.optimizer import EquivalentNodeMergeRule
     from keystone_tpu.workflow.profiling import ProfilingAutoCacheRule

@@ -1,0 +1,109 @@
+"""Instrumented north-star fit: where do the ~34 s go?
+
+Splits fit wall-clock into optimizer rule batches (CSE / node-choice /
+materialize / fusion) and per-node execute times (device-synchronized),
+at exactly the bench.py fit-leg config.  Run on the chip:
+
+    python tools/profile_fit.py [n]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, for bench
+from bench import (  # noqa: E402 — the profiled config IS the bench fit config
+    FIT_CLASSES,
+    FIT_EPOCHS,
+    FIT_GMM_K,
+    FIT_N as _BENCH_FIT_N,
+    FIT_SOLVER_BLOCK,
+    IMAGE_HW,
+    PCA_DIMS,
+)
+
+_args = [a for a in sys.argv[1:] if not a.startswith("-")]
+FIT_N = int(_args[0]) if _args else _BENCH_FIT_N
+
+
+def main():
+    from keystone_tpu.loaders.imagenet import ImageNetLoader
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import Config, ImageNetSiftLcsFV
+    from keystone_tpu.workflow.executor import GraphExecutor
+    from keystone_tpu.workflow.pipeline import PipelineEnv
+
+    cfg = Config(
+        num_classes=FIT_CLASSES,
+        synthetic_n=FIT_N,
+        image_size=IMAGE_HW,
+        gmm_k=FIT_GMM_K,
+        pca_dims=PCA_DIMS,
+        num_epochs=FIT_EPOCHS,
+        solver_block_size=FIT_SOLVER_BLOCK,
+    )
+    train = ImageNetLoader.synthetic(
+        FIT_N, FIT_CLASSES, size=(IMAGE_HW, IMAGE_HW), seed=1
+    )
+
+    from keystone_tpu.workflow import graph as G
+
+    repeat = "--repeat" in sys.argv
+    t_all0 = time.perf_counter()
+    t0 = time.perf_counter()
+    pipe = ImageNetSiftLcsFV.build(cfg, train.data, train.labels)
+    t_build = time.perf_counter() - t0
+
+    # mimic Pipeline.fit(): optimize then execute estimator nodes, but
+    # timed per rule batch / per rule
+    opt = PipelineEnv.get_optimizer()
+    g = pipe.graph
+
+    batch_times = {}
+    for batch in opt.batches:
+        tb = time.perf_counter()
+        for _ in range(batch.strategy.max_iterations):
+            from keystone_tpu.workflow.optimizer import _graph_fingerprint
+
+            before = _graph_fingerprint(g)
+            for rule in batch.rules:
+                g = rule.apply(g)
+            if _graph_fingerprint(g) == before:
+                break
+        batch_times[batch.name] = time.perf_counter() - tb
+
+    t0 = time.perf_counter()
+    ex = GraphExecutor(g, profile=True)
+    for n in g.topological_nodes():
+        if isinstance(g.operators[n], G.EstimatorOperator):
+            ex.execute(n)
+    t_exec = time.perf_counter() - t0
+    if repeat:  # second walk in the same process: jit caches warm, so
+        # node times are dispatch+device, not tracing/compile-cache loads
+        t0 = time.perf_counter()
+        ex = GraphExecutor(g, profile=True)
+        for n in g.topological_nodes():
+            if isinstance(g.operators[n], G.EstimatorOperator):
+                ex.execute(n)
+        t_exec = time.perf_counter() - t0
+    t_total = time.perf_counter() - t_all0
+
+    print(f"n={FIT_N}  total={t_total:.2f}s  build={t_build:.3f}s  "
+          f"exec={t_exec:.2f}s")
+    print("optimizer batches:")
+    for k, v in batch_times.items():
+        print(f"  {k:<14} {v:8.2f}s")
+    print("top execute nodes (device-synced):")
+    items = sorted(ex.timings.items(), key=lambda kv: -kv[1])[:20]
+    for node, secs in items:
+        label = g.operators[node].label() if node in g.operators else str(node)
+        print(f"  {secs:8.3f}s  {node.id}:{label[:100]}")
+
+
+if __name__ == "__main__":
+    main()
