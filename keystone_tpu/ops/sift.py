@@ -165,6 +165,31 @@ def _window_matrix(
     return a, centers.size
 
 
+def _gradient_orientation_map(imgs):
+    """Gradient → 8-orientation soft binning: (n, h, w) → (n, h, w, 8).
+
+    Central-difference gradients (vl_dsift's convention), then magnitude
+    linearly interpolated between the two adjacent orientation bins.
+    Shared by both windowing paths; the elementwise producer of the
+    windowing einsums' input."""
+    dy = jnp.pad(imgs[:, 2:, :] - imgs[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
+    dx = jnp.pad(imgs[:, :, 2:] - imgs[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
+    mag = jnp.sqrt(dx * dx + dy * dy)
+    ang = jnp.arctan2(dy, dx)  # [-pi, pi]
+
+    o = _NUM_ORIENTATIONS
+    theta = (ang % (2 * jnp.pi)) * (o / (2 * jnp.pi))  # [0, 8)
+    lo_bin = jnp.floor(theta)
+    frac = theta - lo_bin
+    lo_bin = lo_bin.astype(jnp.int32) % o
+    hi_bin = (lo_bin + 1) % o
+    bins = jnp.arange(o)[None, None, None, :]
+    return mag[..., None] * (
+        (bins == lo_bin[..., None]) * (1.0 - frac[..., None])
+        + (bins == hi_bin[..., None]) * frac[..., None]
+    )  # (n, h, w, 8)
+
+
 @partial(
     jax.jit, static_argnames=("step", "bin_size", "mxu", "sigma", "windowing")
 )
@@ -185,24 +210,8 @@ def _dsift(
     if sigma > 0.0:
         imgs = separable_gaussian_blur(imgs[..., None], sigma)[..., 0]
 
-    # --- gradients (central differences, like vl_dsift's gradient) ---
-    dy = jnp.pad(imgs[:, 2:, :] - imgs[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
-    dx = jnp.pad(imgs[:, :, 2:] - imgs[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
-    mag = jnp.sqrt(dx * dx + dy * dy)
-    ang = jnp.arctan2(dy, dx)  # [-pi, pi]
-
-    # --- soft orientation binning (linear interp between adjacent bins) ---
     o = _NUM_ORIENTATIONS
-    theta = (ang % (2 * jnp.pi)) * (o / (2 * jnp.pi))  # [0, 8)
-    lo_bin = jnp.floor(theta)
-    frac = theta - lo_bin
-    lo_bin = lo_bin.astype(jnp.int32) % o
-    hi_bin = (lo_bin + 1) % o
-    bins = jnp.arange(o)[None, None, None, :]
-    omap = mag[..., None] * (
-        (bins == lo_bin[..., None]) * (1.0 - frac[..., None])
-        + (bins == hi_bin[..., None]) * frac[..., None]
-    )  # (n, h, w, 8)
+    omap = _gradient_orientation_map(imgs)  # (n, h, w, 8)
 
     if windowing == "matmul":
         # --- windowing + bin extraction as two MXU matmuls ---

@@ -361,7 +361,16 @@ class StageFusionRule(Rule):
                 if not _fusable(mop) or graph.dependencies[m] != (n,):
                     continue
                 stages = _stages(op) + _stages(mop)
-                graph = graph.set_operator(m, G.TransformerOperator(FusedTransformer(stages)))
+                fused_op = G.TransformerOperator(FusedTransformer(stages))
+                # the fused node's OUTPUT is m's output: if the cache rule
+                # flagged m over-HBM-budget (no_memoize → recompute per
+                # consumer), the fused replacement must carry the flag or
+                # the executor pins the very output the device can't
+                # afford.  (n's flag needs no propagation: fusing a
+                # single-consumer n eliminates its output entirely.)
+                if getattr(mop, "no_memoize", False):
+                    fused_op.no_memoize = True
+                graph = graph.set_operator(m, fused_op)
                 graph = graph.set_dependencies(m, graph.dependencies[n])
                 graph = graph.remove_node(n)
                 changed = True

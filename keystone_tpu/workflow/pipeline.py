@@ -253,6 +253,16 @@ class Pipeline(Chainable):
                     g = g.set_dependencies(dep, rest)
             g = g.remove_node(n)
         g = _prune_unreachable(g, self.sink, keep_sources=(self.source,))
+        # Re-fuse: estimator substitution just turned DelegatingOperators
+        # (unfusable while the transformer was unknown) into plain device
+        # transformers, leaving linear chains the pre-fit fusion pass
+        # could not touch.  One more pass means the SCORING path runs as
+        # few jit programs as possible — each extra program costs a
+        # per-process trace + compile-cache load, the dominant cost of a
+        # cold scoring run (BASELINE.md r4 fit-overhead split).
+        from keystone_tpu.workflow.optimizer import StageFusionRule
+
+        g = StageFusionRule().apply(g)
         return FittedPipeline(g, self.source, self.sink)
 
     def to_dot(self, name: str = "pipeline") -> str:

@@ -201,12 +201,61 @@ def test_fusion_rule_fuses_linear_chains():
     assert len(ops[0].transformer.stages) == 3
 
 
+def test_fusion_preserves_no_memoize_flag():
+    """Fusing INTO an over-HBM-budget node (no_memoize — recompute per
+    consumer) must carry the flag to the fused replacement, or the
+    executor pins the very output the cache rule decided the device
+    cannot afford."""
+    from keystone_tpu.workflow import Graph, StageFusionRule, TransformerOperator
+
+    g = Graph()
+    g, src = g.add_source()
+    g, n1 = g.add_node(TransformerOperator(AddConst(1.0)), (src,))
+    flagged = TransformerOperator(AddConst(2.0))
+    flagged.no_memoize = True
+    g, n2 = g.add_node(flagged, (n1,))
+    g, sink = g.add_sink(n2)
+    fused = StageFusionRule().apply(g)
+    ops = [op for op in fused.operators.values()]
+    assert len(ops) == 1
+    assert isinstance(ops[0].transformer, FusedTransformer)
+    assert getattr(ops[0], "no_memoize", False) is True
+
+
 def test_fused_transformer_matches_unfused():
     chain = [AddConst(1.0), CountingDouble(), AddConst(-0.5)]
     fused = FusedTransformer(chain)
     x = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
     expect = (x + 1.0) * 2.0 - 0.5
     assert np.allclose(np.asarray(fused.apply_batch(x)), np.asarray(expect))
+
+
+def test_fit_re_fuses_chains_through_substituted_estimators():
+    """fit() must re-run stage fusion AFTER estimator substitution: the
+    fitted model's apply node was a DelegatingOperator (unfusable) during
+    optimization, so the scoring path would otherwise dispatch one jit
+    program per post-model stage (each costing a per-process trace +
+    cache load — BASELINE.md r4 fit-overhead split)."""
+    data = np.random.default_rng(3).normal(1.0, 1.0, (16, 4)).astype(np.float32)
+    fitted = (
+        AddConst(0.5)
+        .and_then(MeanShift(), Dataset(data))
+        .and_then(AddConst(1.0))
+        .and_then(AddConst(2.0))
+    ).fit()
+    from keystone_tpu.workflow import TransformerOperator
+
+    fused = [
+        op.transformer
+        for op in fitted.graph.operators.values()
+        if isinstance(op, TransformerOperator)
+        and isinstance(op.transformer, FusedTransformer)
+    ]
+    # the fitted MeanShift + trailing AddConsts collapse into one stage
+    assert any(len(f.stages) >= 3 for f in fused)
+    out = fitted(Dataset(data)).get().numpy()
+    expect = (data + 0.5) - (data + 0.5).mean(axis=0) + 3.0
+    assert np.allclose(out, expect, atol=1e-5)
 
 
 def test_host_transformer_path():

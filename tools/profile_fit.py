@@ -13,11 +13,14 @@ import time
 
 import numpy as np
 
-from keystone_tpu.utils.compile_cache import enable_compilation_cache
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, BEFORE any
+# keystone_tpu/bench import — `python tools/profile_fit.py` has tools/
+# as sys.path[0] and keystone_tpu is not an installed package
+
+from keystone_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, for bench
 from bench import (  # noqa: E402 — the profiled config IS the bench fit config
     FIT_CLASSES,
     FIT_EPOCHS,

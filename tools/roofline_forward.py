@@ -1,0 +1,216 @@
+"""Per-stage roofline of the headline forward (VERDICT r3 item 2c).
+
+Uses the jax.profiler DEVICE TRACE (works on the axon backend; events
+carry per-HLO-op ``device_duration_ps``, ``model_flops``,
+``bytes_accessed``, and source attribution), so per-op device times are
+exact — no noisy wall-clock differencing.  For every compiled op of the
+bench.py headline program (batch 128, SIFT bin 4 + smoothing, K=256 FV,
+1000-class scoring) it reports:
+
+- measured device microseconds per batch (median across traced runs)
+- FLOPs and HBM bytes (XLA's per-op counters; the Pallas FV custom call
+  is priced analytically — XLA cannot see inside it)
+- the roofline bound  max(flops/peak_mxu, bytes/peak_hbm)  and the
+  achieved fraction — ops at ≥~75% of bound are done; ops far below it
+  with big byte counts name the fusion lever.
+
+Also prints total device-busy time per iteration vs the program's wall
+marginal time (the overlap/dispatch picture).
+
+Run on the chip:  python tools/roofline_forward.py [--json]
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, for bench
+from bench import (  # noqa: E402
+    BATCH,
+    GMM_K,
+    IMAGE_HW,
+    PCA_DIMS,
+    SIFT_STEP,
+    build_forward,
+    flops_per_image,
+)
+
+BIN_SIZE = 4  # the headline single-scale bin (build_forward default)
+
+TRACE_ITERS = 8
+#: v5e bf16-grade MXU peak and HBM stream peak — per-op bounds use the
+#: bf16 rate for matmul/conv ops (XLA runs default-precision f32 matmuls
+#: as bf16-grade passes) and the f32 VPU-ish rate is not modeled: for
+#: elementwise ops the bound is always bytes.
+_PEAK_MXU = 1.97e14
+_PEAK_HBM = 8.1e11
+
+
+def run_and_trace(logdir: str):
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    fwd = jax.jit(build_forward())
+    x = jnp.asarray(
+        np.random.default_rng(1)
+        .uniform(0, 1, (BATCH, 128, 128, 3))
+        .astype(np.float32)
+    )
+    for _ in range(3):
+        np.asarray(fwd(x)[:1, :8])  # compile + warm
+    # wall marginal (one long run, marginal slope over two lengths)
+    def run(k):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = fwd(x)
+        np.asarray(out[:1, :8])
+        return time.perf_counter() - t0
+
+    t20, t60 = run(20), run(60)
+    wall_marginal = (t60 - t20) / 40.0
+    with jax.profiler.trace(logdir):
+        out = None
+        for _ in range(TRACE_ITERS):
+            out = fwd(x)
+        np.asarray(out[:1, :8])
+    return wall_marginal
+
+
+def parse_trace(logdir: str):
+    paths = sorted(
+        glob.glob(os.path.join(logdir, "plugins/profile/*/*.trace.json.gz"))
+    )
+    if not paths:
+        raise RuntimeError(f"no trace.json.gz under {logdir}")
+    with gzip.open(paths[-1]) as f:
+        t = json.load(f)
+    evs = t.get("traceEvents", t) if isinstance(t, dict) else t
+    # device-op events: ph=X with an hlo_category arg (host pid events
+    # have none); tid varies (queue), pid is the device process
+    ops = [
+        e
+        for e in evs
+        if e.get("ph") == "X"
+        and isinstance(e.get("args"), dict)
+        and "hlo_category" in e["args"]
+    ]
+    return ops
+
+
+def aggregate(ops):
+    """op label → dict(us_per_run, flops, bytes, category, n)."""
+    by_name = defaultdict(list)
+    for e in ops:
+        a = e["args"]
+        by_name[e["name"]].append(
+            (
+                int(a.get("device_duration_ps", 0)) / 1e6,  # ps → µs
+                float(a.get("model_flops", 0) or 0),
+                float(a.get("raw_bytes_accessed", a.get("bytes_accessed", 0)) or 0),
+                a.get("hlo_category", "?"),
+                a.get("tf_op", "") or a.get("source", ""),
+            )
+        )
+    rows = {}
+    for name, vals in by_name.items():
+        d = [v[0] for v in vals]
+        # each run emits the op once; occurrences = ceil(n/TRACE_ITERS)
+        per_run = float(np.sum(d)) / TRACE_ITERS
+        rows[name] = {
+            "us_per_run": per_run,
+            "flops": vals[0][1] * len(vals) / TRACE_ITERS,
+            "bytes": vals[0][2] * len(vals) / TRACE_ITERS,
+            "category": vals[0][3],
+            "attr": vals[0][4][:70],
+            "n": len(vals),
+        }
+    return rows
+
+
+def main():
+    logdir = tempfile.mkdtemp(prefix="ks-roofline-")
+    wall = run_and_trace(logdir)
+    rows = aggregate(parse_trace(logdir))
+
+    # price the Pallas FV custom call analytically (model_flops = 0 for
+    # custom calls XLA can't see inside).  Match by NAME — a category
+    # match would hand the FV count to any other zero-flop custom call
+    # in a future trace.  T derives from the bench geometry, not a
+    # hardcoded 784.
+    from keystone_tpu.ops.sift import sift_output_count
+
+    t_desc = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, (BIN_SIZE,))
+    for name, r in rows.items():
+        if "fisher" in name.lower() and r["flops"] == 0:
+            r["flops"] = 4 * 2 * t_desc * PCA_DIMS * GMM_K * BATCH
+            r["analytic_flops"] = True
+
+    total_dev = sum(r["us_per_run"] for r in rows.values())
+    out_rows = []
+    for name, r in sorted(rows.items(), key=lambda kv: -kv[1]["us_per_run"]):
+        bound_us = max(r["flops"] / _PEAK_MXU, r["bytes"] / _PEAK_HBM) * 1e6 / 1.0
+        # flops/bytes are per-run totals; us_per_run the same
+        pct = bound_us / r["us_per_run"] if r["us_per_run"] > 0.5 else None
+        binding = (
+            "flops" if r["flops"] / _PEAK_MXU >= r["bytes"] / _PEAK_HBM else "bytes"
+        )
+        out_rows.append(
+            {
+                "op": name,
+                "category": r["category"],
+                "us_per_batch": round(r["us_per_run"], 1),
+                "share_of_device": round(r["us_per_run"] / total_dev, 3),
+                "gflops": round(r["flops"] / 1e9, 2),
+                "mbytes": round(r["bytes"] / 1e6, 1),
+                "bound_us": round(bound_us, 1),
+                "binding": binding,
+                "pct_of_bound": pct and round(pct, 2),
+                "attr": r["attr"],
+            }
+        )
+    result = {
+        "batch": BATCH,
+        "wall_marginal_us": round(wall * 1e6, 1),
+        "device_busy_us": round(total_dev, 1),
+        "overlap_or_gap_us": round(wall * 1e6 - total_dev, 1),
+        "images_per_sec": round(BATCH / wall, 1),
+        "analytic_flops_per_image": flops_per_image(),
+        "ops": out_rows,
+    }
+    if "--json" in sys.argv:
+        print(json.dumps(result))
+        return
+    print(
+        f"batch={BATCH}  wall={wall*1e6:.0f}us/batch  device-busy="
+        f"{total_dev:.0f}us  ({BATCH/wall:,.0f} images/s)"
+    )
+    print(
+        f"{'op':<28}{'us':>7}{'%dev':>6}{'GF':>7}{'MB':>8}{'bound':>7}"
+        f"{'bind':>7}{'x-off':>7}  attr"
+    )
+    for r in out_rows:
+        if r["us_per_batch"] < 0.5:
+            continue
+        pct = f"{r['pct_of_bound']:.2f}" if r["pct_of_bound"] else "—"
+        print(
+            f"{r['op'][:27]:<28}{r['us_per_batch']:>7.1f}"
+            f"{100*r['share_of_device']:>5.0f}%{r['gflops']:>7.2f}"
+            f"{r['mbytes']:>8.1f}{r['bound_us']:>7.1f}{r['binding']:>7}"
+            f"{pct:>7}  {r['attr'][:40]}"
+        )
+
+
+if __name__ == "__main__":
+    main()
