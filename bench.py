@@ -94,8 +94,10 @@ _BASELINE_CACHE = os.path.join(os.path.dirname(__file__), ".bench_cpu_baseline.j
 # bump whenever the methodology, config, or the measured PROGRAM changes
 # so stale caches die (v5: SIFT windowing default moved to the matmul
 # path; v6: kills any cache written in the window where r4's first cut
-# accidentally benchmarked with SIFT smoothing disabled)
-_BASELINE_VERSION = 6
+# accidentally benchmarked with SIFT smoothing disabled; v7: the
+# per-scale Gaussian blur moved to banded-matrix einsums — the CPU leg
+# runs the same program)
+_BASELINE_VERSION = 7
 
 
 def build_forward(bin_sizes=(4,), smoothing_magnif: float = 6.0):
@@ -152,7 +154,7 @@ def build_forward(bin_sizes=(4,), smoothing_magnif: float = 6.0):
     return forward
 
 
-def flops_per_image() -> float:
+def flops_per_image(bin_sizes=(4,)) -> float:
     """Analytic FLOPs/image of the forward path (2·MACs convention).
 
     XLA's compiled cost analysis can't price the Pallas FV custom call,
@@ -161,12 +163,14 @@ def flops_per_image() -> float:
     """
     from keystone_tpu.ops.sift import _window_matrix, sift_output_count
 
-    t = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, (4,))
+    t = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, bin_sizes)
     d_sift = 128
-    # SIFT windowing (matmul path, the r3 default): two dense einsums —
-    # (P, H)×(H, W·8) then (Q, W)×(W, P·8), P = Q = 4·num_centers
-    p = _window_matrix(IMAGE_HW, SIFT_STEP, 4)[0].shape[0]
-    sift = 2 * p * IMAGE_HW * IMAGE_HW * 8 + 2 * p * IMAGE_HW * p * 8
+    # SIFT windowing (matmul path, the r3 default), per scale: two dense
+    # einsums — (P, H)×(H, W·8) then (Q, W)×(W, P·8), P = Q = 4·centers
+    sift = 0
+    for b in bin_sizes:
+        p = _window_matrix(IMAGE_HW, SIFT_STEP, b)[0].shape[0]
+        sift += 2 * p * IMAGE_HW * IMAGE_HW * 8 + 2 * p * IMAGE_HW * p * 8
     pca = 2 * t * d_sift * PCA_DIMS
     # FV kernel: 4 MXU contractions of T×D×K (x²·inv, x·μinv, γᵀx, γᵀx²)
     fv = 4 * 2 * t * PCA_DIMS * GMM_K
@@ -231,13 +235,22 @@ def measure_ips(
         if nj != ni
     ]
     per_iter = float(np.median(slopes)) if slopes else float("nan")
-    if not per_iter > 0:  # catches non-positive AND NaN (empty/degenerate)
+    # physical plausibility cap: a tunnel hiccup mid-measurement can
+    # leave the pairwise-slope median absurdly small (observed: a
+    # 1.5M-ips multi-scale leg ≈ 25× the chip's possible rate).  Any
+    # reading beyond 2× the bf16 MXU peak over the program's analytic
+    # FLOPs is a broken measurement, not a fast chip.
+    cap_per_iter = (
+        batch * flops_per_image(bin_sizes or (4,)) / (2.0 * _BF16_EFFECTIVE_PEAK)
+    )
+    if not per_iter > 0 or per_iter < cap_per_iter:
         n_max = max(run_lengths)
         per_iter = float(
             np.median([t / n for n, t in points if n == n_max])
         )
         sys.stderr.write(
-            "bench: slope estimator degenerate; reporting sync-dominated mean\n"
+            "bench: slope estimator degenerate/implausible; "
+            "reporting sync-dominated mean\n"
         )
     return batch / per_iter
 

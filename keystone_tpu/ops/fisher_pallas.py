@@ -31,24 +31,48 @@ from jax.experimental.pallas import tpu as pltpu
 
 _LOG2PI = 1.8378770664093453
 
-# Max descriptors per VMEM tile.  Measured on v5 lite (T=784, K=256, d=64):
-# one whole-image tile runs the kernel at ~42 TF/s vs ~14 TF/s with 128-row
-# tiles — per-program overhead (accumulator init/finalize, revolving
-# windows) dominates small tiles, and M=T-sized matmuls feed the MXU far
-# better.  VMEM stays comfortable: intermediates are ~tile·K·4 floats
-# (~4 MB at tile=1024, K=256), well under the ~16 MB budget.
+# Max descriptors per VMEM tile when the GMM shape is unknown.  Measured
+# on v5 lite (T=784, K=256, d=64): one whole-image tile runs the kernel
+# at ~42 TF/s vs ~14 TF/s with 128-row tiles — per-program overhead
+# (accumulator init/finalize, revolving windows) dominates small tiles,
+# and M=T-sized matmuls feed the MXU far better.
 TILE_T_MAX = 1024
+#: VMEM bytes budgeted for the per-tile intermediates (γ/logp/e are
+#: (tile, K) f32 — ~3 live copies — plus x and x² at (tile, d)); the
+#: rest of the ~16 MB budget holds the (K, d) accumulators + constants.
+_VMEM_TILE_BUDGET = 12 << 20
 
 
-def _tile_t(t: int) -> int:
-    """Fewest tiles of size ≤ TILE_T_MAX covering t.
+def _tile_t(t: int, k: int | None = None, d: int | None = None) -> int:
+    """Fewest tiles covering t under the VMEM budget.
 
-    Single tile: any sublane multiple (8) works.  Multiple tiles: the mask
-    block rides T as its LANE dim, so the tile must be a 128-multiple."""
-    tiles = -(-t // TILE_T_MAX)
-    if tiles == 1:
-        return -(-t // 8) * 8
-    return -(-t // tiles // 128) * 128
+    With the GMM shape (k, d) known, the cap comes from the budget —
+    measured r4 at the multi-scale config (T=2520, K=256): one 2520-row
+    tile runs 620→524 µs/batch vs 3×896 tiles, because the fixed-cap
+    tiling both paid per-tile overhead AND padded the whole descriptor
+    tensor 2520→2688 (a 130 µs jnp.pad copy).  Single tile: any sublane
+    multiple (8) works.  Multiple tiles: the mask block rides T as its
+    LANE dim, so the tile must be a 128-multiple."""
+    cap = TILE_T_MAX
+    if k is not None and d is not None:
+        rows = _VMEM_TILE_BUDGET // (4 * (3 * k + 2 * d))
+        # floor of 8 (one sublane group), NOT some larger convenience
+        # minimum: a floor above the budget would silently re-breach the
+        # VMEM limit the cap exists to respect.  (Multi-tile tiles are
+        # ≥128 regardless — the mask lane-dim constraint — so K large
+        # enough that 128 rows overflow VMEM fails at Mosaic compile,
+        # as it would have at any tile size.)
+        cap = max(8, min(4096, rows // 8 * 8))
+    tiles = -(-t // cap)
+    while True:
+        if tiles == 1:
+            return -(-t // 8) * 8
+        tile = -(-t // tiles // 128) * 128
+        # the 128-up-rounding can push one tile count past the cap;
+        # adding a tile shrinks it (terminates at tile=128)
+        if tile <= max(cap, 128):
+            return tile
+        tiles += 1
 
 
 def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
@@ -124,7 +148,7 @@ def fisher_encode_pallas(
     """
     n, t, d = xs.shape
     k = mu.shape[0]
-    tile_t = _tile_t(t)
+    tile_t = _tile_t(t, k, d)
     tiles = -(-t // tile_t)
     if tiles * tile_t != t:
         pad = tiles * tile_t - t

@@ -206,9 +206,14 @@ def _dsift(
     n, h, w = imgs.shape
 
     # --- per-scale Gaussian smoothing (vl_dsift applies it per bin size
-    # when smoothing != 0; separable depthwise conv) ---
+    # when smoothing != 0).  The blur's physical form follows the
+    # windowing choice: the matmul path runs it as banded-matrix MXU
+    # einsums (r4 roofline: the depthwise convs ran at ~0.1× of their
+    # byte bound); the conv path stays the bit-stable parity reference.
     if sigma > 0.0:
-        imgs = separable_gaussian_blur(imgs[..., None], sigma)[..., 0]
+        imgs = separable_gaussian_blur(
+            imgs[..., None], sigma, strategy=windowing
+        )[..., 0]
 
     o = _NUM_ORIENTATIONS
     omap = _gradient_orientation_map(imgs)  # (n, h, w, 8)

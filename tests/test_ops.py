@@ -487,6 +487,39 @@ def test_sift_per_scale_gaussian_smoothing():
     )
 
 
+def test_blur_matmul_matches_conv_and_scipy():
+    """The banded-matrix blur (r4 default) must equal the depthwise-conv
+    form and scipy's mode='constant' Gaussian — same truncation, same
+    zero-padding edge semantics, both axes."""
+    from scipy.ndimage import gaussian_filter
+
+    from keystone_tpu.ops.filters import separable_gaussian_blur
+
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 1, (2, 33, 47, 3)).astype(np.float32)
+    for sigma in (0.45, 1.3):
+        mm = np.asarray(separable_gaussian_blur(jnp.asarray(x), sigma))
+        cv = np.asarray(
+            separable_gaussian_blur(jnp.asarray(x), sigma, strategy="conv")
+        )
+        np.testing.assert_allclose(mm, cv, atol=2e-5)
+        sp = np.stack(
+            [
+                np.stack(
+                    [
+                        gaussian_filter(
+                            x[i, :, :, c], sigma, mode="constant", truncate=3.0
+                        )
+                        for c in range(3)
+                    ],
+                    axis=-1,
+                )
+                for i in range(2)
+            ]
+        )
+        np.testing.assert_allclose(mm, sp, atol=2e-3)
+
+
 def test_sift_multiscale_concatenates_per_scale_descriptors():
     """Multiple bin sizes (the reference's multi-scale dense SIFT): output
     is the per-scale descriptor sets concatenated along the keypoint axis."""

@@ -34,15 +34,35 @@ def test_pallas_fv_nondivisible_t_padding():
     np.testing.assert_allclose(got, ref, atol=2e-5)
 
 
-def test_pallas_fv_multi_tile_accumulation():
-    """T > TILE_T_MAX forces tiles>1: exercises the revolving-accumulator
-    t-loop, the 128-multiple _tile_t branch, and the (1, 1, tile_t) mask
-    index map (none of which the single-tile tests touch)."""
-    from keystone_tpu.ops.fisher_pallas import TILE_T_MAX, _tile_t
+def test_tile_t_budget_covers_multiscale_in_one_tile():
+    """The VMEM-budgeted cap (r4): the reference multi-scale shape
+    (T=2520, K=256, d=64) fits ONE tile — no descriptor pad copy, no
+    per-tile overhead (measured 620→524 µs/batch) — while a K large
+    enough to blow the budget still tiles with a 128-multiple."""
+    from keystone_tpu.ops import fisher_pallas as fp
 
-    for t in (TILE_T_MAX + 476, 2 * TILE_T_MAX + 1):
-        tile = _tile_t(t)
-        assert tile <= TILE_T_MAX and tile % 128 == 0
+    assert fp._tile_t(2520, 256, 64) == 2520  # exact, padless
+    assert fp._tile_t(784, 256, 64) == 784  # headline unchanged
+    big_k = fp._tile_t(8192, 2048, 128)
+    assert big_k % 128 == 0 and big_k < 8192  # budget forces tiling
+    # the 128-up-rounding must not breach the budget cap (the tile
+    # search adds tiles until the rounded tile fits)
+    rows = fp._VMEM_TILE_BUDGET // (4 * (3 * 2048 + 2 * 128))
+    assert big_k <= max(rows // 8 * 8, 128)
+
+
+def test_pallas_fv_multi_tile_accumulation(monkeypatch):
+    """tiles>1 exercises the revolving-accumulator t-loop, the
+    128-multiple _tile_t branch, and the (1, 1, tile_t) mask index map
+    (none of which the single-tile tests touch).  The VMEM-budgeted cap
+    would cover these small test shapes in one tile, so the budget is
+    pinched to force tiling."""
+    from keystone_tpu.ops import fisher_pallas as fp
+
+    monkeypatch.setattr(fp, "_VMEM_TILE_BUDGET", 1 << 17)
+    for t in (1500, 2049):
+        tile = fp._tile_t(t, 8, 16)
+        assert tile % 128 == 0
         assert -(-t // tile) >= 2
         xs, mask, w, mu, var = _setup(t=t)
         ref = np.asarray(_fisher_encode(xs, mask, w, mu, var))

@@ -17,7 +17,11 @@ bench.py headline program (batch 128, SIFT bin 4 + smoothing, K=256 FV,
 Also prints total device-busy time per iteration vs the program's wall
 marginal time (the overlap/dispatch picture).
 
-Run on the chip:  python tools/roofline_forward.py [--json]
+Run on the chip:  python tools/roofline_forward.py [--json] [--ms]
+
+``--ms`` profiles the multi-scale vl_phow config instead (bins
+(4,6,8,10) + per-scale smoothing, batch 64 — the densest config the
+reference ran, and bench.py's second first-class forward metric).
 """
 from __future__ import annotations
 
@@ -37,6 +41,9 @@ from bench import (  # noqa: E402
     BATCH,
     GMM_K,
     IMAGE_HW,
+    MS_BATCH,
+    MS_BIN_SIZES,
+    MS_SMOOTHING,
     PCA_DIMS,
     SIFT_STEP,
     build_forward,
@@ -44,6 +51,10 @@ from bench import (  # noqa: E402
 )
 
 BIN_SIZE = 4  # the headline single-scale bin (build_forward default)
+_MS = "--ms" in sys.argv
+_RUN_BATCH = MS_BATCH if _MS else BATCH
+_BIN_SIZES = MS_BIN_SIZES if _MS else (BIN_SIZE,)
+_SMOOTHING = MS_SMOOTHING if _MS else None  # None → build_forward default
 
 TRACE_ITERS = 8
 #: v5e bf16-grade MXU peak and HBM stream peak — per-op bounds use the
@@ -61,10 +72,13 @@ def run_and_trace(logdir: str):
     from keystone_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
-    fwd = jax.jit(build_forward())
+    kw = {"bin_sizes": _BIN_SIZES}
+    if _SMOOTHING is not None:
+        kw["smoothing_magnif"] = _SMOOTHING
+    fwd = jax.jit(build_forward(**kw))
     x = jnp.asarray(
         np.random.default_rng(1)
-        .uniform(0, 1, (BATCH, 128, 128, 3))
+        .uniform(0, 1, (_RUN_BATCH, 128, 128, 3))
         .astype(np.float32)
     )
     for _ in range(3):
@@ -151,10 +165,10 @@ def main():
     # hardcoded 784.
     from keystone_tpu.ops.sift import sift_output_count
 
-    t_desc = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, (BIN_SIZE,))
+    t_desc = sift_output_count(IMAGE_HW, IMAGE_HW, SIFT_STEP, _BIN_SIZES)
     for name, r in rows.items():
         if "fisher" in name.lower() and r["flops"] == 0:
-            r["flops"] = 4 * 2 * t_desc * PCA_DIMS * GMM_K * BATCH
+            r["flops"] = 4 * 2 * t_desc * PCA_DIMS * GMM_K * _RUN_BATCH
             r["analytic_flops"] = True
 
     total_dev = sum(r["us_per_run"] for r in rows.values())
@@ -181,20 +195,20 @@ def main():
             }
         )
     result = {
-        "batch": BATCH,
+        "batch": _RUN_BATCH,
         "wall_marginal_us": round(wall * 1e6, 1),
         "device_busy_us": round(total_dev, 1),
         "overlap_or_gap_us": round(wall * 1e6 - total_dev, 1),
-        "images_per_sec": round(BATCH / wall, 1),
-        "analytic_flops_per_image": flops_per_image(),
+        "images_per_sec": round(_RUN_BATCH / wall, 1),
+        "analytic_flops_per_image": flops_per_image(_BIN_SIZES),
         "ops": out_rows,
     }
     if "--json" in sys.argv:
         print(json.dumps(result))
         return
     print(
-        f"batch={BATCH}  wall={wall*1e6:.0f}us/batch  device-busy="
-        f"{total_dev:.0f}us  ({BATCH/wall:,.0f} images/s)"
+        f"batch={_RUN_BATCH}  wall={wall*1e6:.0f}us/batch  device-busy="
+        f"{total_dev:.0f}us  ({_RUN_BATCH/wall:,.0f} images/s)"
     )
     print(
         f"{'op':<28}{'us':>7}{'%dev':>6}{'GF':>7}{'MB':>8}{'bound':>7}"
