@@ -386,14 +386,35 @@ class ColumnSampler(Transformer):
         if arr.ndim != 3:
             raise ValueError("ColumnSampler expects (n, max_k, d) descriptor sets")
         n = ds.n
-        out = _sample_descriptors(
-            arr,
-            ds.mask
-            if ds.mask is not None
-            else jnp.ones(arr.shape[:2], jnp.float32),
-            self.num_samples,
-            key,
-        )
+        from keystone_tpu.workflow.transformer import _apply_chunk_rows
+
+        chunk = _apply_chunk_rows()
+        if chunk and arr.shape[0] > chunk:
+            # fixed-shape row chunks with GLOBAL-index keys (exactly the
+            # stream path's offset sampling, so output is bit-identical
+            # to the whole-array program) — keeps the compiled program's
+            # shape independent of n (see Transformer._apply_dataset_chunked)
+            from keystone_tpu.workflow.transformer import iter_row_chunks
+
+            mask_full = (
+                ds.mask
+                if ds.mask is not None
+                else jnp.ones(arr.shape[:2], jnp.float32)
+            )
+            parts = [
+                _sample_descriptors(a, m, self.num_samples, key, offset=i)
+                for a, m, i in iter_row_chunks(arr, mask_full, chunk)
+            ]
+            out = jnp.concatenate(parts, axis=0)
+        else:
+            out = _sample_descriptors(
+                arr,
+                ds.mask
+                if ds.mask is not None
+                else jnp.ones(arr.shape[:2], jnp.float32),
+                self.num_samples,
+                key,
+            )
         flat = out[:n].reshape(n * self.num_samples, arr.shape[-1])
         return Dataset(flat)
 

@@ -26,6 +26,79 @@ from keystone_tpu.workflow.dataset import Dataset, as_dataset
 #: per-transformer jitted apply_batch wrappers (see _apply_batch_jitted)
 _JIT_APPLY_CACHE = weakref.WeakKeyDictionary()
 
+#: canonical apply chunk (rows); 0 = whole-batch applies (default).
+#: Chunking pins the compiled programs' shapes so they stop scaling
+#: with dataset size — the motivation is the measured ~1-3 s
+#: trace+cache-load per program per process, which recurs for every NEW
+#: n.  It ships OPT-IN (KEYSTONE_APPLY_CHUNK=2048): interleaved A/Bs on
+#: this environment's ±2-3× ambient drift could not demonstrate the
+#: warm-cache-neutral / cold-shape-win profile beyond noise
+#: (BASELINE.md r4 "chunked applies"), and the repo does not default
+#: optimizations it cannot measure.  Bit-parity with whole-batch
+#: applies is pinned by tests/test_workflow.py regardless.
+_APPLY_CHUNK_DEFAULT = 0
+
+
+def _apply_chunk_rows() -> int:
+    """Row-chunk size for device applies; 0 disables.
+
+    ``KEYSTONE_APPLY_CHUNK`` is a FORCE flag: it bypasses the
+    multi-device guard below (the mesh-sharded tests opt in through it
+    deliberately — a row slice of a sharded array pays per-chunk
+    resharding collectives, which is a performance hazard, not a
+    correctness one).  The default-path guard disables chunking
+    whenever the data mesh spans >1 device, where per-shard shapes are
+    already smaller."""
+    import os
+
+    env = os.environ.get("KEYSTONE_APPLY_CHUNK", "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KEYSTONE_APPLY_CHUNK=%r is not an integer; chunking "
+                "stays DISABLED",
+                env,
+            )
+            return 0
+    if not _APPLY_CHUNK_DEFAULT:
+        return 0
+    try:
+        from keystone_tpu.parallel.mesh import active_mesh
+
+        m = active_mesh()
+        if m is not None and m.devices.size > 1:
+            return 0
+    except Exception:
+        pass
+    try:
+        if len(jax.devices()) > 1:
+            return 0
+    except Exception:
+        return 0
+    return _APPLY_CHUNK_DEFAULT
+
+
+def iter_row_chunks(arr, mask, chunk: int):
+    """Yield ``(rows, mask_rows, start)`` in fixed-size row chunks, the
+    ragged tail PADDED UP to ``chunk`` (mask pad rows are zero — callers
+    slice outputs back to the true row count).  The single source of the
+    chunk/pad discipline shared by Transformer._apply_dataset_chunked
+    and ColumnSampler's offset-keyed chunked sampling — their bit-parity
+    guarantees both ride this one implementation."""
+    for i in range(0, arr.shape[0], chunk):
+        a = arr[i : i + chunk]
+        m = mask[i : i + chunk] if mask is not None else None
+        short = chunk - a.shape[0]
+        if short > 0:
+            a = jnp.pad(a, ((0, short),) + ((0, 0),) * (a.ndim - 1))
+            if m is not None:
+                m = jnp.pad(m, ((0, short),) + ((0, 0),) * (m.ndim - 1))
+        yield a, m, i
+
 
 class Chainable:
     """Mixin providing ``and_then`` / ``__or__`` composition sugar."""
@@ -120,10 +193,41 @@ class Transformer(Chainable):
                 except (TypeError, ValueError):
                     pass
             return ds.with_items(out)
+        chunk = _apply_chunk_rows()
+        if chunk and ds.array.shape[0] > chunk:
+            return self._apply_dataset_chunked(ds, chunk)
         result = self._apply_batch_jitted(ds.array, ds.mask)
         if isinstance(result, tuple):  # (values, mask) for ragged producers
             return ds.with_array(result[0], mask=result[1])
         return ds.with_array(result)
+
+    def _apply_dataset_chunked(self, ds: Dataset, chunk: int) -> Dataset:
+        """Apply in fixed-size row chunks (the ragged tail padded UP to
+        the canonical chunk, then sliced off) so the number of distinct
+        compiled programs stops scaling with dataset size: an n=8192 fit
+        re-traced and cache-loaded every stage at 8192-row shapes — the
+        measured ~60 s of a 79 s fit — where the 2048-row programs were
+        already warm from smaller runs.  Semantically free: transformer
+        apply IS a per-item map (apply_one is the contract), so chunk
+        boundaries cannot change any row.  Disabled on multi-device data
+        meshes (``_apply_chunk_rows`` → 0): a row slice of a sharded
+        array would trigger resharding collectives per chunk."""
+        arr, mask = ds.array, ds.mask
+        n0 = arr.shape[0]
+        vals, masks = [], []
+        for a, m, _start in iter_row_chunks(arr, mask, chunk):
+            r = self._apply_batch_jitted(a, m)
+            if isinstance(r, tuple):
+                vals.append(r[0])
+                masks.append(r[1])
+            else:
+                vals.append(r)
+        out = jnp.concatenate(vals, axis=0)[:n0]
+        if masks:
+            return ds.with_array(
+                out, mask=jnp.concatenate(masks, axis=0)[:n0]
+            )
+        return ds.with_array(out)
 
     def _apply_batch_jitted(self, xs, mask):
         """Run apply_batch as ONE compiled program.

@@ -258,6 +258,52 @@ def test_fit_re_fuses_chains_through_substituted_estimators():
     assert np.allclose(out, expect, atol=1e-5)
 
 
+def test_chunked_apply_matches_unchunked(monkeypatch):
+    """Row-chunked device applies (shape-stable programs — fit setup
+    cost stops scaling with n) must be bit-identical to the whole-batch
+    program: plain ops, ragged tails padded to the canonical chunk, and
+    ragged (values, mask) producers."""
+    monkeypatch.setenv("KEYSTONE_APPLY_CHUNK", "64")
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(205, 6)).astype(np.float32)  # 3 full + ragged tail
+    op = AddConst(1.5)
+    chunked = op.apply_dataset(Dataset(x))
+    monkeypatch.setenv("KEYSTONE_APPLY_CHUNK", "0")
+    whole = op.apply_dataset(Dataset(x))
+    np.testing.assert_array_equal(
+        np.asarray(chunked.array), np.asarray(whole.array)
+    )
+    assert chunked.n == whole.n
+
+
+def test_chunked_apply_ragged_producer_and_sampler(monkeypatch):
+    """SIFT (a (values, mask) producer) and ColumnSampler (global-index
+    keys) through the chunked path == unchunked, including the sampler's
+    offset-keyed chunks."""
+    from keystone_tpu.ops import ColumnSampler, SIFTExtractor
+
+    rng = np.random.default_rng(4)
+    imgs = rng.uniform(0, 1, (70, 40, 40)).astype(np.float32)
+    sift = SIFTExtractor(step=6, bin_sizes=(4,))
+    sampler = ColumnSampler(8, seed=3)
+
+    monkeypatch.setenv("KEYSTONE_APPLY_CHUNK", "32")
+    d1 = sift.apply_dataset(Dataset(imgs))
+    s1 = sampler.apply_dataset(d1)
+    monkeypatch.setenv("KEYSTONE_APPLY_CHUNK", "0")
+    d0 = sift.apply_dataset(Dataset(imgs))
+    s0 = sampler.apply_dataset(d0)
+    np.testing.assert_allclose(
+        np.asarray(d1.array), np.asarray(d0.array), atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(d1.mask), np.asarray(d0.mask)
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1.array), np.asarray(s0.array), atol=1e-6
+    )
+
+
 def test_host_transformer_path():
     up = transformer(lambda s: s.upper(), name="Upper", host=True)
     ds = Dataset(["ab", "cd"])
