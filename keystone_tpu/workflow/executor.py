@@ -124,14 +124,19 @@ class GraphExecutor:
         raise TypeError(f"unknown operator {op!r}")
 
 
-def block_on_arrays(obj, _seen=None, _depth=0) -> None:
+def block_on_arrays(obj, _seen=None, _depth=0, visit=None) -> None:
     """Block until every device array reachable from ``obj`` is computed.
 
     Transformers are plain objects, not pytrees, and solvers nest state
     (e.g. a model holding a scaler holding mean/std arrays) — a flat
     ``jax.tree.leaves(vars(t))`` walk stops at the nested object and
     misses its arrays, silently under-blocking.  This walks attributes,
-    containers, and dataclass-like objects recursively (cycle-safe)."""
+    containers, and dataclass-like objects recursively (cycle-safe).
+
+    ``visit``: optional callback applied to each device array INSTEAD of
+    blocking — FittedPipeline.read_back uses it to force a real
+    device→host read per array (axon's block_until_ready returns
+    without draining the stream)."""
     if _depth > 8:
         return
     if _seen is None:
@@ -140,7 +145,10 @@ def block_on_arrays(obj, _seen=None, _depth=0) -> None:
         return
     _seen.add(id(obj))
     if hasattr(obj, "block_until_ready"):
-        obj.block_until_ready()
+        if visit is not None:
+            visit(obj)
+        else:
+            obj.block_until_ready()
         return
     if isinstance(obj, dict):
         children = list(obj.values())
@@ -152,7 +160,7 @@ def block_on_arrays(obj, _seen=None, _depth=0) -> None:
         return
     for c in children:
         if c is not None and not isinstance(c, (str, bytes, int, float, bool)):
-            block_on_arrays(c, _seen, _depth + 1)
+            block_on_arrays(c, _seen, _depth + 1, visit=visit)
 
 
 def _sync_expr(result) -> None:

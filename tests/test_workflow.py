@@ -324,6 +324,17 @@ def test_save_load_fitted(tmp_path):
     assert np.allclose(a, b)
 
 
+def test_fitted_read_back_reads_every_fitted_array():
+    """read_back() must return one finite scalar per fitted device array
+    (the bench fit leg's run-end sync — a REAL device→host transfer,
+    robust to fusion wrapping because it walks nested state generically)."""
+    data = np.random.default_rng(5).normal(2.0, 1.0, (16, 4)).astype(np.float32)
+    fitted = AddConst(0.5).and_then(MeanShift(), Dataset(data)).fit()
+    scalars = fitted.read_back()
+    assert scalars.size >= 1  # at least the fitted mean
+    assert np.all(np.isfinite(scalars))
+
+
 def test_pipeline_datum_apply():
     p = AddConst(1.0) | AddConst(1.0)
     out = p.apply_datum(jnp.array([1.0, 2.0])).get()

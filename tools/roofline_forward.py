@@ -56,7 +56,10 @@ _RUN_BATCH = MS_BATCH if _MS else BATCH
 _BIN_SIZES = MS_BIN_SIZES if _MS else (BIN_SIZE,)
 _SMOOTHING = MS_SMOOTHING if _MS else None  # None → build_forward default
 if "--batch" in sys.argv:  # e.g. --batch 256: probe the large-batch decay
-    _RUN_BATCH = int(sys.argv[sys.argv.index("--batch") + 1])
+    _idx = sys.argv.index("--batch") + 1
+    if _idx >= len(sys.argv) or not sys.argv[_idx].isdigit():
+        sys.exit("usage: roofline_forward.py [--json] [--ms] [--batch N]")
+    _RUN_BATCH = int(sys.argv[_idx])
 
 TRACE_ITERS = 8
 #: v5e bf16-grade MXU peak and HBM stream peak — per-op bounds use the
