@@ -55,6 +55,11 @@ class BlockLinearMapper(Transformer):
     (nodes/learning/BlockLinearMapper.scala).  ``weights`` is
     (num_blocks, block_size, k)."""
 
+    traced_attrs = ("weights", "intercept", "feature_mean")
+
+    def jit_static(self):
+        return (self.block_size,)
+
     def __init__(
         self,
         weights: jnp.ndarray,

@@ -49,6 +49,8 @@ class GaussianMixtureModel(Transformer):
     """Posterior responsibilities transformer; carries (weights, means,
     variances) for Fisher-vector encoding."""
 
+    traced_attrs = ("weights", "means", "variances")
+
     def __init__(self, weights, means, variances):
         self.weights = weights  # (K,)
         self.means = means  # (K, d)
@@ -68,6 +70,18 @@ class GaussianMixtureModel(Transformer):
 
     def apply_one(self, x):
         return self.apply_batch(x[None])[0]
+
+
+# Pytree registration lets a fitted GMM ride as a TRACED jit argument
+# (FisherVector.traced_attrs carries the whole model object), so its
+# arrays are never embedded as program constants — see
+# Transformer.traced_attrs for the measured lowering/compile-cache cost
+# of device-array closure constants.
+jax.tree_util.register_pytree_node(
+    GaussianMixtureModel,
+    lambda g: ((g.weights, g.means, g.variances), None),
+    lambda _, c: GaussianMixtureModel(*c),
+)
 
 
 class GaussianMixtureModelEstimator(Estimator):

@@ -37,6 +37,8 @@ class KMeansModel(Transformer):
     """Emits one-hot nearest-center assignment (KMeansPlusPlus.scala §
     KMeansModel.apply)."""
 
+    traced_attrs = ("centers",)
+
     def __init__(self, centers: jnp.ndarray):
         self.centers = centers  # (k, d)
 

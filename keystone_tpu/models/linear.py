@@ -33,6 +33,8 @@ from keystone_tpu.utils.precision import sdot
 class LinearMapper(Transformer):
     """Applies ``xW + b`` (nodes/learning/LinearMapper.scala § LinearMapper)."""
 
+    traced_attrs = ("weights", "intercept")
+
     def __init__(self, weights: jnp.ndarray, intercept: Optional[jnp.ndarray] = None):
         self.weights = weights
         self.intercept = intercept

@@ -24,6 +24,8 @@ from keystone_tpu.workflow.transformer import Transformer
 
 
 class LogisticRegressionModel(Transformer):
+    traced_attrs = ("weights",)
+
     def __init__(self, weights: jnp.ndarray):
         self.weights = weights  # (d, K)
 

@@ -23,6 +23,8 @@ from keystone_tpu.workflow.transformer import Transformer
 
 
 class NaiveBayesModel(Transformer):
+    traced_attrs = ("log_prior", "log_cond")
+
     def __init__(self, log_prior: jnp.ndarray, log_cond: jnp.ndarray):
         self.log_prior = log_prior  # (K,)
         self.log_cond = log_cond  # (K, d)

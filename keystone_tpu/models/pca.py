@@ -29,6 +29,11 @@ from keystone_tpu.utils.precision import fcast, sdot
 class PCATransformer(Transformer):
     """Projects onto the top-k principal directions: x ↦ (x − μ)·C."""
 
+    # fitted arrays ride as traced jit arguments: both branch PCAs share
+    # one compiled program per shape, and lowering never reads the
+    # components back over the tunnel (Transformer.traced_attrs)
+    traced_attrs = ("components", "mean")
+
     def __init__(self, components: jnp.ndarray, mean: Optional[jnp.ndarray] = None):
         self.components = components  # (d, k)
         self.mean = mean

@@ -20,6 +20,8 @@ from keystone_tpu.utils.precision import sdot
 
 
 class ZCAWhitener(Transformer):
+    traced_attrs = ("whitener", "mean")
+
     def __init__(self, whitener: jnp.ndarray, mean: jnp.ndarray):
         self.whitener = whitener  # (d, d)
         self.mean = mean  # (d,)

@@ -49,11 +49,19 @@ class FisherVector(Transformer):
     # per-image γ elements above which the fused kernel measurably wins
     _PALLAS_GAMMA_THRESHOLD = 32768
 
+    # the fitted GMM (a registered pytree) rides as a traced argument:
+    # both branch FV nodes share one compiled encode per shape, and the
+    # vocabulary is never read back at lowering time
+    traced_attrs = ("gmm",)
+
     def __init__(
         self, gmm: GaussianMixtureModel, use_pallas: Optional[bool] = None
     ):
         self.gmm = gmm
         self.use_pallas = use_pallas
+
+    def jit_static(self):
+        return (self.use_pallas,)
 
     def params(self):
         from keystone_tpu.utils.hashing import cached_fingerprint

@@ -170,6 +170,8 @@ class NormalizeRows(Transformer):
 
 
 class StandardScalerModel(Transformer):
+    traced_attrs = ("mean", "std")
+
     def __init__(self, mean: jnp.ndarray, std: Optional[jnp.ndarray] = None):
         self.mean = mean
         self.std = std
@@ -406,16 +408,15 @@ class ColumnSampler(Transformer):
                 for a, m, i in iter_row_chunks(arr, mask_full, chunk)
             ]
             out = jnp.concatenate(parts, axis=0)
+            flat = out[:n].reshape(n * self.num_samples, arr.shape[-1])
         else:
-            out = _sample_descriptors(
-                arr,
-                ds.mask
-                if ds.mask is not None
-                else jnp.ones(arr.shape[:2], jnp.float32),
-                self.num_samples,
-                key,
+            # sample + slice-to-true-rows + flatten as ONE program: the
+            # eager slice/reshape at (n, max_k, d) scale compiled two
+            # extra (0.1-1.4 s) programs per sampler per process
+            # (BASELINE.md r5 fit-floor split)
+            flat = _sample_descriptors_flat(
+                arr, ds.mask, self.num_samples, key, n_true=n
             )
-        flat = out[:n].reshape(n * self.num_samples, arr.shape[-1])
         return Dataset(flat)
 
     def apply_one(self, x):
@@ -423,6 +424,16 @@ class ColumnSampler(Transformer):
 
 
 from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("k", "n_true"))
+def _sample_descriptors_flat(arr, mask, k, key, n_true):
+    """In-memory sampler fast path: mask default, sampling, true-row
+    slice, and the flat reshape fused into one jit program."""
+    if mask is None:
+        mask = jnp.ones(arr.shape[:2], jnp.float32)
+    out = _sample_descriptors(arr, mask, k, key)
+    return out[:n_true].reshape(n_true * k, arr.shape[-1])
 
 
 @_partial(jax.jit, static_argnames=("k",))

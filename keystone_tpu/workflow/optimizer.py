@@ -289,10 +289,37 @@ def _truncate_datasets(graph: G.Graph, k: int) -> G.Graph:
 
 
 # ------------------------------------------------------------- stage fusion
+
+#: class-shared jitted fused chains, keyed by (per-stage share keys,
+#: matmul mode) — see FusedTransformer._share_key
+_FUSED_SHARED_CACHE: dict = {}
+
+
+def _stage_share_key(s: Transformer):
+    """Identity of one stage for cross-instance program sharing.
+
+    Stages declaring traced_attrs share by (class, jit_static) with
+    their arrays passed as traced arguments; stages without share by
+    (class, params()) — the CSE contract already promises params()
+    fully identifies such a transformer.  None = not shareable (params()
+    is None), which disables sharing for the whole chain."""
+    ta = type(s).traced_attrs
+    if ta:
+        st = s.jit_static()
+        return None if st is None else ("T", type(s), st)
+    p = s.params()
+    return None if p is None else ("C", type(s), p)
+
+
 class FusedTransformer(Transformer):
     """A maximal linear chain of device transformers compiled as ONE jit
     stage.  This is the TPU replacement for the reference's per-node
     ``rdd.map`` chain: stage boundaries = jit boundaries (SURVEY.md §7)."""
+
+    # apply_batch manages its own program caches below; the generic
+    # per-instance jit wrapper must not add an outer jit, or the shared
+    # chain's traced stage parameters become outer-program constants
+    self_jitted = True
 
     def __init__(self, stages: Sequence[Transformer]):
         self.stages = list(stages)
@@ -327,6 +354,25 @@ class FusedTransformer(Transformer):
         from keystone_tpu.utils import precision
 
         mode = precision.matmul_mode()
+        skeys = tuple(_stage_share_key(s) for s in self.stages)
+        if all(k is not None for k in skeys):
+            # the input signature scopes the untraceable memo (one odd
+            # dtype/rank must not pin every later call of the chain to
+            # the per-instance path — same discipline as
+            # Transformer._apply_batch_jitted)
+            from keystone_tpu.workflow.transformer import traced_param_sig
+
+            ckey = (
+                skeys,
+                mode,
+                str(getattr(xs, "dtype", "")),
+                getattr(xs, "ndim", None),
+                tuple(traced_param_sig(s) for s in self.stages),
+            )
+            try:
+                return self._apply_shared(ckey, xs)
+            except (TypeError, jax.errors.JAXTypeError):
+                _FUSED_SHARED_CACHE[ckey] = None
         fn = self._jitted.get(mode)
         if fn is None:
             stages = list(self.stages)
@@ -338,6 +384,43 @@ class FusedTransformer(Transformer):
 
             fn = self._jitted[mode] = jax.jit(run)
         return fn(xs)
+
+    def _apply_shared(self, ckey, xs):
+        """Cross-instance shared jitted chain: stage parameters ride as
+        traced arguments (Transformer.traced_attrs), so e.g. the two
+        branch tails Fused[SignedHellinger > NormalizeRows] compile ONCE
+        and refits never invalidate the persistent compile cache."""
+        import copy
+
+        sentinel = object()
+        entry = _FUSED_SHARED_CACHE.get(ckey, sentinel)
+        if entry is None:  # memoized untraceable for this chain+signature
+            raise TypeError("fused chain memoized untraceable")  # caller falls back
+        if entry is sentinel:
+            # Bound the cache: chains whose stage params() embed per-fit
+            # fingerprints mint a fresh key every refit, and each entry's
+            # templates pin that fit's non-traced arrays.  FIFO-evict —
+            # an evicted-but-live chain just rebuilds its entry.
+            while len(_FUSED_SHARED_CACHE) >= 128:
+                _FUSED_SHARED_CACHE.pop(next(iter(_FUSED_SHARED_CACHE)))
+            from keystone_tpu.workflow.transformer import stripped_template
+
+            templates = [stripped_template(s) for s in self.stages]
+
+            def run(plist, arr):
+                for t, p in zip(templates, plist):
+                    obj = copy.copy(t)
+                    for name, v in p.items():
+                        setattr(obj, name, v)
+                    arr = obj.apply_batch(arr)
+                return arr
+
+            entry = _FUSED_SHARED_CACHE[ckey] = jax.jit(run)
+        plist = [
+            {name: getattr(s, name) for name in type(s).traced_attrs}
+            for s in self.stages
+        ]
+        return entry(plist, xs)
 
 
 class StageFusionRule(Rule):

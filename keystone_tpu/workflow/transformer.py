@@ -20,11 +20,62 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.workflow.dataset import Dataset, as_dataset
 
 #: per-transformer jitted apply_batch wrappers (see _apply_batch_jitted)
 _JIT_APPLY_CACHE = weakref.WeakKeyDictionary()
+
+#: CLASS-shared jitted applies for transformers declaring traced_attrs:
+#: (cls, jit_static(), input signature, param signature) -> jitted fn
+#: (or None = memoized untraceable for that exact signature).  Values
+#: hold parameter-stripped template copies, never fitted arrays.
+_SHARED_APPLY_CACHE: dict = {}
+
+
+def stripped_template(t: "Transformer") -> "Transformer":
+    """Shallow copy of ``t`` safe to pin in a process-lifetime shared
+    cache: traced_attrs are nulled (they arrive as traced arguments),
+    and derived caches that hold strong refs to fitted arrays — the
+    cached_fingerprint attr (``_fp``) and per-instance jit dicts — are
+    dropped, or the template would pin the first fit's arrays forever.
+    The single source for both shared-apply sites (Transformer and
+    FusedTransformer)."""
+    import copy
+
+    tpl = copy.copy(t)
+    for name in type(t).traced_attrs:
+        setattr(tpl, name, None)
+    for derived in ("_fp", "_jitted"):
+        if derived in getattr(tpl, "__dict__", {}):
+            try:
+                delattr(tpl, derived)
+            except AttributeError:
+                pass
+    return tpl
+
+
+def traced_param_sig(t: "Transformer") -> tuple:
+    """Hashable structure signature of an instance's traced parameters
+    (pytree treedef + leaf dtypes per attr).  Part of the shared-cache
+    key, so an instance whose parameter VALUES cannot trace poisons only
+    its own signature — never the whole class."""
+    sig = []
+    for name in type(t).traced_attrs:
+        v = getattr(t, name)
+        if v is None:
+            sig.append((name, None))
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(v)
+            sig.append(
+                (
+                    name,
+                    str(treedef),
+                    tuple(str(getattr(x, "dtype", type(x).__name__)) for x in leaves),
+                )
+            )
+    return tuple(sig)
 
 #: canonical apply chunk (rows); 0 = whole-batch applies (default).
 #: Chunking pins the compiled programs' shapes so they stop scaling
@@ -118,6 +169,25 @@ class Transformer(Chainable):
     #: host ops whose per-item work is trivial (a str method) opt OUT of
     #: the host_map worker pool — IPC would dwarf the work
     parallel_host: bool = True
+    #: Names of array-valued (or None) instance attributes passed as
+    #: TRACED arguments to a class-shared jitted apply_batch, so every
+    #: instance of the class shares ONE compiled program per input
+    #: signature.  Two measured wins (BASELINE.md r5 "traced-parameter
+    #: applies"): N instances stop tracing/compiling N duplicate
+    #: programs, and fitted device arrays stop being closure constants —
+    #: jax lowering reads every closed-over device array back to host
+    #: (~0.4 s tunnel RTT per array here, stacking to the fit's 4.7 s
+    #: worst node), and embedding VALUES keys the persistent compile
+    #: cache by the fit's bits, so every refit recompiled from scratch.
+    #: Declaring classes must route every OTHER attribute that shapes
+    #: the trace through jit_static().  Empty = per-instance programs.
+    traced_attrs: tuple = ()
+    #: True for transformers whose apply_batch manages its OWN jit and
+    #: program cache (FusedTransformer).  The generic per-instance jit
+    #: wrapper must NOT wrap these: an outer per-instance jit would
+    #: inline the inner program and embed its traced stage parameters
+    #: as outer-program constants, nullifying cross-instance sharing.
+    self_jitted: bool = False
 
     @property
     def label(self) -> str:
@@ -131,6 +201,12 @@ class Transformer(Chainable):
     def signature(self):
         p = self.params()
         return None if p is None else (type(self).__name__, p)
+
+    def jit_static(self):
+        """Hashable key covering every non-traced attribute that affects
+        apply_batch's trace structure; part of the shared-program cache
+        key for classes declaring traced_attrs."""
+        return ()
 
     # Optimizer hook: physical-operator choice (workflow/NodeOptimizationRule).
     def choose_physical(self, sample) -> "Transformer":
@@ -247,6 +323,8 @@ class Transformer(Chainable):
         instance to the eager path."""
         from keystone_tpu.utils import precision
 
+        if type(self).self_jitted:
+            return self.apply_batch(xs, mask=mask)
         # Keyed by (mode, dtype, rank, mask-presence) — NOT concrete shapes:
         # jit itself retraces per shape under one wrapper, and traceability
         # failures are dtype/mask/structure-driven, so a shape-keyed memo
@@ -257,6 +335,8 @@ class Transformer(Chainable):
             getattr(xs, "ndim", None),
             None if mask is None else str(getattr(mask, "dtype", "")),
         )
+        if type(self).traced_attrs:
+            return self._apply_batch_shared(xs, mask, sig)
         entry = _JIT_APPLY_CACHE.get(self)
         if entry is None:
             entry = {}
@@ -277,6 +357,63 @@ class Transformer(Chainable):
             return fn(xs, mask)
         except (TypeError, jax.errors.JAXTypeError):
             entry[sig] = None  # don't re-pay a failed trace for this sig
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s.apply_batch is untraceable for signature %s; using the "
+                "eager path (hazardous on the axon backend for FFT ops)",
+                self.label,
+                sig,
+            )
+            return self.apply_batch(xs, mask=mask)
+
+    def _apply_batch_shared(self, xs, mask, sig):
+        """Class-shared jitted apply for traced_attrs declarers.
+
+        The jitted callable closes over a parameter-STRIPPED template
+        copy of the first instance seen per (class, jit_static) key and
+        rebinds the traced attributes to tracer values at trace time —
+        so the compiled program is a pure function of parameter shapes,
+        shared by every instance and every refit."""
+        import copy
+
+        cls = type(self)
+        params = {}
+        for name in cls.traced_attrs:
+            v = getattr(self, name)
+            if v is not None and any(
+                isinstance(leaf, np.ndarray)
+                for leaf in jax.tree_util.tree_leaves(v)
+            ):
+                # host-resident parameters (e.g. an unpickled model, or
+                # a pytree like FisherVector.gmm holding numpy arrays)
+                # would re-transfer on EVERY call as jit arguments;
+                # commit them to device once, on the instance
+                v = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a,
+                    v,
+                )
+                setattr(self, name, v)
+            params[name] = v
+        key = (cls, self.jit_static(), sig, traced_param_sig(self))
+        sentinel = object()
+        fn = _SHARED_APPLY_CACHE.get(key, sentinel)
+        if fn is None:  # memoized "untraceable" for this exact signature
+            return self.apply_batch(xs, mask=mask)
+        if fn is sentinel:
+            template = stripped_template(self)
+
+            def run(p, a, m):
+                obj = copy.copy(template)
+                for name, v in p.items():
+                    setattr(obj, name, v)
+                return obj.apply_batch(a, mask=m)
+
+            fn = _SHARED_APPLY_CACHE[key] = jax.jit(run)
+        try:
+            return fn(params, xs, mask)
+        except (TypeError, jax.errors.JAXTypeError):
+            _SHARED_APPLY_CACHE[key] = None
             import logging
 
             logging.getLogger(__name__).warning(

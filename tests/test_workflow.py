@@ -362,3 +362,125 @@ def test_save_load_fitted_after_apply(tmp_path):
     fitted.save(path)
     loaded = FittedPipeline.load(path)
     np.testing.assert_allclose(loaded(x).get().numpy(), before, atol=1e-6)
+
+
+# ------------------------------------------------- traced-parameter applies
+def test_traced_params_share_one_program_across_instances():
+    """Two PCATransformers (different fitted values, same shapes) must
+    share ONE compiled program: parameters ride as traced arguments
+    (Transformer.traced_attrs), so lowering never embeds fitted device
+    arrays as constants — the measured ~0.4 s/array tunnel read and the
+    refit-recompiles-everything cache-key hazard (BASELINE.md r5)."""
+    import importlib
+
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.workflow.dataset import Dataset
+
+    # the workflow package re-exports the `transformer` DECORATOR under
+    # the module's name, so attribute-style imports get the function
+    T = importlib.import_module("keystone_tpu.workflow.transformer")
+
+    # hermetic: earlier tests may have populated PCA entries for other
+    # input signatures (bf16 mode, masked applies)
+    for k in [k for k in T._SHARED_APPLY_CACHE if k[0] is PCATransformer]:
+        del T._SHARED_APPLY_CACHE[k]
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 12)).astype(np.float32)
+    c1 = rng.normal(size=(12, 3)).astype(np.float32)
+    c2 = rng.normal(size=(12, 3)).astype(np.float32)
+    m1 = rng.normal(size=(12,)).astype(np.float32)
+    p1 = PCATransformer(jnp.asarray(c1), jnp.asarray(m1))
+    p2 = PCATransformer(jnp.asarray(c2), None)
+
+    y1 = np.asarray(p1.apply_dataset(Dataset(xs, shard=False)).array)
+    y2 = np.asarray(p2.apply_dataset(Dataset(xs, shard=False)).array)
+    np.testing.assert_allclose(y1, (xs - m1) @ c1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(y2, xs @ c2, rtol=1e-5, atol=1e-5)
+
+    # one shared wrapper per parameter STRUCTURE (mean present vs absent
+    # key separately so a bad instance poisons only its own signature);
+    # instances with equal structure share one wrapper and one program
+    keys = [k for k in T._SHARED_APPLY_CACHE if k[0] is PCATransformer]
+    assert len(keys) == 2
+    key2 = [k for k in keys if k[3] == T.traced_param_sig(p2)]
+    assert len(key2) == 1
+    fn = T._SHARED_APPLY_CACHE[key2[0]]
+    # a third instance with the SAME structure as p2 must hit the cache,
+    # not grow it
+    sizes = fn._cache_size()
+    p3 = PCATransformer(jnp.asarray(c1), None)
+    y3 = np.asarray(p3.apply_dataset(Dataset(xs, shard=False)).array)
+    np.testing.assert_allclose(y3, xs @ c1, rtol=1e-5, atol=1e-5)
+    assert fn._cache_size() == sizes
+    # the process-lifetime template must not pin fitted arrays (review:
+    # fingerprint caches ride shallow copies)
+    tpl = T.stripped_template(p1)
+    assert tpl.components is None and tpl.mean is None
+    assert "_fp" not in vars(tpl)
+
+
+def test_traced_params_refit_uses_new_values():
+    """The shared program must read the INSTANCE's current parameters —
+    a stale closure constant would silently score with the old fit."""
+    from keystone_tpu.models.linear import LinearMapper
+    from keystone_tpu.workflow.dataset import Dataset
+
+    xs = np.eye(4, dtype=np.float32)
+    w1 = np.full((4, 2), 2.0, np.float32)
+    w2 = np.full((4, 2), 5.0, np.float32)
+    out1 = np.asarray(LinearMapper(jnp.asarray(w1)).apply_dataset(
+        Dataset(xs, shard=False)).array)
+    out2 = np.asarray(LinearMapper(jnp.asarray(w2)).apply_dataset(
+        Dataset(xs, shard=False)).array)
+    np.testing.assert_allclose(out1, xs @ w1)
+    np.testing.assert_allclose(out2, xs @ w2)
+
+
+def test_fused_chain_shares_program_across_instances():
+    """Two FusedTransformer instances with identical stage identities
+    (class+params) share one compiled chain (optimizer._FUSED_SHARED_CACHE)."""
+    from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.workflow import optimizer as O
+
+    O._FUSED_SHARED_CACHE.clear()  # hermetic: identify OUR chain's entry
+    f1 = O.FusedTransformer([SignedHellingerMapper(), NormalizeRows()])
+    f2 = O.FusedTransformer([SignedHellingerMapper(), NormalizeRows()])
+    xs = jnp.asarray(np.random.default_rng(1).normal(size=(4, 6)), jnp.float32)
+    y1 = f1.apply_batch(xs)
+    target = [
+        k
+        for k, v in O._FUSED_SHARED_CACHE.items()
+        if callable(v) and k[0][0][1] is SignedHellingerMapper
+    ]
+    assert target, "fused chain did not take the shared path"
+    fn = O._FUSED_SHARED_CACHE[target[0]]
+    size = fn._cache_size()
+    y2 = f2.apply_batch(xs)
+    assert fn._cache_size() == size  # second instance reused the program
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+    # and the executor path must ride the same shared program — the
+    # per-instance outer jit would otherwise inline it with the stage
+    # parameters embedded as constants (self_jitted bypass)
+    from keystone_tpu.workflow.dataset import Dataset
+
+    y3 = f2.apply_dataset(Dataset(np.asarray(xs), shard=False)).array
+    assert fn._cache_size() == size
+    np.testing.assert_allclose(np.asarray(y3), np.asarray(y1), rtol=1e-6)
+
+
+def test_fused_chain_with_traced_stage_params():
+    """A fused chain containing a traced_attrs stage (PCA) passes the
+    stage's arrays as arguments and still matches the eager compose."""
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.ops.stats import NormalizeRows
+    from keystone_tpu.workflow import optimizer as O
+
+    rng = np.random.default_rng(2)
+    xs = jnp.asarray(rng.normal(size=(5, 8)), jnp.float32)
+    comp = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+    pca = PCATransformer(comp, None)
+    fused = O.FusedTransformer([pca, NormalizeRows()])
+    got = np.asarray(fused.apply_batch(xs))
+    want = np.asarray(NormalizeRows().apply_batch(pca.apply_batch(xs)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
