@@ -47,13 +47,26 @@ def _blur_matrix(extent: int, sigma: float, truncate: float = 3.0) -> np.ndarray
     return b
 
 
+#: image extent above which the banded-matmul blur falls back to the
+#: conv form: the dense (extent, extent) operator makes the matmul pass
+#: O(extent³) per axis vs the conv's O(k·extent²), and the measured win
+#: (BASELINE.md r4) is at 128 px where the conv emitter's fixed costs
+#: dominate.  512 px keeps the matmul pass within ~4 GF/axis/image —
+#: still cheap MXU work — while callers on larger maps (e.g. DAISY on
+#: full-resolution inputs) keep the byte-bound conv (ADVICE r4).
+_MATMUL_BLUR_MAX_EXTENT = 512
+
+
 def separable_gaussian_blur(x, sigma: float, strategy: str = "matmul"):
     """Separable Gaussian blur of (n, h, w, c) maps.
 
     SAME zero padding (matches scipy ``mode="constant"``); accumulation
     in f32 regardless of input dtype.  ``strategy="matmul"`` (default)
-    runs the two 1-D passes as banded-matrix einsums on the MXU;
-    ``"conv"`` keeps the depthwise-conv form (parity reference)."""
+    runs the two 1-D passes as banded-matrix einsums on the MXU, falling
+    back to conv above ``_MATMUL_BLUR_MAX_EXTENT``; ``"conv"`` keeps the
+    depthwise-conv form (parity reference)."""
+    if strategy == "matmul" and max(x.shape[1], x.shape[2]) > _MATMUL_BLUR_MAX_EXTENT:
+        strategy = "conv"
     if strategy == "matmul":
         h, w = x.shape[1], x.shape[2]
         bh = jnp.asarray(_blur_matrix(h, float(sigma)))

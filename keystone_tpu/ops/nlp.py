@@ -155,6 +155,9 @@ class CommonSparseFeaturesModel(Transformer):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(ds, StreamDataset) and ds.is_host:
+            native = self._apply_native_stream(ds)
+            if native is not None:
+                return native
             return _featurize_host_stream(self, ds)
         from keystone_tpu.utils.hostmap import host_map
 
@@ -162,6 +165,40 @@ class CommonSparseFeaturesModel(Transformer):
             return ds.with_items(host_map(self.apply_one, ds.items))
         rows = np.stack(host_map(self.apply_one, ds.items))
         return Dataset(rows)
+
+    def __getstate__(self):
+        # the packed-vocab blob is a multi-MB derived cache (native fast
+        # path); saved models must not duplicate the vocab dict with it
+        state = self.__dict__.copy()
+        state.pop("_native_vocab", None)
+        return state
+
+    def _apply_native_stream(self, ds):
+        """Fused C++ featurize straight from the RAW doc stream when the
+        host-chain provenance matches (ops/nlp_native); None = Python
+        path.  Mirrors _featurize_host_stream's payload contract: sparse
+        → lazy host stream of CSR rows, dense → device stream."""
+        from keystone_tpu.ops import nlp_native
+
+        chain = getattr(ds, "_host_chain", None)
+        if chain is None or not nlp_native.available():
+            return None
+        cfg = nlp_native.chain_config(chain[1])
+        if cfg is None:
+            return None
+        if not hasattr(self, "_native_vocab"):
+            self._native_vocab = nlp_native.pack_vocab(self.vocab)
+        blob, offs, vsize = self._native_vocab
+        base, nf, sparse = chain[0], self.num_features, self.sparse_output
+
+        def fn(batch, _mask):
+            if batch and not isinstance(batch[0], str):
+                raise TypeError("native text path expects raw doc strings")
+            return nlp_native.featurize_docs(
+                batch, blob, offs, vsize, cfg, nf, sparse
+            )
+
+        return base.map_batches(fn, host=True if sparse else False)
 
 
 def _featurize_host_stream(model, ds):
@@ -199,6 +236,9 @@ class CommonSparseFeatures(Estimator):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(data, StreamDataset) and data.is_host:
+            native = self._fit_native_stream(data)
+            if native is not None:
+                return native
             # streaming document-frequency pass: one sweep, Counter-sized
             # state — the raw corpus never materializes (fit_arrays
             # consumes any iterable, so feed it the stream lazily)
@@ -206,6 +246,35 @@ class CommonSparseFeatures(Estimator):
                 d for batch in data.batches() for d in batch
             )
         return self.fit_arrays(data.items)
+
+    def _fit_native_stream(self, data) -> Optional[CommonSparseFeaturesModel]:
+        """Native df sweep over the RAW doc stream when this stream's
+        host-chain provenance matches the fused C++ path (ops/nlp_native
+        — skips every intermediate Python token list / term dict).
+        Returns None to use the Python path.  Tie-break divergence is
+        documented in nlp_native's module docstring."""
+        from keystone_tpu.ops import nlp_native
+
+        chain = getattr(data, "_host_chain", None)
+        if chain is None or not nlp_native.available():
+            return None
+        cfg = nlp_native.chain_config(chain[1])
+        if cfg is None:
+            return None
+        base = chain[0]
+        acc = nlp_native.DfAccumulator(cfg)
+        try:
+            for batch in base.batches():
+                if batch and not isinstance(batch[0], str):
+                    return None  # base stream is not raw text
+                acc.update(batch)
+            top = acc.topn(self.num_features)
+        finally:
+            acc.close()
+        vocab = {t: i for i, (t, _) in enumerate(top)}
+        return CommonSparseFeaturesModel(
+            vocab, self.num_features, self.sparse_output
+        )
 
     def fit_arrays(self, docs: Iterable[Dict]) -> CommonSparseFeaturesModel:
         df: Counter = Counter()

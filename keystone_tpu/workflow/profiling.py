@@ -190,9 +190,19 @@ def device_hbm_budget(fraction: float = 0.5) -> int:
     Reads the backend's memory stats (HBM ``bytes_limit``); ``fraction``
     leaves headroom for solver state and XLA temporaries.  Falls back to
     8 GiB (half a v5-lite HBM) when the backend exposes no stats (CPU
-    test meshes)."""
+    test meshes).  ``KEYSTONE_HBM_BUDGET_BYTES`` overrides the device
+    limit (before ``fraction``) — the auto-out-of-core tests use it to
+    provoke the over-budget path on small data."""
+    import os
+
     import jax
 
+    env = os.environ.get("KEYSTONE_HBM_BUDGET_BYTES", "").strip()
+    if env:
+        try:
+            return int(int(env) * fraction)
+        except ValueError:
+            logger.warning("KEYSTONE_HBM_BUDGET_BYTES=%r is not an int", env)
     try:
         stats = jax.devices()[0].memory_stats() or {}
         limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
@@ -202,6 +212,14 @@ def device_hbm_budget(fraction: float = 0.5) -> int:
         pass
     # no stats (axon/CPU): assume a 16 GiB v5e-class device
     return int((16 << 30) * fraction)
+
+
+#: Footprint estimate of the LAST ProfilingAutoCacheRule pass, read by
+#: Pipeline.fit's auto-out-of-core decision (workflow/pipeline.py §
+#: _auto_out_of_core).  A module global rather than a graph annotation:
+#: rule batches rebuild Graph instances, so an annotation would not
+#: survive the fusion pass that runs after materialization.
+last_footprint: dict = {}
 
 
 class ProfilingAutoCacheRule(Rule):
@@ -223,6 +241,10 @@ class ProfilingAutoCacheRule(Rule):
         self.static_cost = bool(static_cost)
 
     def apply(self, graph: G.Graph) -> G.Graph:
+        # a PREVIOUS fit's estimate must never leak into this fit's
+        # auto-out-of-core decision (fallback/early-return paths would
+        # otherwise leave it standing — review r5)
+        last_footprint.clear()
         shared = [
             n
             for n in graph.topological_nodes()
@@ -252,9 +274,11 @@ class ProfilingAutoCacheRule(Rule):
             )
         )
         remaining = self.budget_bytes
+        shared_bytes = 0
         for n in shared:
             prof = profiles.get(n)
             cost = prof.full_bytes if prof else 0
+            shared_bytes += cost
             if cost <= remaining:
                 remaining -= cost
                 graph = _insert_cacher(graph, n)
@@ -271,6 +295,15 @@ class ProfilingAutoCacheRule(Rule):
                     flagged = G.TransformerOperator(op.transformer)
                     flagged.no_memoize = True
                     graph = graph.set_operator(n, flagged)
+        # record the pass's byte estimates for the auto-out-of-core
+        # decision (fit-time pre-flight in workflow/pipeline.py)
+        last_footprint.clear()
+        last_footprint.update(
+            {
+                "shared_bytes": int(shared_bytes),
+                "budget_bytes": int(self.budget_bytes),
+            }
+        )
         return graph
 
 

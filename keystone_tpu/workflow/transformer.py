@@ -77,17 +77,21 @@ def traced_param_sig(t: "Transformer") -> tuple:
             )
     return tuple(sig)
 
-#: canonical apply chunk (rows); 0 = whole-batch applies (default).
+#: canonical apply chunk (rows); 0 = whole-batch applies.
 #: Chunking pins the compiled programs' shapes so they stop scaling
-#: with dataset size — the motivation is the measured ~1-3 s
-#: trace+cache-load per program per process, which recurs for every NEW
-#: n.  It ships OPT-IN (KEYSTONE_APPLY_CHUNK=2048): interleaved A/Bs on
-#: this environment's ±2-3× ambient drift could not demonstrate the
-#: warm-cache-neutral / cold-shape-win profile beyond noise
-#: (BASELINE.md r4 "chunked applies"), and the repo does not default
-#: optimizations it cannot measure.  Bit-parity with whole-batch
-#: applies is pinned by tests/test_workflow.py regardless.
-_APPLY_CHUNK_DEFAULT = 0
+#: with dataset size.  DEFAULT ON since r5, decided by program COUNT
+#: (VERDICT r4 item 4 — wall clock was the wrong instrument under this
+#: environment's ambient drift): at a NEW dataset size n=8192, the
+#: chunked fit ran 88/88 programs from the persistent compile cache
+#: (ZERO cold compiles; wall 44.6 s → 11.5 s) where the unchunked fit
+#: paid 9 cold full-shape compiles; n=4096 cold-shape: 29 (one-time
+#: chunk plumbing) vs 46 misses and 79.5 s → 50.7 s (BASELINE.md r5
+#: "chunked applies by program count").  The warm bench-fit path
+#: (n=2048 ≤ chunk) takes the whole-batch branch and is unaffected.
+#: Bit-parity with whole-batch applies is pinned by
+#: tests/test_workflow.py; multi-device meshes still disable chunking
+#: (per-chunk resharding collectives — see _apply_chunk_rows).
+_APPLY_CHUNK_DEFAULT = 2048
 
 
 def _apply_chunk_rows() -> int:
@@ -241,12 +245,20 @@ class Transformer(Chainable):
                 if self.parallel_host:
                     from keystone_tpu.utils.hostmap import host_map
 
-                    return ds.map_batches(
+                    out = ds.map_batches(
                         lambda batch, _mask: host_map(self.apply_one, batch)
                     )
-                return ds.map_batches(
-                    lambda batch, _mask: [self.apply_one(x) for x in batch]
-                )
+                else:
+                    out = ds.map_batches(
+                        lambda batch, _mask: [self.apply_one(x) for x in batch]
+                    )
+                # provenance for the native text fast path: the base raw
+                # stream plus the host transformers applied since —
+                # consumers (ops/nlp_native) can re-run the whole chain
+                # in C++ from the raw docs instead of the per-item maps
+                base, stages = getattr(ds, "_host_chain", None) or (ds, ())
+                out._host_chain = (base, stages + (self,))
+                return out
             if self.is_host:
                 raise TypeError(
                     f"{self.label} is a host transformer; streams carry device "

@@ -399,6 +399,8 @@ def test_sift_matches_independent_numpy_reference():
     descs = []
     for cy in ys:
         for cx in xs_:
+            # canonical (y_bin, x_bin, orientation) feature order — the
+            # r5 descriptor-layout contract (ops/sift._DESCRIPTOR_ORDER)
             d = np.stack(
                 [sm[cy + oy, cx + ox] for oy in offs for ox in offs]
             ).reshape(-1)

@@ -8,12 +8,13 @@ at exactly the bench.py fit-leg config.  Run on the chip:
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, BEFORE any
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root, BEFORE any
 # keystone_tpu/bench import — `python tools/profile_fit.py` has tools/
 # as sys.path[0] and keystone_tpu is not an installed package
 

@@ -36,7 +36,7 @@ from collections import defaultdict
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root, for bench
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root, for bench
 from bench import (  # noqa: E402
     BATCH,
     GMM_K,
