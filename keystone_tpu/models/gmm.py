@@ -111,29 +111,27 @@ class GaussianMixtureModelEstimator(Estimator):
     def fit_dataset(self, data: Dataset) -> GaussianMixtureModel:
         x = data.array
         if data.mask is not None:
-            x = x.reshape(-1, x.shape[-1])
-            valid = data.mask.reshape(-1) > 0
-            x = x * valid[:, None]
-            n = jnp.sum(valid.astype(jnp.float32))
+            # ragged prep (flatten, mask, true count) lives INSIDE
+            # _gmm_fit's jit — one program, not two
             w, m, v = _gmm_fit(
-                x, n, valid.astype(jnp.float32), self.k, self.max_iterations,
-                self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+                x, None, data.mask, self.k, self.max_iterations,
+                self.min_variance, self.seed, self.kmeans_iters,
             )
         else:
-            n_rows = x.shape[0]
-            row_ok = (jnp.arange(n_rows) < data.n).astype(jnp.float32)
+            # row mask + PRNG key are built INSIDE _gmm_fit (row_ok=None)
+            # — eager, the iota/less/convert/threefry preamble was 4 tiny
+            # compiled programs per fit (r5 call-site attribution)
             w, m, v = _gmm_fit(
-                x, jnp.float32(data.n), row_ok, self.k, self.max_iterations,
-                self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+                x, float(data.n), None, self.k, self.max_iterations,
+                self.min_variance, self.seed, self.kmeans_iters,
             )
         return GaussianMixtureModel(w, m, v)
 
     def fit_arrays(self, x) -> GaussianMixtureModel:
         x = jnp.asarray(x, jnp.float32)
-        row_ok = jnp.ones((x.shape[0],), jnp.float32)
         w, m, v = _gmm_fit(
-            x, jnp.float32(x.shape[0]), row_ok, self.k, self.max_iterations,
-            self.min_variance, jax.random.PRNGKey(self.seed), self.kmeans_iters,
+            x, float(x.shape[0]), None, self.k, self.max_iterations,
+            self.min_variance, self.seed, self.kmeans_iters,
         )
         return GaussianMixtureModel(w, m, v)
 
@@ -162,7 +160,20 @@ def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var):
 
 
 @partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters"))
-def _gmm_fit(x, n, row_ok, k, iters, min_var, key, kmeans_iters):
+def _gmm_fit(x, n, row_ok, k, iters, min_var, seed, kmeans_iters):
+    # the eager preambles (ragged flatten/mask/count; dense iota/less;
+    # PRNGKey) were ~7 extra compiled programs per fit, each a ~0.1 s
+    # compile-cache RPC on the tunneled backend (r5 call-site
+    # attribution) — all live inside this one program now
+    if row_ok is not None and row_ok.ndim == 2:  # ragged (n,max_k) mask
+        x = x.reshape(-1, x.shape[-1])
+        valid = (row_ok.reshape(-1) > 0).astype(jnp.float32)
+        x = x * valid[:, None]
+        n = jnp.sum(valid)
+        row_ok = valid
+    elif row_ok is None:
+        row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)
+    key = jax.random.PRNGKey(seed)
     x = constrain(x.astype(jnp.float32), DATA_AXIS)
     means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key)
     gmean = jnp.sum(x * row_ok[:, None], axis=0) / n

@@ -65,16 +65,16 @@ class PCAEstimator(Estimator):
         x = data.array
         if data.mask is not None:
             # ragged descriptor sets: (n, max_k, d) -> valid rows only
-            x = x.reshape(-1, x.shape[-1])
-            m = data.mask.reshape(-1) > 0
-            comp, mean = _pca_masked(x, m, self.dims, self.center)
+            # (flatten + mask threshold live inside the jit: eager they
+            # were 2 extra compiled programs per fit)
+            comp, mean = _pca_masked(x, data.mask, self.dims, self.center)
             return PCATransformer(comp, mean if self.center else None)
-        comp, mean = _pca_fit(x, jnp.float32(data.n), self.dims, self.center)
+        comp, mean = _pca_fit(x, float(data.n), self.dims, self.center)
         return PCATransformer(comp, mean if self.center else None)
 
     def fit_arrays(self, x) -> PCATransformer:
         x = jnp.asarray(x, jnp.float32)
-        comp, mean = _pca_fit(x, jnp.float32(x.shape[0]), self.dims, self.center)
+        comp, mean = _pca_fit(x, float(x.shape[0]), self.dims, self.center)
         return PCATransformer(comp, mean if self.center else None)
 
 
@@ -84,14 +84,14 @@ class DistributedPCAEstimator(PCAEstimator):
 
     def fit_arrays(self, x) -> PCATransformer:
         x = jnp.asarray(x, jnp.float32)
-        comp, mean = _pca_cov_fit(x, jnp.float32(x.shape[0]), self.dims, self.center)
+        comp, mean = _pca_cov_fit(x, float(x.shape[0]), self.dims, self.center)
         return PCATransformer(comp, mean if self.center else None)
 
     def fit_dataset(self, data: Dataset) -> PCATransformer:
         x = data.array
         if data.mask is not None:
             return super().fit_dataset(data)
-        comp, mean = _pca_cov_fit(x, jnp.float32(data.n), self.dims, self.center)
+        comp, mean = _pca_cov_fit(x, float(data.n), self.dims, self.center)
         return PCATransformer(comp, mean if self.center else None)
 
 
@@ -120,7 +120,11 @@ def _pca_cov_fit(x, n, dims, center):
 
 
 @partial(jax.jit, static_argnames=("dims", "center"))
-def _pca_masked(x, valid, dims, center):
+def _pca_masked(x, mask, dims, center):
+    if x.ndim == 3:  # ragged (n, max_k, d) + (n, max_k) mask
+        x = x.reshape(-1, x.shape[-1])
+        mask = mask.reshape(-1)
+    valid = mask > 0
     w = valid.astype(jnp.float32)
     n = jnp.maximum(jnp.sum(w), 1.0)
     mean = (w @ x) / n

@@ -346,7 +346,6 @@ class ColumnSampler(Transformer):
     def apply_dataset(self, ds: Dataset) -> Dataset:
         from keystone_tpu.workflow.dataset import StreamDataset
 
-        key = jax.random.PRNGKey(self.seed)
         if isinstance(ds, StreamDataset):
             if ds.is_host:
                 raise TypeError(
@@ -362,6 +361,7 @@ class ColumnSampler(Transformer):
 
             outs = []
             offset = 0
+            key = jax.random.PRNGKey(self.seed)
             for arr, mask in ds.device_batches():
                 if arr.ndim != 3:
                     raise ValueError(
@@ -403,6 +403,7 @@ class ColumnSampler(Transformer):
                 if ds.mask is not None
                 else jnp.ones(arr.shape[:2], jnp.float32)
             )
+            key = jax.random.PRNGKey(self.seed)
             parts = [
                 _sample_descriptors(a, m, self.num_samples, key, offset=i)
                 for a, m, i in iter_row_chunks(arr, mask_full, chunk)
@@ -415,7 +416,7 @@ class ColumnSampler(Transformer):
             # extra (0.1-1.4 s) programs per sampler per process
             # (BASELINE.md r5 fit-floor split)
             flat = _sample_descriptors_flat(
-                arr, ds.mask, self.num_samples, key, n_true=n
+                arr, ds.mask, self.num_samples, self.seed, n_true=n
             )
         return Dataset(flat)
 
@@ -427,9 +428,11 @@ from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("k", "n_true"))
-def _sample_descriptors_flat(arr, mask, k, key, n_true):
-    """In-memory sampler fast path: mask default, sampling, true-row
-    slice, and the flat reshape fused into one jit program."""
+def _sample_descriptors_flat(arr, mask, k, seed, n_true):
+    """In-memory sampler fast path: mask default, PRNG key derivation,
+    sampling, true-row slice, and the flat reshape fused into one jit
+    program (the eager PRNGKey alone was 2 compiled programs/fit)."""
+    key = jax.random.PRNGKey(seed)
     if mask is None:
         mask = jnp.ones(arr.shape[:2], jnp.float32)
     out = _sample_descriptors(arr, mask, k, key)

@@ -195,13 +195,12 @@ def test_gmm_em_stays_sharded(mesh):
     K, d = 8, 16
     x = jnp.asarray(rng.normal(size=(_N, d)).astype(np.float32))
     row_ok = jnp.ones((_N,), jnp.float32)
-    key = jax.random.PRNGKey(0)
     compiled = _gmm_fit.lower(
-        x, jnp.float32(_N), row_ok, K, 2, 1e-4, key, 2
+        x, jnp.float32(_N), row_ok, K, 2, 1e-4, 0, 2
     ).compile()
     _assert_gate(
         compiled,
-        (x, jnp.float32(_N), row_ok, 1e-4, key),
+        (x, jnp.float32(_N), row_ok, 1e-4, 0),
         _N,
         "_gmm_fit",
     )
