@@ -49,4 +49,5 @@ from keystone_tpu.workflow.pipeline import (  # noqa: F401
     PipelineDataset,
     PipelineDatum,
     PipelineEnv,
+    PreflightOOMError,
 )
