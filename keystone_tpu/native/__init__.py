@@ -21,7 +21,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "libkeystone_native.so")
-_ABI_VERSION = 3  # must match ks_version() in native/keystone_native.cpp
+_ABI_VERSION = 4  # must match ks_version() in native/keystone_native.cpp
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

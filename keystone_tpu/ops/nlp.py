@@ -380,6 +380,9 @@ class HashingTF(Transformer):
         from keystone_tpu.workflow.dataset import StreamDataset
 
         if isinstance(ds, StreamDataset) and ds.is_host:
+            native = self._apply_native_stream(ds)
+            if native is not None:
+                return native
             return _featurize_host_stream(self, ds)
         from keystone_tpu.utils.hostmap import host_map
 
@@ -387,6 +390,29 @@ class HashingTF(Transformer):
             return ds.with_items(host_map(self.apply_one, ds.items))
         rows = np.stack(host_map(self.apply_one, ds.items))
         return Dataset(rows)
+
+    def _apply_native_stream(self, ds):
+        """Fused C++ hash-featurize from the RAW doc stream (native
+        blake2b twin of stable_term_hash); None = Python path.  Same
+        payload contract as CommonSparseFeaturesModel's native apply."""
+        from keystone_tpu.ops import nlp_native
+
+        if self.num_features > (1 << 31) - 1:
+            return None  # native columns are int32; Python handles wider
+        chain = getattr(ds, "_host_chain", None)
+        if chain is None or not nlp_native.available():
+            return None
+        cfg = nlp_native.chain_config(chain[1])
+        if cfg is None:
+            return None
+        base, nf, sparse = chain[0], self.num_features, self.sparse_output
+
+        def fn(batch, _mask):
+            if batch and not isinstance(batch[0], str):
+                raise TypeError("native text path expects raw doc strings")
+            return nlp_native.hashtf_docs(batch, cfg, nf, sparse)
+
+        return base.map_batches(fn, host=True if sparse else False)
 
 
 class NGramsCounts(Transformer):

@@ -48,7 +48,11 @@ def _lib():
     from keystone_tpu.native import get_lib
 
     lib = get_lib()
-    if lib is None or not hasattr(lib, "ks_text_featurize"):
+    if lib is None or not hasattr(lib, "ks_text_featurize") or not hasattr(
+        lib, "ks_text_hashtf"
+    ):
+        # both entry points ship in the same build (ABI v4); a partial
+        # binary means a stale .so — fall back to Python entirely
         return None
     return lib
 
@@ -108,6 +112,40 @@ def chain_config(stages) -> Optional[dict]:
     }
 
 
+
+def _unpack_native_rows(lib, indptr, out_idx, out_val, n, num_features,
+                        sparse_output):
+    """Copy a ks_text_* CSR result out of native memory and build the
+    per-doc payload (scipy CSR rows or a dense (n, F) array) — the one
+    place that owns the copy-out/free and row-construction contract."""
+    import scipy.sparse as sp
+
+    nnz = int(indptr[-1])
+    try:
+        idx = np.ctypeslib.as_array(out_idx, shape=(max(nnz, 1),))[:nnz].copy()
+        val = np.ctypeslib.as_array(out_val, shape=(max(nnz, 1),))[:nnz].copy()
+    finally:
+        lib.ks_free(out_idx)
+        lib.ks_free(out_val)
+    if sparse_output:
+        rows: List = []
+        for i in range(n):
+            lo, hi = int(indptr[i]), int(indptr[i + 1])
+            rows.append(
+                sp.csr_matrix(
+                    (val[lo:hi], idx[lo:hi], np.array([0, hi - lo], np.int32)),
+                    shape=(1, num_features),
+                    copy=False,
+                )
+            )
+        return rows
+    dense = np.zeros((n, num_features), np.float32)
+    for i in range(n):
+        lo, hi = int(indptr[i]), int(indptr[i + 1])
+        dense[i, idx[lo:hi]] = val[lo:hi]
+    return dense
+
+
 def featurize_docs(
     docs: Sequence[str],
     vocab_keys_joined: bytes,
@@ -146,30 +184,49 @@ def featurize_docs(
     )
     if rc != 0:
         raise RuntimeError(f"ks_text_featurize failed: {rc}")
-    nnz = int(indptr[-1])
-    try:
-        idx = np.ctypeslib.as_array(out_idx, shape=(max(nnz, 1),))[:nnz].copy()
-        val = np.ctypeslib.as_array(out_val, shape=(max(nnz, 1),))[:nnz].copy()
-    finally:
-        lib.ks_free(out_idx)
-        lib.ks_free(out_val)
-    if sparse_output:
-        rows: List = []
-        for i in range(n):
-            lo, hi = int(indptr[i]), int(indptr[i + 1])
-            rows.append(
-                sp.csr_matrix(
-                    (val[lo:hi], idx[lo:hi], np.array([0, hi - lo], np.int32)),
-                    shape=(1, num_features),
-                    copy=False,
-                )
-            )
-        return rows
-    dense = np.zeros((n, num_features), np.float32)
-    for i in range(n):
-        lo, hi = int(indptr[i]), int(indptr[i + 1])
-        dense[i, idx[lo:hi]] = val[lo:hi]
-    return dense
+    return _unpack_native_rows(
+        lib, indptr, out_idx, out_val, n, num_features, sparse_output
+    )
+
+
+def hashtf_docs(
+    docs: Sequence[str],
+    cfg: dict,
+    num_features: int,
+    sparse_output: bool,
+    threads: int = 0,
+):
+    """Raw docs -> HashingTF rows: col = blake2b8(repr(term)) %
+    num_features (stable_term_hash's exact contract, reimplemented in
+    C++ from RFC 7693 — parity pinned incl. apostrophe tokens, whose
+    repr double-quotes); colliding terms' tf values accumulate."""
+    import scipy.sparse as sp
+
+    lib = _lib()
+    blob, offs = _pack_docs(docs)
+    n = len(docs)
+    indptr = np.zeros(n + 1, np.int64)
+    out_idx = ctypes.POINTER(ctypes.c_int32)()
+    out_val = ctypes.POINTER(ctypes.c_float)()
+    rc = lib.ks_text_hashtf(
+        blob,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n),
+        ctypes.c_uint32(cfg["orders_mask"]),
+        cfg["log_tf"],
+        cfg["lower"],
+        cfg["trim"],
+        ctypes.c_int64(num_features),
+        threads,
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(out_idx),
+        ctypes.byref(out_val),
+    )
+    if rc != 0:
+        raise RuntimeError(f"ks_text_hashtf failed: {rc}")
+    return _unpack_native_rows(
+        lib, indptr, out_idx, out_val, n, num_features, sparse_output
+    )
 
 
 def pack_vocab(vocab: dict) -> Tuple[bytes, np.ndarray, int]:

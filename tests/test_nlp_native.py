@@ -100,6 +100,7 @@ def test_featurize_rows_match_python(sparse):
     out, _ = _chained_stream(DOCS)
     dicts = _py_dicts(DOCS)
     model = CommonSparseFeatures(128, sparse_output=sparse).fit_arrays(dicts)
+    assert model._apply_native_stream(out) is not None  # gate engaged
     want = np.stack(
         [
             (r.toarray()[0] if sparse else r)
@@ -127,3 +128,31 @@ def test_nondefault_pattern_falls_back_to_python():
     assert nlp_native.chain_config(stages) is None  # unsupported pattern
     model = CommonSparseFeatures(16).fit_dataset(out)  # python path, no crash
     assert ("a-b",) in model.vocab
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_hashtf_rows_match_python(sparse):
+    """Native blake2b(repr(term)) must reproduce stable_term_hash
+    exactly — including apostrophe tokens, whose Python repr switches to
+    double quotes — and collision accumulation must match to 1e-6."""
+    from keystone_tpu.ops.nlp import HashingTF
+
+    out, _ = _chained_stream(DOCS)
+    dicts = _py_dicts(DOCS)
+    model = HashingTF(num_features=128, sparse_output=sparse)  # force collisions
+    # the native gate must actually ENGAGE for this chain — otherwise the
+    # comparison below is vacuously Python-vs-Python
+    assert model._apply_native_stream(out) is not None
+    want = np.stack(
+        [
+            (r.toarray()[0] if sparse else r)
+            for r in (model.apply_one(d) for d in dicts)
+        ]
+    )
+    feat = model.apply_dataset(out)
+    rows = []
+    for b in feat.batches():
+        for r in b:
+            rows.append(r.toarray()[0] if sparse else np.asarray(r))
+    got = np.stack(rows)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)

@@ -76,6 +76,30 @@ def run_two_pass(docs, use_native: bool) -> float:
     return len(docs) / total
 
 
+def run_hash_pass(docs, use_native: bool) -> float:
+    from keystone_tpu.ops.nlp import HashingTF
+
+    def src():
+        for i in range(0, len(docs), BATCH):
+            yield docs[i : i + BATCH]
+
+    ds = StreamDataset(src, n=len(docs), host=True)
+    out = ds
+    for t in (Trimmer(), LowerCase(), Tokenizer(), NGramsFeaturizer((1, 2)),
+              TermFrequency(log_tf)):
+        out = t.apply_dataset(out)
+    if not use_native:
+        out._host_chain = None
+    feat = HashingTF(NUM_FEATURES, sparse_output=True).apply_dataset(out)
+    t0 = time.perf_counter()
+    nrows = sum(len(b) for b in feat.batches())
+    dt = time.perf_counter() - t0
+    assert nrows == len(docs)
+    print(f"  {'native' if use_native else 'python'} hashtf: "
+          f"{len(docs)/dt:8.0f} docs/s")
+    return len(docs) / dt
+
+
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("-")]
     n = int(args[0]) if args else 100_000
@@ -87,6 +111,9 @@ def main():
     native = run_two_pass(docs, use_native=True)
     py = run_two_pass(docs[:pydocs], use_native=False)
     print(f"speedup (2-pass docs/s): {native/py:.2f}x")
+    hn = run_hash_pass(docs, use_native=True)
+    hp = run_hash_pass(docs[:pydocs], use_native=False)
+    print(f"speedup (hashtf docs/s): {hn/hp:.2f}x")
 
 
 if __name__ == "__main__":
