@@ -116,6 +116,32 @@ class TermFrequency(Transformer):
         return {k: self.fn(float(v)) for k, v in counts.items()}
 
 
+def _native_chain(ds):
+    """(cfg, base_dataset) when ``ds`` carries host-chain provenance the
+    native text path supports (ops/nlp_native), else None — the one
+    gating prologue for every native consumer (df fit, vocab featurize,
+    hashing featurize; stream and in-memory)."""
+    from keystone_tpu.ops import nlp_native
+
+    chain = getattr(ds, "_host_chain", None)
+    if chain is None or not nlp_native.available():
+        return None
+    cfg = nlp_native.chain_config(chain[1])
+    if cfg is None:
+        return None
+    return cfg, chain[0]
+
+
+def _base_docs(base) -> Optional[list]:
+    """Raw doc list of an in-memory host base dataset, or None."""
+    if not base.is_host:
+        return None
+    docs = base.items
+    if docs and not isinstance(docs[0], str):
+        return None
+    return docs
+
+
 class CommonSparseFeaturesModel(Transformer):
     """doc term-dict → row over the learned vocabulary.
 
@@ -159,6 +185,10 @@ class CommonSparseFeaturesModel(Transformer):
             if native is not None:
                 return native
             return _featurize_host_stream(self, ds)
+        if ds.is_host:
+            native = self._apply_native_items(ds)
+            if native is not None:
+                return native
         from keystone_tpu.utils.hostmap import host_map
 
         if self.sparse_output:
@@ -180,16 +210,14 @@ class CommonSparseFeaturesModel(Transformer):
         → lazy host stream of CSR rows, dense → device stream."""
         from keystone_tpu.ops import nlp_native
 
-        chain = getattr(ds, "_host_chain", None)
-        if chain is None or not nlp_native.available():
+        nc = _native_chain(ds)
+        if nc is None:
             return None
-        cfg = nlp_native.chain_config(chain[1])
-        if cfg is None:
-            return None
+        cfg, base = nc
         if not hasattr(self, "_native_vocab"):
             self._native_vocab = nlp_native.pack_vocab(self.vocab)
         blob, offs, vsize = self._native_vocab
-        base, nf, sparse = chain[0], self.num_features, self.sparse_output
+        nf, sparse = self.num_features, self.sparse_output
 
         def fn(batch, _mask):
             if batch and not isinstance(batch[0], str):
@@ -199,6 +227,28 @@ class CommonSparseFeaturesModel(Transformer):
             )
 
         return base.map_batches(fn, host=True if sparse else False)
+
+    def _apply_native_items(self, ds):
+        """In-memory twin of _apply_native_stream (the non-stream apps):
+        featurize the base dataset's raw docs in one native call."""
+        from keystone_tpu.ops import nlp_native
+
+        nc = _native_chain(ds)
+        if nc is None:
+            return None
+        cfg, base = nc
+        docs = _base_docs(base)
+        if docs is None:
+            return None
+        if not hasattr(self, "_native_vocab"):
+            self._native_vocab = nlp_native.pack_vocab(self.vocab)
+        blob, offs, vsize = self._native_vocab
+        rows = nlp_native.featurize_docs(
+            docs, blob, offs, vsize, cfg, self.num_features, self.sparse_output
+        )
+        if self.sparse_output:
+            return ds.with_items(rows)
+        return Dataset(rows)
 
 
 def _featurize_host_stream(model, ds):
@@ -245,6 +295,10 @@ class CommonSparseFeatures(Estimator):
             return self.fit_arrays(
                 d for batch in data.batches() for d in batch
             )
+        if data.is_host:
+            native = self._fit_native_items(data)
+            if native is not None:
+                return native
         return self.fit_arrays(data.items)
 
     def _fit_native_stream(self, data) -> Optional[CommonSparseFeaturesModel]:
@@ -255,19 +309,39 @@ class CommonSparseFeatures(Estimator):
         documented in nlp_native's module docstring."""
         from keystone_tpu.ops import nlp_native
 
-        chain = getattr(data, "_host_chain", None)
-        if chain is None or not nlp_native.available():
+        nc = _native_chain(data)
+        if nc is None:
             return None
-        cfg = nlp_native.chain_config(chain[1])
-        if cfg is None:
-            return None
-        base = chain[0]
+        cfg, base = nc
         acc = nlp_native.DfAccumulator(cfg)
         try:
             for batch in base.batches():
                 if batch and not isinstance(batch[0], str):
                     return None  # base stream is not raw text
                 acc.update(batch)
+            top = acc.topn(self.num_features)
+        finally:
+            acc.close()
+        vocab = {t: i for i, (t, _) in enumerate(top)}
+        return CommonSparseFeaturesModel(
+            vocab, self.num_features, self.sparse_output
+        )
+
+    def _fit_native_items(self, data) -> Optional[CommonSparseFeaturesModel]:
+        """In-memory twin of _fit_native_stream (the non-stream apps)."""
+        from keystone_tpu.ops import nlp_native
+
+        nc = _native_chain(data)
+        if nc is None:
+            return None
+        cfg, base = nc
+        docs = _base_docs(base)
+        if docs is None:
+            return None
+        acc = nlp_native.DfAccumulator(cfg)
+        try:
+            for i in range(0, len(docs), 8192):
+                acc.update(docs[i : i + 8192])
             top = acc.topn(self.num_features)
         finally:
             acc.close()
@@ -384,6 +458,10 @@ class HashingTF(Transformer):
             if native is not None:
                 return native
             return _featurize_host_stream(self, ds)
+        if ds.is_host:
+            native = self._apply_native_items(ds)
+            if native is not None:
+                return native
         from keystone_tpu.utils.hostmap import host_map
 
         if self.sparse_output:
@@ -399,13 +477,11 @@ class HashingTF(Transformer):
 
         if self.num_features > (1 << 31) - 1:
             return None  # native columns are int32; Python handles wider
-        chain = getattr(ds, "_host_chain", None)
-        if chain is None or not nlp_native.available():
+        nc = _native_chain(ds)
+        if nc is None:
             return None
-        cfg = nlp_native.chain_config(chain[1])
-        if cfg is None:
-            return None
-        base, nf, sparse = chain[0], self.num_features, self.sparse_output
+        cfg, base = nc
+        nf, sparse = self.num_features, self.sparse_output
 
         def fn(batch, _mask):
             if batch and not isinstance(batch[0], str):
@@ -413,6 +489,26 @@ class HashingTF(Transformer):
             return nlp_native.hashtf_docs(batch, cfg, nf, sparse)
 
         return base.map_batches(fn, host=True if sparse else False)
+
+    def _apply_native_items(self, ds):
+        """In-memory twin of _apply_native_stream."""
+        from keystone_tpu.ops import nlp_native
+
+        if self.num_features > (1 << 31) - 1:
+            return None
+        nc = _native_chain(ds)
+        if nc is None:
+            return None
+        cfg, base = nc
+        docs = _base_docs(base)
+        if docs is None:
+            return None
+        rows = nlp_native.hashtf_docs(
+            docs, cfg, self.num_features, self.sparse_output
+        )
+        if self.sparse_output:
+            return ds.with_items(rows)
+        return Dataset(rows)
 
 
 class NGramsCounts(Transformer):

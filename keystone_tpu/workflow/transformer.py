@@ -280,7 +280,14 @@ class Transformer(Chainable):
                     return ds.with_array(jnp.stack([jnp.asarray(o) for o in out]))
                 except (TypeError, ValueError):
                     pass
-            return ds.with_items(out)
+            res = ds.with_items(out)
+            # provenance for the native text fast path, mirroring the
+            # host-STREAM branch above: in-memory host datasets chain
+            # through with_items, so downstream featurizers can re-run
+            # the whole chain in C++ from the base items
+            base, stages = getattr(ds, "_host_chain", None) or (ds, ())
+            res._host_chain = (base, stages + (self,))
+            return res
         chunk = _apply_chunk_rows()
         if chunk and ds.array.shape[0] > chunk:
             return self._apply_dataset_chunked(ds, chunk)

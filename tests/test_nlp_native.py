@@ -156,3 +156,40 @@ def test_hashtf_rows_match_python(sparse):
             rows.append(r.toarray()[0] if sparse else np.asarray(r))
     got = np.stack(rows)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_in_memory_chain_engages_native_and_matches():
+    """Non-stream apps (synthetic/loaded host Datasets) ride the same
+    native path via with_items provenance: fit + featurize must match
+    the per-item Python chain."""
+    from keystone_tpu.ops.nlp import CommonSparseFeatures, HashingTF
+    from keystone_tpu.workflow.dataset import Dataset
+
+    ds = Dataset(list(DOCS))
+    out = ds
+    for t in (Trimmer(), LowerCase(), Tokenizer(), NGramsFeaturizer((1, 2)),
+              TermFrequency(log_tf)):
+        out = t.apply_dataset(out)
+    dicts = _py_dicts(DOCS)
+
+    est = CommonSparseFeatures(64, sparse_output=False)
+    assert est._fit_native_items(out) is not None  # gate engaged
+    model = est.fit_dataset(out)
+    import collections
+
+    df = collections.Counter()
+    for d in dicts:
+        df.update(set(d.keys()))
+    assert set(model.vocab) == set(df)
+
+    model_py = CommonSparseFeatures(128).fit_arrays(dicts)
+    assert model_py._apply_native_items(out) is not None
+    got = np.asarray(model_py.apply_dataset(out).array)
+    want = np.stack([model_py.apply_one(d) for d in dicts])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    h = HashingTF(num_features=128)
+    assert h._apply_native_items(out) is not None
+    got = np.asarray(h.apply_dataset(out).array)
+    want = np.stack([h.apply_one(d) for d in dicts])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
