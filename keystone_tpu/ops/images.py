@@ -42,6 +42,12 @@ class Convolver(Transformer):
     """
 
     strategy = "auto"  # class default for pre-strategy pickles
+    # fitted filters/offset ride as traced jit arguments (refits and
+    # sibling instances share programs; no lowering read-back)
+    traced_attrs = ("filters", "offset")
+
+    def jit_static(self):
+        return (self.stride, self.strategy)
 
     def __init__(
         self,

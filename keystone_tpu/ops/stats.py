@@ -28,6 +28,11 @@ class CosineRandomFeatures(Transformer):
     Laplacian kernel; b ~ Uniform[0, 2π].
     """
 
+    # TIMIT gathers many instances of this class with identical shapes —
+    # traced parameters make them share ONE compiled program per shape
+    # (Transformer.traced_attrs)
+    traced_attrs = ("w", "b")
+
     def __init__(self, w: jnp.ndarray, b: jnp.ndarray):
         self.w = w  # (num_out, num_in)
         self.b = b  # (num_out,)
@@ -72,6 +77,8 @@ class CosineRandomFeatures(Transformer):
 class RandomSignNode(Transformer):
     """Elementwise Rademacher sign flip (nodes/stats/RandomSignNode.scala);
     paired with PaddedFFT for fastfood-style random features."""
+
+    traced_attrs = ("signs",)  # MNIST gathers N sign-flip branches
 
     def __init__(self, signs: jnp.ndarray):
         self.signs = signs
