@@ -193,3 +193,55 @@ def test_in_memory_chain_engages_native_and_matches():
     got = np.asarray(h.apply_dataset(out).array)
     want = np.stack([h.apply_one(d) for d in dicts])
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_native_text_property_random_docs():
+    """Property guard: random printable-ASCII docs (incl. apostrophes,
+    digits, punctuation, odd whitespace) must produce IDENTICAL df maps
+    and featurize rows on the native and Python chains."""
+    import collections
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    from keystone_tpu.ops.nlp import CommonSparseFeatures, HashingTF
+
+    alphabet = st.sampled_from(
+        list("abcXYZ019'!.,;- \t\n") + ["don't", "  ", "café"]
+    )
+    docs_strategy = st.lists(
+        st.lists(alphabet, max_size=30).map("".join), min_size=1, max_size=8
+    )
+
+    @settings(max_examples=25, deadline=None)
+    @given(docs_strategy)
+    def check(docs):
+        out, stages = _chained_stream(docs, batch=3)
+        cfg = nlp_native.chain_config(stages)
+        dicts = _py_dicts(docs)
+
+        acc = nlp_native.DfAccumulator(cfg)
+        for i in range(0, len(docs), 3):
+            acc.update(docs[i : i + 3])
+        native_df = dict(acc.topn(100000))
+        acc.close()
+        df = collections.Counter()
+        for d in dicts:
+            df.update(set(d.keys()))
+        assert native_df == dict(df)
+
+        model = CommonSparseFeatures(64).fit_arrays(dicts)
+        want = np.stack([model.apply_one(d) for d in dicts])
+        got = np.concatenate(
+            [np.asarray(b) for b in model.apply_dataset(out).batches()], axis=0
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+        h = HashingTF(num_features=64)
+        wanth = np.stack([h.apply_one(d) for d in dicts])
+        goth = np.concatenate(
+            [np.asarray(b) for b in h.apply_dataset(out).batches()], axis=0
+        )
+        np.testing.assert_allclose(goth, wanth, rtol=1e-6, atol=1e-7)
+
+    check()
