@@ -1,0 +1,337 @@
+"""Process-wide deterministic fault injection.
+
+The reference inherited its failure modes *and* their remedies from
+Spark: partial writes, flaky storage, and worker death were absorbed by
+lineage recompute and task retry (SURVEY.md §5).  The TPU rebuild
+replaces those remedies with stage retry + durable checkpoints — which
+means the failure modes themselves must be injectable on demand, or the
+recovery paths rot untested.  This module is the injection side of that
+contract; ``keystone_tpu.utils.durable`` is the survival side.
+
+Named **sites** are threaded through the codebase::
+
+    blockstore.read     FeatureBlockStore.read_block
+    blockstore.write    FeatureBlockStore.append_rows (per block file)
+    ckpt.save           durable.save_npz (write + publish phases)
+    ckpt.load           durable.load_npz (per candidate file)
+    stream.batch        loaders.stream.batched / resilient sources
+    multihost.init      parallel.multihost.initialize
+    executor.stage      GraphExecutor stage execution (inside retry scope)
+
+A **plan** activates faults at sites, either via the ``inject`` context
+manager (tests) or the ``KEYSTONE_FAULTS`` environment variable — the
+env route is what lets the multi-process kill workers
+(tests/faulttol_worker.py) run under injected faults without plumbing::
+
+    KEYSTONE_FAULTS="ckpt.save:after=3:raise;blockstore.read:p=0.2:seed=7"
+
+Plan grammar: ``site:token:token;site:token...`` where tokens are
+
+- triggers: ``after=N`` (skip the first N matching calls), ``every=N``
+  (then fire every Nth), ``p=F`` + ``seed=S`` (fire with probability F
+  from a dedicated deterministic RNG), ``times=N`` (stop after N fires);
+- actions: ``raise`` (default — raise :class:`FaultInjected`, an
+  ``OSError`` so every transient-I/O retry path treats it as
+  retryable), ``corrupt`` (flip bytes in the site's file), ``truncate``
+  (halve the site's file), ``exit`` / ``exit=CODE`` (``os._exit`` — the
+  kill-worker action).
+
+Everything is deterministic given the plan string and the call
+sequence: probabilistic specs draw from a private ``random.Random(seed)``
+so the same plan replayed over the same calls injects at the same call
+indices (locked in by tests/test_faults.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from collections import Counter
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "KEYSTONE_FAULTS"
+
+#: the sites wired through the codebase; plans naming anything else are
+#: rejected at parse time (a typo'd site would otherwise never fire).
+SITES = {
+    "blockstore.read",
+    "blockstore.write",
+    "ckpt.save",
+    "ckpt.load",
+    "stream.batch",
+    "multihost.init",
+    "executor.stage",
+}
+
+_ACTIONS = ("raise", "corrupt", "truncate", "exit")
+
+# file-damaging actions only make sense once the file is durably
+# published; failure actions fire while the operation is in flight.
+# Two-phase sites (ckpt.save) pass phase="write" / phase="publish";
+# single-phase sites pass no phase and accept every action.
+_ACTION_PHASE = {"corrupt": "publish", "truncate": "publish"}
+
+
+class FaultInjected(OSError):
+    """An injected transient fault.  Subclasses ``OSError`` on purpose:
+    every retry path that absorbs flaky storage/transport I/O absorbs
+    injected faults identically — a plan with ``times=1`` at a retried
+    site must be *survived*, and that is the behavior chaos tests pin."""
+
+    def __init__(self, site: str, message: Optional[str] = None):
+        super().__init__(message or f"injected fault at {site!r}")
+        self.site = site
+
+
+class FaultPlanError(ValueError):
+    """A malformed ``KEYSTONE_FAULTS`` / ``inject`` plan string."""
+
+
+class SiteSpec:
+    """One parsed ``site:tokens`` clause plus its firing state."""
+
+    def __init__(
+        self,
+        site: str,
+        action: str = "raise",
+        after: int = 0,
+        every: int = 1,
+        p: float = 1.0,
+        seed: int = 0,
+        times: Optional[int] = None,
+        exit_code: int = 42,
+    ):
+        self.site = site
+        self.action = action
+        self.after = int(after)
+        self.every = max(1, int(every))
+        self.p = float(p)
+        self.seed = int(seed)
+        self.times = None if times is None else int(times)
+        self.exit_code = int(exit_code)
+        self.reset()
+
+    def reset(self) -> None:
+        self.calls = 0
+        self.fired = 0
+        self._pending = False
+        self._rng = random.Random(self.seed)
+
+    def _advance(self) -> bool:
+        """Consume one *operation* against the triggers."""
+        self.calls += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.calls <= self.after:
+            return False
+        if (self.calls - self.after - 1) % self.every != 0:
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+    def should_fire(self, phase: Optional[str]) -> bool:
+        """Decide whether this call fires the fault.  Triggers advance
+        once per *operation*: two-phase sites evaluate them on the
+        ``write`` call, and a publish-phase action (corrupt/truncate)
+        carries that decision over to the matching ``publish`` call, so
+        ``after=N`` counts saves, not phases."""
+        want = _ACTION_PHASE.get(self.action)  # None or "publish"
+        if phase is None:
+            return self._advance()
+        if phase == "write":
+            fire = self._advance()
+            if want == "publish":
+                self._pending = fire
+                return False
+            return fire
+        if phase == "publish" and want == "publish":
+            fire, self._pending = self._pending, False
+            return fire
+        return False
+
+
+class FaultPlan:
+    """An ordered set of :class:`SiteSpec`, activated as a unit."""
+
+    def __init__(self, specs: List[SiteSpec], source: str = ""):
+        self.specs = specs
+        self.source = source
+
+    def for_site(self, site: str) -> List[SiteSpec]:
+        return [s for s in self.specs if s.site == site]
+
+    def reset(self) -> None:
+        for s in self.specs:
+            s.reset()
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse the ``KEYSTONE_FAULTS`` grammar into a :class:`FaultPlan`."""
+    specs: List[SiteSpec] = []
+    for clause in text.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        tokens = [t.strip() for t in clause.split(":")]
+        site = tokens[0]
+        if site not in SITES:
+            raise FaultPlanError(
+                f"unknown fault site {site!r}; known sites: {sorted(SITES)}"
+            )
+        kwargs: Dict = {}
+        for tok in tokens[1:]:
+            if not tok:
+                continue
+            key, _, val = tok.partition("=")
+            if key in _ACTIONS and not val:
+                kwargs["action"] = key
+            elif key == "exit":
+                kwargs["action"] = "exit"
+                kwargs["exit_code"] = int(val)
+            elif key == "after":
+                kwargs["after"] = int(val)
+            elif key == "every":
+                kwargs["every"] = int(val)
+            elif key == "times":
+                kwargs["times"] = int(val)
+            elif key == "p":
+                kwargs["p"] = float(val)
+            elif key == "seed":
+                kwargs["seed"] = int(val)
+            else:
+                raise FaultPlanError(
+                    f"bad fault token {tok!r} in clause {clause!r}"
+                )
+        specs.append(SiteSpec(site, **kwargs))
+    return FaultPlan(specs, source=text)
+
+
+# --------------------------------------------------------------- runtime
+
+_LOCK = threading.Lock()
+_STACK: List[FaultPlan] = []  # inject() plans, innermost last
+_ENV_PLAN: Optional[FaultPlan] = None
+_ENV_TEXT: Optional[str] = None  # the string _ENV_PLAN was parsed from
+
+CALLS: Counter = Counter()  # site -> fault_point calls (operations)
+INJECTED: Counter = Counter()  # site -> faults actually applied
+
+
+def _env_plan() -> Optional[FaultPlan]:
+    """The plan from ``KEYSTONE_FAULTS``, reparsed whenever the env value
+    changes — so monkeypatched tests and freshly-spawned workers both
+    pick it up without an explicit install call."""
+    global _ENV_PLAN, _ENV_TEXT
+    text = os.environ.get(ENV_VAR)
+    if text != _ENV_TEXT:
+        _ENV_TEXT = text
+        _ENV_PLAN = parse_plan(text) if text else None
+        if _ENV_PLAN is not None:
+            logger.info("fault plan active from %s: %s", ENV_VAR, text)
+    return _ENV_PLAN
+
+
+def active_plans() -> List[FaultPlan]:
+    plans = list(_STACK)
+    env = _env_plan()
+    if env is not None:
+        plans.append(env)
+    return plans
+
+
+@contextmanager
+def inject(plan):
+    """Activate a fault plan for a ``with`` block (tests).  ``plan`` is a
+    plan string or a :class:`FaultPlan`; trigger counters start fresh on
+    entry so the block is a deterministic replay unit."""
+    p = parse_plan(plan) if isinstance(plan, str) else plan
+    p.reset()
+    with _LOCK:
+        _STACK.append(p)
+    try:
+        yield p
+    finally:
+        with _LOCK:
+            _STACK.remove(p)
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        CALLS.clear()
+        INJECTED.clear()
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Per-site ``{"calls": n, "injected": m}`` since the last reset."""
+    with _LOCK:
+        sites = set(CALLS) | set(INJECTED)
+        return {
+            s: {"calls": CALLS[s], "injected": INJECTED[s]} for s in sites
+        }
+
+
+def _corrupt_file(path: str) -> None:
+    """Flip a byte run in the middle of ``path`` (content damage the
+    length/np.load checks cannot see — only a checksum catches it)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(16) or b"\0"
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def _truncate_file(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = None, **ctx) -> None:
+    """The injection hook threaded through the codebase.
+
+    No active plan ⇒ a counter bump and an immediate return (the hot
+    paths pay one dict lookup).  With a matching spec it raises
+    :class:`FaultInjected`, damages the file at ``path``, or exits the
+    process, per the spec's action.  File actions with no ``path`` fall
+    back to raising, so a plan never silently does nothing.
+    """
+    with _LOCK:
+        if phase != "publish":  # two-phase sites count once per operation
+            CALLS[site] += 1
+        plans = list(_STACK)
+    env = _env_plan()
+    if env is not None:
+        plans.append(env)
+    if not plans:
+        return
+    for plan in reversed(plans):  # innermost inject() wins
+        for spec in plan.for_site(site):
+            with _LOCK:
+                fire = spec.should_fire(phase)
+                if fire:
+                    INJECTED[site] += 1
+            if not fire:
+                continue
+            logger.warning(
+                "fault injected at %s (action=%s%s)",
+                site,
+                spec.action,
+                f", path={path}" if path else "",
+            )
+            if spec.action == "exit":
+                os._exit(spec.exit_code)
+            if spec.action == "corrupt" and path and os.path.exists(path):
+                _corrupt_file(path)
+                continue  # damage is silent: the *load* must detect it
+            if spec.action == "truncate" and path and os.path.exists(path):
+                _truncate_file(path)
+                continue
+            raise FaultInjected(site)

@@ -13,24 +13,181 @@ the host-side building blocks loaders use to construct one:
 - :func:`batched` — re-iterable batch source over an in-memory array;
 - :func:`prefetched` — wrap any re-iterable batch source so host work
   (decode, transforms) runs on a background thread one batch ahead of
-  the consumer.
+  the consumer;
+- :func:`resilient` — wrap any re-iterable batch source with bounded
+  per-batch retry (exponential backoff) plus a ``max_bad_batches`` drop
+  quota, so flaky storage degrades instead of killing the fit.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from keystone_tpu.faults import fault_point
+
+logger = logging.getLogger(__name__)
+
 
 def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndarray]]:
-    """Re-iterable batch source over an in-memory array."""
+    """Re-iterable batch source over an in-memory array.  Carries the
+    ``stream.batch`` fault site so chaos plans can flake any pipeline
+    built on in-memory batching (the demo/test source every --stream
+    app can fall back to)."""
 
     def gen():
         for i in range(0, len(array), batch_size):
+            fault_point("stream.batch", index=i // batch_size)
             yield array[i : i + batch_size]
+
+    return gen
+
+
+def resilient(
+    source,
+    retries: int = 2,
+    max_bad_batches: int = 0,
+    base_delay: float = 0.05,
+    max_delay: float = 1.0,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Callable[[], Iterator]:
+    """Re-iterable batch source that survives transient per-batch
+    failures (the Spark-task-retry analogue for input streams).
+
+    A failed fetch is retried up to ``retries`` times with exponential
+    backoff; each retry re-creates the underlying iterator (``source``
+    must be re-iterable, this module's standing contract) and replays to
+    the failed position.  A batch that still fails with its retries
+    exhausted is DROPPED against the ``max_bad_batches`` quota — the
+    reference tolerated lost partitions the same way, by bounded data
+    loss rather than job death — and once the quota is spent the last
+    error propagates.  ``max_bad_batches=0`` (default) means retry-only:
+    transient flakiness is absorbed, deterministic failure still fails
+    the fit.
+
+    A source that ends BEFORE the replay position raises rather than
+    silently truncating the stream.  One ambiguity is undetectable from
+    the iterator protocol alone: a plain generator dies at the batch
+    that raised, so a DROPPED batch on a generator source ends the
+    stream at the drop point (observationally identical to a source
+    whose final batch was bad) — it is logged loudly, and exact-n
+    consumers (``FeatureBlockStore.from_batches``) still fail on the row
+    shortfall.  A nonzero drop quota therefore wants batch-resumable
+    iterators (e.g. file-per-batch readers), where fetches after a
+    failed batch keep working.
+
+    Note: dropped batches shrink the delivered row count, so only
+    consumers that tolerate ragged totals (df sweeps, statistics) should
+    run with a nonzero quota; exact-n consumers (FeatureBlockStore
+    spills) keep the default.
+    """
+    if not callable(source) and iter(source) is source:
+        raise ValueError(
+            "resilient() needs a re-iterable source: pass a callable "
+            "returning a fresh iterator (or a list of batches), not a "
+            "one-shot generator/iterator"
+        )
+
+    def gen():
+        delivered = 0  # batches yielded to the consumer
+        dropped = set()  # absolute indices written off against the quota
+        attempt = 0  # failures of the batch at `attempt_idx`
+        attempt_idx = -1  # the budget is PER BATCH, not pooled
+        swallowed_last = False  # previous fetch was a dropped batch failing
+        while True:
+            src = source() if callable(source) else iter(source)
+            pos = 0  # absolute index of the next fetch from this iterator
+            restart = False
+            while not restart:
+                # everything before `target` was already handled: either
+                # delivered to the consumer (replayed silently) or
+                # dropped (its failure swallowed)
+                target = delivered + len(dropped)
+                idx = pos
+                try:
+                    batch = next(src)
+                    pos += 1
+                    swallowed_last = False
+                except StopIteration:
+                    if idx < target:
+                        raise RuntimeError(
+                            f"stream source ended at batch {idx} while "
+                            f"replaying to batch {target}: the source "
+                            "shrank (or a non-resumable iterator died on "
+                            "a dropped batch) — refusing to silently "
+                            "truncate the stream"
+                        )
+                    if swallowed_last:
+                        # undetectable generator-death-vs-final-bad-batch
+                        # ambiguity (see docstring): be loud about it
+                        logger.warning(
+                            "stream ended immediately after dropped batch "
+                            "%d; if the source is a plain generator its "
+                            "remaining batches are unreachable (use a "
+                            "batch-resumable iterator with "
+                            "max_bad_batches)",
+                            idx - 1,
+                        )
+                    return
+                except Exception as e:
+                    pos += 1
+                    if idx in dropped:
+                        swallowed_last = True
+                        continue  # a written-off batch failing again
+                    swallowed_last = False
+                    if idx != attempt_idx:
+                        attempt_idx, attempt = idx, 0
+                    attempt += 1
+                    if attempt <= retries:
+                        delay = min(
+                            max_delay, base_delay * (2.0 ** (attempt - 1))
+                        )
+                        logger.warning(
+                            "stream batch %d failed (%s); retry %d/%d "
+                            "in %.2fs",
+                            idx,
+                            e,
+                            attempt,
+                            retries,
+                            delay,
+                        )
+                        sleep(delay)
+                        # the iterator is suspect after an exception:
+                        # restart fresh and replay rather than pull more
+                        restart = True
+                        continue
+                    if idx >= target and len(dropped) < max_bad_batches:
+                        dropped.add(idx)
+                        attempt_idx, attempt = -1, 0
+                        # if the source is a dead generator, the next
+                        # fetch is StopIteration — flag it so the
+                        # truncation warning above fires
+                        swallowed_last = True
+                        logger.warning(
+                            "stream batch %d failed %d times; dropping "
+                            "it (%d/%d bad-batch quota used)",
+                            idx,
+                            retries + 1,
+                            len(dropped),
+                            max_bad_batches,
+                        )
+                        continue
+                    # out of quota — or an already-DELIVERED batch failed
+                    # its replay (dropping it would desync the consumer)
+                    raise
+                else:
+                    if idx == attempt_idx:
+                        # the batch that was failing came through
+                        attempt_idx, attempt = -1, 0
+                    if idx < target:
+                        continue  # replaying an already-delivered batch
+                    yield batch
+                    delivered += 1
 
     return gen
 

@@ -335,19 +335,25 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         )
         problem = fp.hexdigest()
 
+        from keystone_tpu.utils import durable
+
         def _read_checkpoint():
-            """(resume_epoch+1, w_host, p_host) or (0, zeros, zeros)."""
+            """(resume_epoch+1, w_host, p_host) or (0, zeros, zeros).
+            durable.load_npz scans newest→last-good: a corrupt newest
+            epoch checkpoint resumes from the previous epoch, not from
+            scratch."""
             w0 = np.zeros((nb, bs, k), np.float32)
             p0 = np.zeros(yc.shape, np.float32)
-            if not os.path.exists(path):
+            loaded = durable.load_npz(
+                path,
+                validate=lambda z: str(z.get("problem")) == problem
+                and z["w"].shape == w0.shape
+                and z["p"].shape == p0.shape,
+            )
+            if loaded is None:
                 return 0, w0, p0
-            try:
-                with np.load(path) as z:
-                    if str(z["problem"]) == problem:
-                        return int(z["epoch"]) + 1, z["w"], z["p"]
-            except Exception:
-                pass  # unreadable/corrupt checkpoint: fit from scratch
-            return 0, w0, p0
+            z, _ = loaded
+            return int(z["epoch"]) + 1, z["w"], z["p"]
 
         if jax.process_count() > 1:
             # processes must enter the epoch loop at the SAME iteration
@@ -386,18 +392,27 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         for e in range(start, self.num_iter):
             w, p = _bcd_epoch(xb, yc, nf, self.lam, w, p)
             jax.block_until_ready(w)
-            # atomic write: a crash mid-save must not destroy the
-            # checkpoint; per-process tmp names so concurrent writers on
-            # a shared dir never truncate each other mid-write
-            tmp = f"{path}.tmp.{jax.process_index()}.npz"
-            np.savez(
-                tmp,
-                epoch=e,
-                w=gather_to_host(w),
-                p=gather_to_host(p),
-                problem=problem,
-            )
-            os.replace(tmp, path)
+            # the gathers are COLLECTIVES: every process must run them
+            w_host = gather_to_host(w)
+            p_host = gather_to_host(p)
+            # … but only process 0 writes: rotation + sidecar are not
+            # concurrent-writer-safe on a shared dir, and the resume
+            # decision is read by process 0 alone anyway (broadcast).
+            # durable.save_npz = atomic tmp+fsync+rename, BLAKE2b
+            # sidecar, previous epoch rotated to <path>.1 — the
+            # last-good fallback _read_checkpoint resumes from when the
+            # newest save is later found corrupt
+            if jax.process_index() == 0:
+                durable.save_npz(
+                    path,
+                    {
+                        "epoch": np.asarray(e),
+                        "w": w_host,
+                        "p": p_host,
+                        "problem": np.asarray(problem),
+                    },
+                    keep=2,
+                )
         return finish_block_model(
             w, xm, ym, x.shape[1], self.block_size, self.fit_intercept
         )
@@ -588,16 +603,20 @@ def _oc_bcd_fit(
         fp.update(_mh.gather_to_host(alpha[: min(n_rows, 64)]).tobytes())
         problem = fp.hexdigest()
 
+        from keystone_tpu.utils import durable
+
         def _read_oc_checkpoint():
-            if not os.path.exists(ckpt_path):
+            # newest→last-good scan (utils/durable): a corrupt newest
+            # epoch falls back to the previous one instead of a scratch fit
+            loaded = durable.load_npz(
+                ckpt_path,
+                validate=lambda z: str(z.get("problem")) == problem
+                and z["w"].shape == (nb, bs, k),
+            )
+            if loaded is None:
                 return 0, None, None
-            try:
-                with np.load(ckpt_path) as z:
-                    if str(z["problem"]) == problem:
-                        return int(z["epoch"]) + 1, np.asarray(z["w"]), np.asarray(z["p"])
-            except Exception:
-                pass  # unreadable checkpoint: fit from scratch
-            return 0, None, None
+            z, _ = loaded
+            return int(z["epoch"]) + 1, np.asarray(z["w"]), np.asarray(z["p"])
 
         if jax.process_count() > 1:
             # every sweep runs collectives, so processes must enter the
@@ -650,17 +669,26 @@ def _oc_bcd_fit(
         if (i + 1) % nb == 0:
             if ckpt_path is not None:
                 jax.block_until_ready(p)
-                # per-process tmp names: concurrent writers on a shared
-                # dir must never truncate each other mid-write
-                tmp = f"{ckpt_path}.tmp.{jax.process_index()}.npz"
-                np.savez(
-                    tmp,
-                    epoch=epoch,
-                    w=np.stack([_mh.gather_to_host(x) for x in w]),
-                    p=_mh.gather_to_host(p),
-                    problem=problem,
-                )
-                os.replace(tmp, ckpt_path)
+                # collectives first (every process participates) …
+                w_host = np.stack([_mh.gather_to_host(x) for x in w])
+                p_host = _mh.gather_to_host(p)
+                # … then ONE writer: rotation + sidecar are not
+                # concurrent-writer-safe, and resume reads are process-0
+                # + broadcast anyway.  durable.save_npz = atomic
+                # tmp+fsync+rename + checksum sidecar + previous epoch
+                # rotated to <path>.1 (the resume scan's last-good
+                # fallback)
+                if jax.process_index() == 0:
+                    durable.save_npz(
+                        ckpt_path,
+                        {
+                            "epoch": np.asarray(epoch),
+                            "w": w_host,
+                            "p": p_host,
+                            "problem": np.asarray(problem),
+                        },
+                        keep=2,
+                    )
             epoch += 1
     weights = jnp.stack(w)
     return weights, xm.reshape(-1), ym

@@ -171,6 +171,15 @@ def _gmm_fit(x, n, row_ok, k, iters, min_var, seed, kmeans_iters):
         x = x * valid[:, None]
         n = jnp.sum(valid)
         row_ok = valid
+    elif row_ok is not None:  # 1-D row mask (n,): valid-row indicator
+        # n may arrive as None (fit_dataset's mask branch) — derive it
+        # from the mask, and zero masked rows so they can't leak into
+        # the moment sums (the pre-r5 handling, regressed when the
+        # ragged path was fused into this jit)
+        row_ok = (row_ok.reshape(-1) > 0).astype(jnp.float32)
+        x = x * row_ok[:, None]
+        if n is None:
+            n = jnp.sum(row_ok)
     elif row_ok is None:
         row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)
     key = jax.random.PRNGKey(seed)

@@ -258,17 +258,21 @@ def _lbfgs_checkpoint_callbacks(
     checkpoint_dir: str, problem: str, tag: str, flat_size: int, m: int
 ):
     """(load_cb, save_cb) persisting the L-BFGS carry to
-    ``<dir>/lbfgs_<tag>.npz`` with the _oc_bcd_fit conventions
-    (block_ls.py § _oc_bcd_fit): content-fingerprint validation, atomic
-    tmp+replace writes, and — multi-process — process 0 alone reads and
-    BROADCASTS the resume decision, because every process must enter the
-    chunk loop at the same iteration or the collectives deadlock.
-    ``flat_size``/``m`` let every process build the carry template
-    locally, so the broadcast pytree has uniform shapes with or without
-    a checkpoint on disk."""
+    ``<dir>/lbfgs_<tag>.npz`` through the hardened durable layer
+    (utils/durable: atomic tmp+fsync+rename, BLAKE2b sidecar, rolling
+    last-good fallback — a corrupt newest checkpoint resumes from the
+    previous chunk instead of refitting from scratch), with
+    content-fingerprint validation and — multi-process — process 0 alone
+    reading and BROADCASTING the resume decision, because every process
+    must enter the chunk loop at the same iteration or the collectives
+    deadlock.  ``flat_size``/``m`` let every process build the carry
+    template locally, so the broadcast pytree has uniform shapes with or
+    without a checkpoint on disk."""
     import os
 
     import numpy as np
+
+    from keystone_tpu.utils import durable
 
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, f"lbfgs_{tag}.npz")
@@ -284,21 +288,18 @@ def _lbfgs_checkpoint_callbacks(
         np.bool_(False),
     )
 
+    def _valid(z) -> bool:
+        if str(z.get("problem")) != problem:
+            return False  # a different fit's checkpoint: not corrupt, stale
+        carry = tuple(np.asarray(z[k]) for k in keys)
+        return all(a.shape == t.shape for a, t in zip(carry, template))
+
     def _read():
-        if not os.path.exists(path):
-            return None
-        try:
-            with np.load(path) as z:
-                if str(z["problem"]) != problem:
-                    return None
-                carry = tuple(np.asarray(z[k]) for k in keys)
-                if any(
-                    a.shape != t.shape for a, t in zip(carry, template)
-                ):
-                    return None  # different history cap / model size
-                return int(z["it"]), carry
-        except Exception:
-            return None  # unreadable checkpoint: fit from scratch
+        loaded = durable.load_npz(path, validate=_valid)
+        if loaded is None:
+            return None  # no valid checkpoint at any depth: fit from scratch
+        z, _ = loaded
+        return int(z["it"]), tuple(np.asarray(z[k]) for k in keys)
 
     def load_cb():
         if jax.process_count() == 1:
@@ -325,14 +326,15 @@ def _lbfgs_checkpoint_callbacks(
         # device→host copy
         if jax.process_index() != 0:
             return
-        tmp = f"{path}.tmp.{os.getpid()}.npz"
-        np.savez(
-            tmp,
-            it=np.int32(it),
-            problem=problem,
-            **{k: np.asarray(a) for k, a in zip(keys, carry)},
+        durable.save_npz(
+            path,
+            dict(
+                {k: np.asarray(a) for k, a in zip(keys, carry)},
+                it=np.int32(it),
+                problem=problem,
+            ),
+            keep=2,
         )
-        os.replace(tmp, path)
 
     return load_cb, save_cb
 

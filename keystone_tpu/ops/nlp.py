@@ -133,11 +133,16 @@ def _native_chain(ds):
 
 
 def _base_docs(base) -> Optional[list]:
-    """Raw doc list of an in-memory host base dataset, or None."""
+    """Raw doc list of an in-memory host base dataset, or None.
+
+    Checks EVERY item, not just ``docs[0]``: a heterogeneous host list
+    (one stray non-str doc) must fall back to the Python path like the
+    stream variants do, instead of dying in native packing with an
+    ``AttributeError`` on ``.encode``."""
     if not base.is_host:
         return None
     docs = base.items
-    if docs and not isinstance(docs[0], str):
+    if docs and not all(isinstance(d, str) for d in docs):
         return None
     return docs
 

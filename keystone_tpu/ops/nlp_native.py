@@ -99,6 +99,12 @@ def chain_config(stages) -> Optional[dict]:
         1 <= n <= 8 for n in ngrams.orders
     ):
         return None
+    if len(set(ngrams.orders)) != len(ngrams.orders):
+        # duplicate orders (e.g. (1, 1)) collapse in the orders_mask, so
+        # the native path would emit each n-gram once where the Python
+        # path counts it per duplicate — silently halving tf values.
+        # Fall back to the Python path, which honors duplicates.
+        return None
     if not isinstance(tf, TermFrequency) or tf.fn not in (None, log_tf):
         return None
     mask = 0

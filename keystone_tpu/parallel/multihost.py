@@ -35,6 +35,8 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    retries: Optional[int] = None,
+    initialization_timeout: Optional[int] = None,
 ) -> None:
     """jax.distributed.initialize with TPU auto-detection when args are None.
 
@@ -43,11 +45,22 @@ def initialize(
     is impossible — so this function inspects jax's distributed state
     directly instead of calling backend-touching APIs).  No-op when
     already initialized, or when no coordinator is configured (plain
-    single-process use).  Real initialization errors propagate.
+    single-process use).
+
+    Hardened: connecting to the coordinator retries with exponential
+    backoff + jitter (``retries=None`` resolves KEYSTONE_INIT_RETRIES,
+    default 2 — restarted jobs routinely race their coordinator coming
+    back up), ``initialization_timeout`` forwards to jax's barrier
+    timeout, and the attempt carries the ``multihost.init`` fault site
+    so chaos plans can exercise exactly this path.  Deterministic
+    initialization errors still propagate once the budget is spent.
     """
     import os
 
     from jax._src import distributed as _dist
+
+    from keystone_tpu.faults import fault_point
+    from keystone_tpu.utils import durable
 
     if getattr(_dist.global_state, "client", None) is not None:
         return  # already initialized
@@ -60,10 +73,41 @@ def initialize(
     ):
         logger.debug("no coordinator configured; staying single-process")
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    if retries is None:
+        retries = durable._env_int("KEYSTONE_INIT_RETRIES", 2)
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = int(initialization_timeout)
+
+    def _init():
+        fault_point("multihost.init")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except Exception:
+            # jax assigns global client/service BEFORE connecting, so a
+            # failed connect leaves them set and every retry would hit
+            # "initialize should only be called once" — clear the
+            # partial state so the retry actually reconnects, and the
+            # surfaced error stays the real one
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                _dist.global_state.client = None
+                _dist.global_state.service = None
+            raise
+
+    durable.with_retries(
+        _init,
+        retries=retries,
+        base_delay=0.5,
+        max_delay=10.0,
+        retry_on=(OSError, ConnectionError, RuntimeError),
+        description="distributed init",
     )
 
 

@@ -15,6 +15,8 @@ from keystone_tpu.utils.stats import (  # noqa: F401
     rand_matrix_uniform,
 )
 from keystone_tpu.utils import tracing  # noqa: F401
+from keystone_tpu.utils import durable  # noqa: F401
+from keystone_tpu.utils.durable import CorruptStateError  # noqa: F401
 
 # Test-fixture generators (the reference's src/test/scala/utils/TestUtils
 # analogue) live in keystone_tpu.utils.test_utils — import that module

@@ -29,7 +29,11 @@ from keystone_tpu.workflow.graph import (  # noqa: F401
     TransformerOperator,
 )
 from keystone_tpu.workflow.executor import GraphExecutor  # noqa: F401
-from keystone_tpu.workflow.recovery import fit_with_recovery  # noqa: F401
+from keystone_tpu.workflow.recovery import (  # noqa: F401
+    fit_with_recovery,
+    purge_invalid_state,
+    scan_state_dir,
+)
 from keystone_tpu.workflow.optimizer import (  # noqa: F401
     AutoMaterializeRule,
     EquivalentNodeMergeRule,

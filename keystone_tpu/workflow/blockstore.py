@@ -40,8 +40,18 @@ from typing import Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from keystone_tpu.faults import fault_point
+
 _META = "meta.json"
 _DTYPES = ("float32", "bfloat16")
+
+
+def _verify_blocks_enabled() -> bool:
+    """Per-read checksum verification kill switch (KEYSTONE_VERIFY_BLOCKS
+    =0).  BLAKE2b streams at memory-ish bandwidth, so verification is
+    roughly a second disk pass per sweep — on by default because a
+    silently-corrupt feature block poisons every subsequent epoch."""
+    return os.environ.get("KEYSTONE_VERIFY_BLOCKS", "1") != "0"
 
 
 def _bf16():
@@ -107,6 +117,17 @@ class FeatureBlockStore:
             del mm  # flushed zero-initialized file
         store = cls(directory)
         store._cursor = 0
+        # incremental payload digests, fed from the IN-MEMORY chunks as
+        # they are written: finalize() compares them against what the
+        # files actually contain, so corruption introduced by the write
+        # path itself (torn write, bit flip between buffer and platter)
+        # is caught at seal time — a sidecar hashed from the file alone
+        # would faithfully checksum the damage
+        import hashlib
+
+        store._hashers = [
+            hashlib.blake2b(digest_size=16) for _ in range(nb)
+        ]
         return store
 
     @staticmethod
@@ -134,13 +155,65 @@ class FeatureBlockStore:
                 chunk = chunk.astype(_bf16()).view(np.uint16)
             mm[start:stop] = chunk
             del mm
+            hashers = getattr(self, "_hashers", None)
+            if hashers is not None:
+                hashers[b].update(np.ascontiguousarray(chunk).tobytes())
+            fault_point(
+                "blockstore.write", path=self._block_path(self.directory, b)
+            )
         self._cursor = stop
+
+    def finalize(self) -> None:
+        """Seal a fully-written store: verify each block file's payload
+        against the digest accumulated from the in-memory chunks during
+        :meth:`append_rows` (write-path corruption — a torn or flipped
+        write — surfaces HERE as :class:`CorruptStateError`, at spill
+        time, instead of training on damaged features), then write a
+        BLAKE2b sidecar per block so every later :meth:`read_block`
+        verifies content integrity (truncation is caught even without
+        sidecars via the size check).  ``from_array`` / ``from_batches``
+        call this automatically; streaming ``append_rows`` writers call
+        it once the last row lands."""
+        import hashlib
+
+        from keystone_tpu.utils import durable
+
+        hashers = getattr(self, "_hashers", None)
+        complete = getattr(self, "_cursor", None) == self.n
+        for b in range(self.num_blocks):
+            path = self._block_path(self.directory, b)
+            if hashers is not None and complete:
+                try:
+                    raw = np.load(path, mmap_mode="r")
+                    h = hashlib.blake2b(digest_size=16)
+                    # stream row chunks off the memmap: the store exists
+                    # because n×d does NOT fit in memory, so seal-time
+                    # verification must stay O(chunk), not O(block)
+                    row_bytes = max(1, raw.shape[1] * raw.itemsize)
+                    step = max(1, (4 << 20) // row_bytes)
+                    for s in range(0, raw.shape[0], step):
+                        h.update(
+                            np.ascontiguousarray(raw[s : s + step]).tobytes()
+                        )
+                    on_disk = h.hexdigest()
+                except Exception as e:
+                    raise durable.CorruptStateError(
+                        f"unreadable block {path} at seal time: {e}"
+                    )
+                if on_disk != hashers[b].hexdigest():
+                    raise durable.CorruptStateError(
+                        f"write verification failed for block {path}: "
+                        "on-disk payload does not match the bytes that "
+                        "were written (torn or corrupted write)"
+                    )
+            durable.write_checksum(path)
 
     @classmethod
     def from_array(cls, directory: str, x, block_size: int, dtype: str = "float32"):
         x = np.asarray(x, np.float32)
         store = cls.create(directory, x.shape[0], x.shape[1], block_size, dtype=dtype)
         store.append_rows(x)
+        store.finalize()
         return store
 
     @classmethod
@@ -167,16 +240,51 @@ class FeatureBlockStore:
             raise ValueError(
                 f"batch stream produced {store._cursor} rows, expected {n}"
             )
+        store.finalize()
         return store
 
     # -------------------------------------------------------------- read
     def read_block(self, b: int) -> np.ndarray:
         """One (n, block_size) block, as an in-memory host array.
 
+        Hardened: transient read errors retry with backoff
+        (utils/durable), a truncated file (partial write, torn spill)
+        raises :class:`~keystone_tpu.utils.durable.CorruptStateError`
+        before any bytes reach a solver, and sealed stores
+        (:meth:`finalize`) additionally checksum-verify the content.
+
         bf16 stores return ml_dtypes.bfloat16 — consumers transfer the
         half-width bytes to device and cast to f32 THERE (halving the
         host→device wire cost, the scarce resource on this backend)."""
-        raw = np.array(np.load(self._block_path(self.directory, b), mmap_mode="r"))
+        from keystone_tpu.utils import durable
+
+        path = self._block_path(self.directory, b)
+        expected_bytes = (
+            self.n * self.block_size * np.dtype(self._disk_dtype).itemsize
+        )
+
+        def _read():
+            fault_point("blockstore.read", path=path)
+            if os.path.getsize(path) < expected_bytes:
+                raise durable.CorruptStateError(
+                    f"truncated block {path}: {os.path.getsize(path)} bytes "
+                    f"< {expected_bytes} of payload for shape "
+                    f"({self.n}, {self.block_size})"
+                )
+            if _verify_blocks_enabled():
+                durable.verify_checksum(path)  # no-op for unsealed stores
+            try:
+                raw = np.array(np.load(path, mmap_mode="r"))
+            except ValueError as e:  # npy header inconsistent with size
+                raise durable.CorruptStateError(f"corrupt block {path}: {e}")
+            if raw.shape != (self.n, self.block_size):
+                raise durable.CorruptStateError(
+                    f"block {path} has shape {raw.shape}, expected "
+                    f"({self.n}, {self.block_size})"
+                )
+            return raw
+
+        raw = durable.with_retries(_read, description=f"block read {path}")
         if self.dtype == "bfloat16":
             return raw.view(_bf16())
         return raw

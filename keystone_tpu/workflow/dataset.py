@@ -162,6 +162,8 @@ class StreamDataset(Dataset):
         name: Optional[str] = None,
         prefetch: int = 0,
         host: bool = False,
+        retries: int = 0,
+        max_bad_batches: int = 0,
     ):
         self.name = name
         self.n = int(n)
@@ -176,6 +178,15 @@ class StreamDataset(Dataset):
                 "StreamDataset source must be re-iterable: pass a callable "
                 "returning a fresh iterator (or a list of batches), not a "
                 "one-shot generator/iterator"
+            )
+        if retries > 0 or max_bad_batches > 0:
+            # flaky-source hardening (loaders/stream.resilient): bounded
+            # per-batch retry with backoff, then a drop quota — wrapped
+            # UNDER prefetched so retries run on the producer thread
+            from keystone_tpu.loaders.stream import resilient
+
+            source = resilient(
+                source, retries=retries, max_bad_batches=max_bad_batches
             )
         if prefetch > 0:
             from keystone_tpu.loaders.stream import prefetched

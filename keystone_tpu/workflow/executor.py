@@ -82,8 +82,15 @@ class GraphExecutor:
         op = self.graph.operators[target]
         deps = [self._eval(d) for d in self.graph.dependencies[target]]
         t0 = time.perf_counter() if self.profile else 0.0
+        delays = None
         for attempt in range(self.node_retries + 1):
             try:
+                # the fault site sits INSIDE the retry scope: an injected
+                # stage fault with retries configured must be survived,
+                # which is exactly what the chaos tests assert
+                from keystone_tpu.faults import fault_point
+
+                fault_point("executor.stage", node=op.label())
                 result = self._execute_op(op, deps)
                 break
             except Exception as e:
@@ -96,6 +103,18 @@ class GraphExecutor:
                     attempt + 1,
                     self.node_retries,
                 )
+                # brief backoff (+jitter) before the re-run: transient
+                # causes (preemption, flaky interconnect) need a beat to
+                # clear, and decorrelating parallel executors helps
+                if delays is None:
+                    from keystone_tpu.utils.durable import backoff_delays
+
+                    delays = iter(
+                        backoff_delays(
+                            self.node_retries, base_delay=0.05, max_delay=1.0
+                        )
+                    )
+                time.sleep(next(delays, 1.0))
         if self.profile:
             _sync_expr(result)
             self.timings[target] = time.perf_counter() - t0

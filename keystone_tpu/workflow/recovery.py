@@ -25,9 +25,60 @@ task retry, speculative execution.  The TPU-era decomposition here:
 from __future__ import annotations
 
 import logging
-from typing import Callable, Optional, Tuple
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
+
+
+def scan_state_dir(state_dir: str) -> Dict[str, List[str]]:
+    """Classify the ``.npz`` durable-state files under ``state_dir``
+    (recursively: solver checkpoint dirs nest) as valid / corrupt.
+
+    Validity is the durable layer's contract (utils/durable): the
+    checksum sidecar matches when present, and the npz parses.  Files
+    without a sidecar only fail on unreadability (legacy state keeps
+    loading).  Returns ``{"valid": [...], "corrupt": [...]}``.
+    """
+    import numpy as np
+
+    from keystone_tpu.utils import durable
+
+    out: Dict[str, List[str]] = {"valid": [], "corrupt": []}
+    for root, _dirs, files in os.walk(state_dir):
+        for name in files:
+            if not (name.endswith(".npz") or ".npz." in name):
+                continue
+            if ".tmp." in name or name.endswith(durable.CHECKSUM_SUFFIX):
+                continue
+            if name.endswith(".corrupt"):
+                continue
+            path = os.path.join(root, name)
+            try:
+                durable.verify_checksum(path)
+                with np.load(path, allow_pickle=False) as z:
+                    z.files  # force the header parse
+                out["valid"].append(path)
+            except Exception:
+                out["corrupt"].append(path)
+    return out
+
+
+def purge_invalid_state(state_dir: str) -> List[str]:
+    """Quarantine corrupt durable-state files (renamed ``*.corrupt``) so
+    resume scans stop tripping over them; rotated last-good copies
+    (``<file>.1`` …) are left for the solvers' fallback loads.  Returns
+    the quarantined paths.  Called between ``fit_with_recovery``
+    attempts — a restart after a torn write starts from a clean scan."""
+    from keystone_tpu.utils import durable
+
+    quarantined = []
+    for path in scan_state_dir(state_dir)["corrupt"]:
+        dest = durable.quarantine(path)
+        if dest is not None:
+            quarantined.append(dest)
+    return quarantined
 
 
 def fit_with_recovery(
@@ -70,6 +121,11 @@ def fit_with_recovery(
     if state_dir is not None:
         PipelineEnv.state_dir = state_dir
     try:
+        from keystone_tpu.utils.durable import backoff_delays
+
+        delays = iter(
+            backoff_delays(max_restarts, base_delay=0.1, max_delay=2.0)
+        )
         last_err: Optional[BaseException] = None
         for attempt in range(max_restarts + 1):
             try:
@@ -88,6 +144,13 @@ def fit_with_recovery(
                     e,
                     max_restarts - attempt,
                 )
+                if state_dir is not None:
+                    # quarantine corrupt durable state before the resume
+                    # scan: the restart must load last-good checkpoints,
+                    # not re-crash on the same torn file
+                    purge_invalid_state(state_dir)
+                # jittered backoff: restarting fleets must decorrelate
+                time.sleep(next(delays, 2.0))
         raise last_err  # unreachable; keeps type checkers calm
     finally:
         PipelineEnv.state_dir = prev_state_dir

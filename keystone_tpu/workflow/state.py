@@ -100,17 +100,27 @@ class SavedStateLoadRule(Rule):
 
 
 def save_dataset(ds: Dataset, path: str) -> None:
+    from keystone_tpu.utils import durable
+
     payload = {"array": np.asarray(ds.array), "n": np.asarray(ds.n)}
     if ds.mask is not None:
         payload["mask"] = np.asarray(ds.mask)
-    np.savez(path, **payload)
+    # atomic + checksummed (utils/durable): a crash mid-save never leaves
+    # a half-written prefix for a later run to trip over, and bit rot is
+    # detected at load instead of silently reviving wrong features
+    durable.save_npz(path, payload, keep=1)
 
 
 def load_dataset(path: str) -> Dataset:
-    with np.load(path) as z:
-        arr = z["array"]
-        n = int(z["n"])
-        mask = z["mask"] if "mask" in z else None
+    from keystone_tpu.utils import durable
+
+    loaded = durable.load_npz(path)
+    if loaded is None:
+        raise durable.CorruptStateError(f"no valid saved dataset at {path}")
+    z, _ = loaded
+    arr = z["array"]
+    n = int(z["n"])
+    mask = z["mask"] if "mask" in z else None
     d = Dataset(arr, n=n, shard=True)
     if mask is not None:
         import jax.numpy as jnp
@@ -236,6 +246,11 @@ def save_pipeline_state(
                 save_dataset_orbax(expr.dataset, orbax_path)
                 if os.path.exists(npz_path):  # newest save must win reload
                     os.remove(npz_path)
+                from keystone_tpu.utils import durable
+
+                side = durable.checksum_path(npz_path)
+                if os.path.exists(side):
+                    os.remove(side)
             else:
                 save_dataset(expr.dataset, npz_path)
                 if os.path.isdir(orbax_path):

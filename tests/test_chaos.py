@@ -1,0 +1,365 @@
+"""Chaos tests: injected faults (keystone_tpu/faults.py) against the
+hardened durable-state layer (utils/durable.py) — tier-1, single
+process, CPU.  The multi-process kill tests live in test_faulttol.py;
+these lock the per-layer survival contracts:
+
+- a corrupt epoch checkpoint (injected via KEYSTONE_FAULTS, the
+  acceptance scenario) resumes from the newest VALID checkpoint and
+  bit-matches the uninterrupted fit;
+- a truncated blockstore block is detected before its bytes reach a
+  solver, and a retried fit re-spills and recovers;
+- a flaky stream source retries/drops per its quota;
+- injected read flakiness is absorbed by the bounded-retry layer.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.utils import durable
+from keystone_tpu.utils.durable import CorruptStateError
+
+pytestmark = pytest.mark.chaos
+
+
+def _problem(seed=0, n=96, d=24, k=3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n, k)).astype(np.float32)
+    return x, y
+
+
+def test_corrupt_epoch_checkpoint_resumes_from_last_good_bitmatch(
+    tmp_path, monkeypatch
+):
+    """The acceptance scenario: a BCD fit whose newest epoch checkpoint
+    is corrupted (via the KEYSTONE_FAULTS env plan, exactly what a
+    kill-worker harness would export) resumes from the newest *valid*
+    checkpoint and produces exactly the model of an uninterrupted run."""
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Dataset
+
+    x, y = _problem()
+    est = BlockLeastSquaresEstimator(
+        block_size=8, num_iter=5, lam=1e-3, fit_intercept=False
+    )
+
+    # --- control: uninterrupted 5-epoch fit
+    ref = est.fit_checkpointed(
+        Dataset(x), Dataset(y), checkpoint_dir=str(tmp_path / "ref")
+    )
+
+    # --- interrupted: 3 epochs, with the 3rd (newest) epoch checkpoint
+    # corrupted after it durably publishes
+    ckpt = str(tmp_path / "chaos")
+    monkeypatch.setenv(faults.ENV_VAR, "ckpt.save:after=2:times=1:corrupt")
+    short = BlockLeastSquaresEstimator(
+        block_size=8, num_iter=3, lam=1e-3, fit_intercept=False
+    )
+    short.fit_checkpointed(Dataset(x), Dataset(y), checkpoint_dir=ckpt)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+    path = os.path.join(ckpt, "bcd_epoch.npz")
+    with pytest.raises(CorruptStateError):
+        durable.verify_checksum(path)  # the newest save really is damaged
+    assert os.path.exists(path + ".1")  # … and a last-good sibling exists
+
+    # --- resume: the scan must skip the corrupt epoch-2 file, fall back
+    # to epoch 1, and re-run epochs 2..4 — landing on the control model
+    # EXACTLY (same epoch program, same state; gather/restore round-trips
+    # preserve float32 bits)
+    out = est.fit_checkpointed(Dataset(x), Dataset(y), checkpoint_dir=ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(out.weights), np.asarray(ref.weights)
+    )
+
+
+def test_corrupt_lbfgs_checkpoint_falls_back_bitmatch(tmp_path):
+    """Same contract for the L-BFGS carry checkpoints (the other solver
+    family): corrupt the newest chunk checkpoint, resume a longer fit,
+    match the uninterrupted trajectory exactly."""
+    from keystone_tpu.models.lbfgs import DenseLBFGSwithL2
+    from keystone_tpu.workflow import Dataset
+
+    x, y = _problem(seed=1, n=64, d=10, k=2)
+
+    def fit(num_iter, ckpt_dir):
+        est = DenseLBFGSwithL2(lam=1e-3, num_iterations=num_iter, history=4)
+        return est.fit_checkpointed(
+            Dataset(x),
+            Dataset(y),
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=2,
+        )
+
+    ref = fit(8, str(tmp_path / "ref"))
+
+    ckpt = str(tmp_path / "chaos")
+    with faults.inject("ckpt.save:after=1:times=1:corrupt"):
+        # saves land at it=2 and it=4; after=1 lets the first through and
+        # corrupts the it=4 save — the newest on disk
+        fit(4, ckpt)
+    path = os.path.join(ckpt, "lbfgs_dense.npz")
+    with pytest.raises(CorruptStateError):
+        durable.verify_checksum(path)
+
+    out = fit(8, ckpt)  # falls back to it=2, re-runs 2..8
+    np.testing.assert_array_equal(
+        np.asarray(out.weights), np.asarray(ref.weights)
+    )
+
+
+def test_truncated_block_detected_before_solver(tmp_path):
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    x, _ = _problem()
+    store = FeatureBlockStore.from_array(str(tmp_path / "store"), x, block_size=8)
+    good = np.array(store.read_block(1))
+    path = store._block_path(store.directory, 1)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CorruptStateError, match="truncated"):
+        store.read_block(1)
+    # other blocks still verify and read
+    np.testing.assert_array_equal(store.read_block(1 - 1).shape, good.shape)
+
+
+def test_corrupt_block_content_caught_by_checksum(tmp_path):
+    """Same-size corruption (no truncation to detect): only the sealed
+    store's BLAKE2b sidecar can catch it."""
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    x, _ = _problem()
+    store = FeatureBlockStore.from_array(str(tmp_path / "store"), x, block_size=8)
+    with faults.inject("blockstore.read:corrupt:times=1"):
+        with pytest.raises(CorruptStateError, match="checksum mismatch"):
+            store.read_block(0)  # corrupted in place, caught immediately
+    # the damage is persistent, not a one-read fluke
+    with pytest.raises(CorruptStateError, match="checksum mismatch"):
+        store.read_block(0)
+
+
+def test_corrupt_write_caught_at_seal_time(tmp_path):
+    """Corruption introduced by the write path itself (bytes flipped
+    between buffer and disk) cannot be caught by a sidecar hashed from
+    the file — finalize() verifies the on-disk payload against digests
+    of the in-memory chunks instead, failing the spill immediately."""
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    x, _ = _problem()
+    with faults.inject("blockstore.write:after=1:times=1:corrupt"):
+        with pytest.raises(CorruptStateError, match="write verification"):
+            FeatureBlockStore.from_array(
+                str(tmp_path / "store"), x, block_size=8
+            )
+
+
+def test_truncated_spill_recovers_via_refit(tmp_path):
+    """End-to-end: a spill torn mid-write (injected truncate on
+    blockstore.write) fails the fit attempt, and fit_with_recovery's
+    rebuild re-spills and completes — no user intervention."""
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Dataset, StreamDataset, fit_with_recovery
+    from keystone_tpu.loaders.stream import batched
+
+    x, y = _problem()
+    est = BlockLeastSquaresEstimator(
+        block_size=8, num_iter=2, lam=1e-3, fit_intercept=False
+    )
+
+    def build():
+        # one batch per spill: the injected truncation below hits the
+        # LAST write of a block, so the torn tail is never rewritten by
+        # a later append (that benign case heals by construction —
+        # np.memmap re-extends the file — and injects no failure)
+        return est.with_data(
+            StreamDataset(batched(x, x.shape[0]), n=x.shape[0]), Dataset(y)
+        )
+
+    ref = build().fit()(Dataset(x)).get().numpy()  # uninterrupted OOC fit
+
+    with faults.inject("blockstore.write:after=2:times=1:truncate"):
+        fitted, attempts = fit_with_recovery(build, max_restarts=2)
+    assert attempts >= 1  # the torn spill really did cost an attempt
+    got = fitted(Dataset(x)).get().numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_flaky_stream_source_retries_transparently():
+    from keystone_tpu.loaders.stream import resilient
+
+    state = {"fails": 0}
+
+    def src():
+        def it():
+            for i in range(5):
+                if i == 2 and state["fails"] < 2:
+                    state["fails"] += 1
+                    raise OSError("flaky read")
+                yield np.full((4, 3), i, np.float32)
+
+        return it()
+
+    out = list(resilient(src, retries=2, base_delay=0.0)())
+    assert state["fails"] == 2  # it really failed twice …
+    assert len(out) == 5  # … and the consumer never noticed
+    np.testing.assert_array_equal(out[2], np.full((4, 3), 2, np.float32))
+
+
+class _SkippableSource:
+    """Batch-resumable source (each fetch independent — the file-per-batch
+    reader shape), where a bad batch can actually be skipped."""
+
+    def __init__(self, n, bad, fail_always=True):
+        self.n, self.bad = n, bad
+
+    def __call__(self):
+        return _SkippableIter(self.n, self.bad)
+
+
+class _SkippableIter:
+    def __init__(self, n, bad):
+        self.i, self.n, self.bad = 0, n, bad
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.i >= self.n:
+            raise StopIteration
+        i = self.i
+        self.i += 1
+        if i == self.bad:
+            raise OSError(f"batch {i} is rotten")
+        return i
+
+
+def test_retry_budget_is_per_batch_not_pooled():
+    """Transient failures at DIFFERENT positions must not pool into one
+    budget: batch 3 failing once and batch 1 failing once (on replay)
+    are each within retries=1 and the stream must complete."""
+    from collections import defaultdict
+
+    from keystone_tpu.loaders.stream import resilient
+
+    counts = defaultdict(int)
+
+    class It:
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.i >= 5:
+                raise StopIteration
+            i = self.i
+            self.i += 1
+            counts[i] += 1
+            if i == 3 and counts[3] == 1:
+                raise OSError("transient at 3")
+            if i == 1 and counts[1] == 2:
+                raise OSError("transient at 1, during replay")
+            return i
+
+    out = list(resilient(It, retries=1, base_delay=0.0)())
+    assert out == [0, 1, 2, 3, 4]
+    assert counts[3] >= 2 and counts[1] >= 3  # both really failed
+
+
+def test_bad_batch_quota_drops_then_fails():
+    from keystone_tpu.loaders.stream import resilient
+
+    # quota 1: the deterministically-bad batch is dropped, rest delivered
+    out = list(
+        resilient(
+            _SkippableSource(5, bad=2),
+            retries=1,
+            max_bad_batches=1,
+            base_delay=0.0,
+        )()
+    )
+    assert out == [0, 1, 3, 4]
+
+    # quota 0 (default): retries exhaust, the error propagates
+    with pytest.raises(OSError, match="rotten"):
+        list(
+            resilient(
+                _SkippableSource(5, bad=2), retries=1, base_delay=0.0
+            )()
+        )
+
+
+def test_injected_read_flakiness_absorbed_by_retries(tmp_path):
+    """blockstore.read faults within the retry budget are survived — the
+    exact contract FaultInjected-is-an-OSError exists to guarantee."""
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    x, _ = _problem()
+    store = FeatureBlockStore.from_array(str(tmp_path / "store"), x, block_size=8)
+    faults.reset_stats()
+    with faults.inject("blockstore.read:every=2:raise"):
+        for b in range(store.num_blocks):
+            block = store.read_block(b)  # retry absorbs every injection
+            assert block.shape == (store.n, store.block_size)
+    st = faults.stats()
+    assert st["blockstore.read"]["injected"] >= store.num_blocks // 2
+
+
+def test_stream_dataset_retries_injected_batch_faults(monkeypatch):
+    """env-plan chaos through a real StreamDataset: one injected batch
+    fault, absorbed by the dataset's own resilient wrapper."""
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    x, _ = _problem()
+    monkeypatch.setenv(faults.ENV_VAR, "stream.batch:after=2:times=1:raise")
+    ds = StreamDataset(batched(x, 32), n=x.shape[0], retries=2)
+    rows = np.concatenate([np.asarray(b) for b in ds.batches()])
+    np.testing.assert_array_equal(rows, x)
+
+
+def test_executor_stage_faults_survived_with_retries():
+    """Injected stage faults ride the same retry budget as real ones."""
+    from keystone_tpu.workflow import Dataset, GraphExecutor, Pipeline, Transformer
+
+    class AddOne(Transformer):
+        def params(self):
+            return ()
+
+        def apply_dataset(self, ds):
+            return ds.with_array(ds.array + 1.0)
+
+    lazy = Pipeline.of(AddOne())(Dataset(np.ones((4, 2), np.float32)))
+    with faults.inject("executor.stage:times=2:raise"):
+        ex = GraphExecutor(lazy.graph, node_retries=2)
+        out = ex.execute(lazy.graph.sinks[0])
+    np.testing.assert_allclose(np.asarray(out.dataset.array), 2.0)
+
+    with faults.inject("executor.stage:times=3:raise"):
+        ex = GraphExecutor(lazy.graph, node_retries=1)
+        with pytest.raises(faults.FaultInjected):
+            ex.execute(lazy.graph.sinks[0])
+
+
+def test_purge_invalid_state_quarantines_only_corrupt(tmp_path):
+    from keystone_tpu.workflow.recovery import purge_invalid_state, scan_state_dir
+
+    good = str(tmp_path / "good.npz")
+    bad = str(tmp_path / "bad.npz")
+    durable.save_npz(good, {"w": np.ones(4)})
+    durable.save_npz(bad, {"w": np.ones(4)})
+    size = os.path.getsize(bad)
+    with open(bad, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xff\xff\xff\xff")
+    scan = scan_state_dir(str(tmp_path))
+    assert scan["valid"] == [good]
+    assert scan["corrupt"] == [bad]
+    quarantined = purge_invalid_state(str(tmp_path))
+    assert quarantined == [bad + ".corrupt"]
+    assert not os.path.exists(bad)
+    assert os.path.exists(good)

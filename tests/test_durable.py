@@ -1,0 +1,162 @@
+"""Hardened durable-I/O layer (keystone_tpu/utils/durable.py):
+checksummed atomic writes, retry/backoff, rolling last-good fallback."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.utils import durable
+from keystone_tpu.utils.durable import CorruptStateError
+
+
+def _flip_middle_byte(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_save_load_round_trip_with_checksum(tmp_path):
+    path = str(tmp_path / "state.npz")
+    arrays = {"w": np.arange(12, dtype=np.float32).reshape(3, 4), "it": np.int32(7)}
+    durable.save_npz(path, arrays)
+    assert os.path.exists(durable.checksum_path(path))
+    loaded = durable.load_npz(path)
+    assert loaded is not None
+    z, used = loaded
+    assert used == path
+    np.testing.assert_array_equal(z["w"], arrays["w"])
+    assert int(z["it"]) == 7
+
+
+def test_checksum_verification_catches_corruption(tmp_path):
+    path = str(tmp_path / "state.npz")
+    durable.save_npz(path, {"w": np.ones(64, np.float32)})
+    _flip_middle_byte(path)
+    with pytest.raises(CorruptStateError, match="checksum mismatch"):
+        durable.verify_checksum(path)
+
+
+def test_missing_sidecar_is_legacy_pass(tmp_path):
+    path = str(tmp_path / "old.npz")
+    with open(path, "wb") as f:
+        np.savez(f, w=np.zeros(3))
+    assert durable.verify_checksum(path) is False  # unverified, not fatal
+    with pytest.raises(CorruptStateError, match="missing checksum"):
+        durable.verify_checksum(path, required=True)
+    loaded = durable.load_npz(path)  # legacy files still load
+    assert loaded is not None
+
+
+def test_corrupt_newest_falls_back_to_last_good(tmp_path, caplog):
+    path = str(tmp_path / "ckpt.npz")
+    durable.save_npz(path, {"epoch": np.asarray(0)}, keep=2)
+    durable.save_npz(path, {"epoch": np.asarray(1)}, keep=2)
+    assert os.path.exists(path + ".1")  # previous epoch rotated aside
+    _flip_middle_byte(path)
+    z, used = durable.load_npz(path)
+    assert used == path + ".1"
+    assert int(z["epoch"]) == 0  # degraded to the last good epoch
+
+
+def test_all_candidates_corrupt_returns_none(tmp_path):
+    path = str(tmp_path / "ckpt.npz")
+    durable.save_npz(path, {"epoch": np.asarray(0)}, keep=2)
+    durable.save_npz(path, {"epoch": np.asarray(1)}, keep=2)
+    _flip_middle_byte(path)
+    _flip_middle_byte(path + ".1")
+    assert durable.load_npz(path) is None
+
+
+def test_validator_rejection_scans_deeper(tmp_path):
+    path = str(tmp_path / "ckpt.npz")
+    durable.save_npz(path, {"tag": np.asarray("good")}, keep=2)
+    durable.save_npz(path, {"tag": np.asarray("stale")}, keep=2)
+    z, used = durable.load_npz(
+        path, validate=lambda z: str(z["tag"]) == "good"
+    )
+    assert used == path + ".1"
+
+
+def test_retention_keeps_exactly_n(tmp_path):
+    path = str(tmp_path / "ckpt.npz")
+    for e in range(6):
+        durable.save_npz(path, {"epoch": np.asarray(e)}, keep=3)
+    assert sorted(
+        f for f in os.listdir(tmp_path) if not f.endswith(durable.CHECKSUM_SUFFIX)
+    ) == ["ckpt.npz", "ckpt.npz.1", "ckpt.npz.2"]
+    assert int(durable.load_npz(path)[0]["epoch"]) == 5
+    assert int(durable.load_npz(path + ".2")[0]["epoch"]) == 3
+
+
+def test_atomic_write_never_publishes_partial(tmp_path):
+    path = str(tmp_path / "state.npz")
+    durable.save_npz(path, {"w": np.zeros(8)})
+    before = durable.compute_checksum(path)
+
+    def exploding(tmp):
+        with open(tmp, "wb") as f:
+            f.write(b"partial garbage")
+        raise RuntimeError("crash mid-write")
+
+    with pytest.raises(RuntimeError, match="crash mid-write"):
+        durable.atomic_write(path, exploding)
+    # the published file is byte-identical to before the failed save
+    assert durable.compute_checksum(path) == before
+    durable.verify_checksum(path)
+
+
+def test_with_retries_backoff_and_budget():
+    calls = {"n": 0}
+    naps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert (
+        durable.with_retries(flaky, retries=3, sleep=naps.append) == "ok"
+    )
+    assert calls["n"] == 3
+    assert len(naps) == 2
+    assert naps[1] > naps[0] * 1.2  # backoff actually grows
+
+    calls["n"] = -10  # needs 13 calls; budget allows 3
+    with pytest.raises(OSError):
+        durable.with_retries(flaky, retries=2, sleep=lambda _: None)
+
+
+def test_with_retries_never_retries_corruption():
+    calls = {"n": 0}
+
+    def corrupt():
+        calls["n"] += 1
+        raise CorruptStateError("deterministic damage")
+
+    with pytest.raises(CorruptStateError):
+        durable.with_retries(corrupt, retries=5, sleep=lambda _: None)
+    assert calls["n"] == 1  # no futile retries
+
+
+def test_backoff_delays_deterministic_with_seed():
+    a = list(durable.backoff_delays(5, seed=3))
+    b = list(durable.backoff_delays(5, seed=3))
+    c = list(durable.backoff_delays(5, seed=4))
+    assert a == b
+    assert a != c
+    assert all(x <= 2.0 * 1.5 for x in a)  # max_delay * (1 + jitter)
+
+
+def test_quarantine_moves_file_and_sidecar(tmp_path):
+    path = str(tmp_path / "bad.npz")
+    durable.save_npz(path, {"w": np.zeros(4)})
+    dest = durable.quarantine(path)
+    assert dest == path + ".corrupt"
+    assert not os.path.exists(path)
+    assert os.path.exists(dest)
+    assert os.path.exists(durable.checksum_path(dest))

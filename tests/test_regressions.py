@@ -1,0 +1,113 @@
+"""Regression locks for the ADVICE r5 fixes that ride with the
+fault-injection PR: native-chain duplicate orders, GMM 1-D row masks,
+heterogeneous host doc lists."""
+
+import numpy as np
+import pytest
+
+
+def test_chain_config_rejects_duplicate_ngram_orders():
+    """ADVICE r5 (medium): a chain like NGramsFeaturizer((1, 1)) counts
+    every unigram twice on the Python path, but the native orders_mask
+    collapses duplicates — silently halving tf values.  chain_config must
+    return None so the chain falls back to the Python path."""
+    from keystone_tpu.ops.nlp import NGramsFeaturizer, TermFrequency, Tokenizer
+    from keystone_tpu.ops.nlp_native import chain_config
+
+    supported = [Tokenizer(), NGramsFeaturizer((1, 2)), TermFrequency()]
+    assert chain_config(supported) is not None  # sanity: pattern matches
+
+    dup = [Tokenizer(), NGramsFeaturizer((1, 1)), TermFrequency()]
+    assert chain_config(dup) is None
+
+    dup_mixed = [Tokenizer(), NGramsFeaturizer((2, 1, 2)), TermFrequency()]
+    assert chain_config(dup_mixed) is None
+
+
+def test_duplicate_order_python_path_counts_duplicates():
+    """The behavior the native path cannot reproduce (and so must not
+    claim): duplicate orders double every count."""
+    from keystone_tpu.ops.nlp import NGramsFeaturizer, TermFrequency
+
+    tokens = ["a", "b", "a"]
+    single = TermFrequency().apply_one(NGramsFeaturizer((1,)).apply_one(tokens))
+    doubled = TermFrequency().apply_one(NGramsFeaturizer((1, 1)).apply_one(tokens))
+    assert doubled == {k: 2 * v for k, v in single.items()}
+
+
+def test_gmm_fit_dataset_handles_1d_row_mask():
+    """ADVICE r5 (low): a 1-D row mask reached _gmm_fit with n=None and
+    crashed (DynamicJaxprTracer + NoneType).  It must fit, deriving the
+    true count from the mask and zeroing masked rows."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.models.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.workflow.dataset import Dataset
+
+    rng = np.random.default_rng(0)
+    n_valid, n_rows, d = 48, 64, 5
+    x = np.zeros((n_rows, d), np.float32)
+    x[:n_valid] = rng.normal(size=(n_valid, d)).astype(np.float32)
+    # garbage beyond the valid range: the mask must keep it out
+    x[n_valid:] = 1e6
+
+    est = GaussianMixtureModelEstimator(k=3, max_iterations=8, seed=0)
+    masked = Dataset(
+        x, n=n_rows, mask=jnp.asarray(np.arange(n_rows) < n_valid)
+    )
+    gm = est.fit_dataset(masked)  # crashed before the fix
+
+    assert np.isfinite(np.asarray(gm.means)).all()
+    assert np.isfinite(np.asarray(gm.weights)).all()
+    np.testing.assert_allclose(np.asarray(gm.weights).sum(), 1.0, atol=1e-4)
+    # the 1e6 garbage rows must not have pulled any component's mean
+    assert np.abs(np.asarray(gm.means)).max() < 100.0
+
+    # and the mask-derived count matches the n-based fit (identical
+    # math: same rows zeroed, same true count)
+    x_clean = np.zeros_like(x)
+    x_clean[:n_valid] = x[:n_valid]
+    gm_ref = est.fit_dataset(Dataset(x_clean, n=n_valid))
+    np.testing.assert_allclose(
+        np.sort(np.asarray(gm.means), axis=0),
+        np.sort(np.asarray(gm_ref.means), axis=0),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_base_docs_rejects_heterogeneous_host_lists():
+    """ADVICE r5 (low): _base_docs gated the native path on docs[0]
+    alone; a stray non-str doc later in the list died in native packing
+    with AttributeError on .encode.  It must return None (Python-path
+    fallback) like the stream variants."""
+    from keystone_tpu.ops.nlp import _base_docs
+    from keystone_tpu.workflow.dataset import Dataset
+
+    clean = Dataset(["one doc", "two docs"])
+    assert _base_docs(clean) == ["one doc", "two docs"]
+
+    hetero = Dataset(["one doc", {"not": "a str"}, "three"])
+    assert _base_docs(hetero) is None
+
+    first_bad = Dataset([None, "str later"])
+    assert _base_docs(first_bad) is None
+
+
+def test_heterogeneous_docs_fall_back_to_python_path():
+    """End-to-end: a featurize over a heterogeneous doc list must not
+    crash even when the native library is available — the dataset-level
+    gate routes it to the Python path, which raises the ordinary
+    per-item type error only if the items are truly unusable."""
+    from keystone_tpu.ops import nlp_native
+    from keystone_tpu.ops.nlp import CommonSparseFeatures
+    from keystone_tpu.workflow.dataset import Dataset
+
+    if not nlp_native.available():
+        pytest.skip("native text library not built")
+    # term-dict items (the Python path's contract) with full provenance
+    # absent: fit_dataset must take the Python branch without touching
+    # native packing
+    docs = Dataset([{"a": 1.0}, {"b": 2.0}])
+    model = CommonSparseFeatures(4).fit_dataset(docs)
+    assert set(model.vocab) == {"a", "b"}

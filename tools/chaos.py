@@ -1,0 +1,208 @@
+"""Run a pipeline under a fault plan and report per-site outcomes.
+
+The driver half of the chaos contract (keystone_tpu/faults.py injects,
+utils/durable.py survives): execute a workload with a KEYSTONE_FAULTS-
+grammar plan active and report, per site, how many calls passed through,
+how many faults were injected, and whether the workload survived.
+
+Usage (CPU-safe; any laptop)::
+
+    JAX_PLATFORMS=cpu python tools/chaos.py \
+        --plan "blockstore.read:every=3:raise;ckpt.save:after=1:times=1:corrupt" \
+        --workload bcd --restarts 1
+
+    # or drive your own entry point: any module:function() that runs a fit
+    JAX_PLATFORMS=cpu python tools/chaos.py --plan "..." \
+        --workload my_pkg.my_module:main
+
+Built-in workloads (synthetic, seconds-scale): ``bcd`` (checkpointed
+block coordinate descent), ``ooc`` (out-of-core streamed BCD — spills a
+FeatureBlockStore, exercising blockstore.*), ``lbfgs`` (chunk-
+checkpointed dense L-BFGS), ``stream`` (a resilient StreamDataset sweep).
+
+Exit code 0 = workload completed under the plan (all injected faults
+survived); 1 = the workload failed — the report's ``error`` names the
+escaping fault/exception.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bcd(tmp, restarts):
+    import numpy as np
+
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Dataset, fit_with_recovery
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 48)).astype(np.float32)
+    y = rng.normal(size=(256, 4)).astype(np.float32)
+    ckpt = os.path.join(tmp, "bcd-ckpt")
+
+    class CheckpointedBLS(BlockLeastSquaresEstimator):
+        def fit_dataset(self, data, labels=None):
+            return self.fit_checkpointed(data, labels, checkpoint_dir=ckpt)
+
+    est = CheckpointedBLS(block_size=16, num_iter=4, lam=1e-3)
+    fit_with_recovery(
+        lambda: est.with_data(Dataset(x), Dataset(y)),
+        state_dir=tmp,
+        max_restarts=restarts,
+    )
+
+
+def _ooc(tmp, restarts):
+    import numpy as np
+
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Dataset, StreamDataset, fit_with_recovery
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 48)).astype(np.float32)
+    y = rng.normal(size=(256, 4)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=16, num_iter=2, lam=1e-3)
+    fit_with_recovery(
+        lambda: est.with_data(
+            StreamDataset(batched(x, 64), n=x.shape[0]), Dataset(y)
+        ),
+        max_restarts=restarts,
+    )
+
+
+def _lbfgs(tmp, restarts):
+    import numpy as np
+
+    from keystone_tpu.models.lbfgs import DenseLBFGSwithL2
+    from keystone_tpu.workflow import Dataset, fit_with_recovery
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16)).astype(np.float32)
+    y = rng.normal(size=(128, 2)).astype(np.float32)
+    ckpt = os.path.join(tmp, "lbfgs-ckpt")
+
+    class CheckpointedLBFGS(DenseLBFGSwithL2):
+        def fit_dataset(self, data, labels=None):
+            return self.fit_checkpointed(
+                data, labels, checkpoint_dir=ckpt, checkpoint_every=3
+            )
+
+    est = CheckpointedLBFGS(lam=1e-3, num_iterations=9, history=4)
+    fit_with_recovery(
+        lambda: est.with_data(Dataset(x), Dataset(y)),
+        max_restarts=restarts,
+    )
+
+
+def _stream(tmp, restarts):
+    import numpy as np
+
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    ds = StreamDataset(batched(x, 32), n=512, retries=3)
+    total = sum(np.asarray(b).shape[0] for b in ds.batches())
+    if total != 512:
+        raise RuntimeError(f"stream delivered {total}/512 rows")
+
+
+WORKLOADS = {"bcd": _bcd, "ooc": _ooc, "lbfgs": _lbfgs, "stream": _stream}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run a workload under a KEYSTONE_FAULTS plan and "
+        "report per-site injected/survived counts"
+    )
+    ap.add_argument(
+        "--plan",
+        required=True,
+        help="fault plan, KEYSTONE_FAULTS grammar "
+        "(e.g. 'ckpt.save:after=1:corrupt;blockstore.read:p=0.1:seed=7')",
+    )
+    ap.add_argument(
+        "--workload",
+        default="bcd",
+        help=f"one of {sorted(WORKLOADS)} or module.path:function",
+    )
+    ap.add_argument(
+        "--restarts",
+        type=int,
+        default=1,
+        help="fit_with_recovery restart budget for the built-in workloads",
+    )
+    ap.add_argument(
+        "--tmp", default=None, help="scratch dir (default: a fresh tempdir)"
+    )
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    from keystone_tpu import faults
+
+    plan = faults.parse_plan(args.plan)  # fail fast on grammar errors
+    tmp = args.tmp or tempfile.mkdtemp(prefix="kst_chaos_")
+
+    if args.workload in WORKLOADS:
+        run = lambda: WORKLOADS[args.workload](tmp, args.restarts)  # noqa: E731
+    else:
+        modname, _, fnname = args.workload.partition(":")
+        fn = getattr(importlib.import_module(modname), fnname or "main")
+        run = fn
+
+    faults.reset_stats()
+    error = None
+    with faults.inject(plan):
+        try:
+            run()
+        except BaseException as e:  # report, don't crash the reporter
+            error = f"{type(e).__name__}: {e}"
+
+    stats = faults.stats()
+    escaped_site = None
+    if error is not None and "injected fault at" in error:
+        for site in faults.SITES:
+            if repr(site) in error:
+                escaped_site = site
+
+    def survived(site, counts):
+        # only claim survival when it is attributable: a clean run
+        # survived everything; an escaped FaultInjected pins one site;
+        # any other failure (e.g. a downstream CorruptStateError from a
+        # corrupt action) leaves per-site survival unknown -> null
+        if error is None:
+            return counts["injected"]
+        if site == escaped_site:
+            return counts["injected"] - 1
+        return None
+
+    report = {
+        "plan": args.plan,
+        "workload": args.workload,
+        "completed": error is None,
+        "error": error,
+        "sites": {
+            site: {
+                "calls": counts["calls"],
+                "injected": counts["injected"],
+                "survived": survived(site, counts),
+            }
+            for site, counts in sorted(stats.items())
+        },
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if error is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
