@@ -42,6 +42,13 @@ Since r5 it also carries the at-scale artifacts (VERDICT r4 item 5):
 true-f32 TF/s with band); ``fit_at_scale`` — the full two-branch fit at
 n=8192 (the shape-stable chunked-apply regime).
 
+Since r6 the line also carries ``precision_sweep`` — the headline
+forward re-measured under each matmul policy (f32 / auto / bf16_apply,
+one pinned-env subprocess leg per mode; BENCH_PRECISION_LEGS legs each,
+0 disables) so the bf16 apply-path win (and any regression) lands in
+BENCH_*.json as first-class ips / mfu_bf16_eff numbers next to the
+headline.
+
 Usage: python bench.py           # TPU (or default backend) + cached CPU leg
        python bench.py --cpu     # CPU-baseline leg only
        python bench.py --sweep   # batch sweep (prints one line per batch)
@@ -99,6 +106,18 @@ ATSCALE_N, ATSCALE_D, ATSCALE_K = 65536, 16384, 64
 ATSCALE_EPOCHS = 1
 FIT_SCALE_N = 8192
 SCALE_LEGS = int(os.environ.get("BENCH_SCALE_LEGS", "2"))
+
+# --- precision-mode sweep (ISSUE 2): the headline forward under each
+# matmul policy, one subprocess leg per (mode, leg) with KEYSTONE_MATMUL
+# pinned in the child env — so policy resolution, trace caches, and the
+# persistent compile cache are per-mode clean.  "f32" = full-precision
+# featurize policy, "auto" = the default (bf16 featurize on TPU),
+# "bf16_apply" = the opt-in apply path (utils/precision.py) whose
+# mfu_bf16_eff delta vs "auto" is the r6 headline claim.  On CPU hosts
+# all three resolve inert and the sweep just measures noise — it still
+# runs so the artifact shape is identical everywhere.
+PRECISION_MODES = ("f32", "auto", "bf16_apply")
+PRECISION_LEGS = int(os.environ.get("BENCH_PRECISION_LEGS", "1"))
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -530,17 +549,21 @@ def main():
     # anywhere in a ±25% band (VERDICT r2 item 7).  The first leg of
     # each runs in-process (it also pays any compile); later legs ride
     # the compilation cache.
-    def subprocess_leg(flag: str, required=("leg_ips",)):
+    def subprocess_leg(flag: str, required=("leg_ips",), env=None):
         try:
             # the run itself sits INSIDE the try: one hung leg (e.g. an
             # at-scale solver leg on a degraded tunnel) must skip, not
             # abort the whole multi-leg artifact
+            child_env = None
+            if env:
+                child_env = {**os.environ, **env}
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), flag],
                 capture_output=True,
                 text=True,
                 timeout=3600,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=child_env,
             )
             leg = json.loads(proc.stdout.strip().splitlines()[-1])
             # one malformed leg (e.g. a stray JSON log line on stdout)
@@ -606,6 +629,36 @@ def main():
         if lg
     ]
 
+    # precision-mode sweep: same headline program and estimator, one
+    # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
+    # "auto" mode IS the headline measurement when the parent env does
+    # not pin a policy, so those already-collected samples are reused
+    # instead of paying a redundant subprocess leg.
+    precision_sweep = {}
+    for mode in PRECISION_MODES if PRECISION_LEGS > 0 else ():
+        if mode == "auto" and not os.environ.get("KEYSTONE_MATMUL"):
+            vals = list(samples)
+        else:
+            vals = [
+                float(lg["leg_ips"])
+                for lg in (
+                    subprocess_leg("--leg", env={"KEYSTONE_MATMUL": mode})
+                    for _ in range(PRECISION_LEGS)
+                )
+                if lg
+            ]
+        if not vals:
+            continue
+        mips = float(np.median(vals))
+        mtf = mips * flops_per_image() / 1e12
+        precision_sweep[mode] = {
+            "images_per_sec": round(mips, 1),
+            "band": band(vals),
+            "tflops": round(mtf, 2),
+            "mfu_f32": round(mtf * 1e12 / _f32_peak(), 3),
+            "mfu_bf16_eff": round(mtf * 1e12 / _BF16_EFFECTIVE_PEAK, 3),
+        }
+
     cpu_ips = cpu_baseline_ips()
     vs = ips / cpu_ips if cpu_ips > 0 else None
     out = {
@@ -622,6 +675,8 @@ def main():
             "gmm_k": GMM_K, "pca_dims": PCA_DIMS, "classes": NUM_CLASSES,
         },
     }
+    if precision_sweep:
+        out["precision_sweep"] = precision_sweep
     if fit_legs:
         fit_s = [float(lg["fit_seconds"]) for lg in fit_legs]
         out["fit"] = {

@@ -78,8 +78,14 @@ class BlockLinearMapper(Transformer):
         return self.weights.reshape(nb * bs, k)
 
     def apply_batch(self, xs, mask=None):
+        from keystone_tpu.utils import precision
+
         return _block_predict(
-            xs, self.weights, self.intercept, self.feature_mean
+            xs,
+            self.weights,
+            self.intercept,
+            self.feature_mean,
+            mxu=precision.apply_mode(),
         )
 
     def apply_one(self, x):
@@ -114,21 +120,25 @@ def _offset(weights, feature_mean, intercept):
     return off
 
 
-@jax.jit
-def _block_predict(xs, weights, intercept, feature_mean):
+@partial(jax.jit, static_argnames=("mxu",))
+def _block_predict(xs, weights, intercept, feature_mean, mxu: str = "f32"):
     # Blocks are contiguous column ranges (blockify), so summing per-block
     # partials equals ONE flat matmul against the concatenated weights.
     # The blocked einsum compiled to a scan of dynamic-sliced weight reads
     # (async slice-copies dominated the scoring stage in device traces);
     # the flat dot streams the weights once, straight into the MXU.
+    # Scoring (not solving), so the flat dot is under the apply precision
+    # policy: 'bf16_apply' halves the (d × k) weight stream — at the
+    # headline shape that is 32768×1000 f32 read per batch — with f32
+    # accumulation; inert modes keep the exact pre-policy dot.
     xs = xs.astype(jnp.float32)
     nb, bs, k = weights.shape
     d = xs.shape[-1]
     if nb * bs != d:
         xs = jnp.pad(xs, ((0, 0), (0, nb * bs - d)))
-    out = jnp.dot(
-        xs, weights.reshape(nb * bs, k), preferred_element_type=jnp.float32
-    )
+    from keystone_tpu.utils import precision
+
+    out = precision.apply_dot(xs, weights.reshape(nb * bs, k), mode=mxu)
     out = out + _offset(weights, feature_mean, intercept)
     return out
 
@@ -185,11 +195,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return self._fit(data.array, labels.array, data.n)
 
     def fit_stream_dataset(
-        self, data, labels, spill_dir=None, checkpoint_dir=None
+        self, data, labels, spill_dir=None, checkpoint_dir=None, prefetch=None
     ) -> BlockLinearMapper:
         """Out-of-core fit: spill the streamed features to a block store
         once, then sweep blocks from disk (the default path when a
         StreamDataset reaches this estimator through the DAG).
+
+        ``prefetch`` — block read-ahead depth for the sweep (None →
+        ``KEYSTONE_OC_PREFETCH`` env, else 2; see :func:`_oc_prefetch`).
 
         The spill directory is deleted after a successful fit; on failure
         it is left behind for inspection (a later retry re-spills, and
@@ -205,13 +218,18 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             self.block_size,
             dtype=self.spill_dtype,
         )
-        fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
+        fitted = self.fit_store(
+            store, labels, checkpoint_dir=checkpoint_dir, prefetch=prefetch
+        )
         shutil.rmtree(store.directory, ignore_errors=True)
         return fitted
 
-    def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
+    def fit_store(
+        self, store, labels, checkpoint_dir=None, prefetch=None
+    ) -> BlockLinearMapper:
         """Fit from an existing FeatureBlockStore (features never fully
-        resident in HBM; see _oc_bcd_fit).
+        resident in HBM; see _oc_bcd_fit).  ``prefetch`` as in
+        :meth:`fit_stream_dataset`.
 
         Multi-process: ``store`` holds this process's row slice,
         ``labels`` is the GLOBAL label Dataset (made via
@@ -232,6 +250,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             self.num_iter,
             self.fit_intercept,
             checkpoint_dir=checkpoint_dir,
+            prefetch=prefetch,
         )
         return finish_block_model(
             weights, xm, ym, store.d, self.block_size, self.fit_intercept
@@ -262,14 +281,26 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             weights, xm, ym, x.shape[1], self.block_size, self.fit_intercept
         )
 
-    def fit_checkpointed(self, data, labels, checkpoint_dir: str):
+    def fit_checkpointed(self, data, labels, checkpoint_dir: str, prefetch=None):
         """Fit with per-epoch state checkpointing and resume.
 
         The reference has no mid-solver checkpointing (models are only
         saveable after fit — SURVEY.md §5); this closes that gap: each
         epoch's (W, P) lands in ``checkpoint_dir/bcd_epoch.npz``, and an
         interrupted fit resumes from the last completed epoch.
+
+        ``prefetch`` rides the signature for parity with
+        :meth:`fit_store` / :meth:`fit_stream_dataset`: when a
+        checkpointed fit is routed out-of-core (a StreamDataset source
+        spilled to a block store) the depth reaches ``_oc_bcd_fit``; the
+        in-memory path here stages no disk blocks, so it is unused.
         """
+        from keystone_tpu.workflow.dataset import StreamDataset as _SD
+
+        if isinstance(data, _SD):
+            return self.fit_stream_dataset(
+                data, labels, checkpoint_dir=checkpoint_dir, prefetch=prefetch
+            )
         import os
 
         import numpy as np
@@ -472,6 +503,20 @@ def _oc_block_step(a_raw, xm_b, yc, sa, row_ok, p, wb, lam_n):
     return wb_new, p_new
 
 
+def _oc_prefetch(explicit=None) -> int:
+    """Resolved read-ahead depth for out-of-core block staging: the
+    explicit caller value wins, else the ``KEYSTONE_OC_PREFETCH`` env
+    override, else 2 (the measured default — one block transferring
+    while one computes).  Deeper prefetch buys overlap on slow disks at
+    the cost of pinned host memory: each slot holds an (n × block_size)
+    f32/bf16 host block."""
+    from keystone_tpu.utils.durable import _env_int
+
+    if explicit is not None:
+        return max(1, int(explicit))
+    return max(1, _env_int("KEYSTONE_OC_PREFETCH", 2))
+
+
 def _check_store_rows(store, labels) -> None:
     """Single-process: store rows == label rows.  Multi-process: the
     per-process slices must jointly cover the global labels."""
@@ -497,12 +542,13 @@ def _oc_bcd_fit(
     num_iter,
     fit_intercept,
     checkpoint_dir=None,
-    prefetch: int = 2,
+    prefetch=None,
 ):
     """Stream feature blocks from ``store`` through BCD sweeps.
 
     ``y``: (n_rows, k) device labels, row-sharded; ``alpha``: (n_rows,)
-    per-example weights with zeros on padding rows.  Returns
+    per-example weights with zeros on padding rows; ``prefetch``: block
+    read-ahead depth (None → :func:`_oc_prefetch` resolution).  Returns
     ``(weights (nb, bs, k), xm (nb*bs,), ym (k,))``.
 
     Multi-process (pod) runs: ``store`` holds only THIS process's row
@@ -526,18 +572,27 @@ def _oc_bcd_fit(
 
     nb, bs = store.num_blocks, store.block_size
     n_rows, k = y.shape
+    prefetch = _oc_prefetch(prefetch)
     wsum = jnp.sum(alpha)
     sa = jnp.sqrt(alpha)
     row_ok = (alpha > 0).astype(jnp.float32)
 
+    # Row-count validation, ONCE, against store metadata — every block
+    # stages to the same padded shape by construction, so re-checking
+    # inside the hot loop re-raised the identical comparison nb×num_iter
+    # times per fit.  A 1-column probe resolves the mesh/process padding
+    # without reading any feature block from disk.
+    probe = _mh.global_rows_from_local(np.zeros((store.n, 1), np.float32))
+    if probe.shape[0] != n_rows:
+        raise ValueError(
+            f"store rows pad to {probe.shape[0]} but labels have {n_rows}: "
+            "store.n must equal the label Dataset's n (per-process "
+            "row slice in multi-process runs)"
+        )
+    del probe
+
     def stage(blk):
         a = _mh.global_rows_from_local(blk)
-        if a.shape[0] != n_rows:
-            raise ValueError(
-                f"store rows pad to {a.shape[0]} but labels have {n_rows}: "
-                "store.n must equal the label Dataset's n (per-process "
-                "row slice in multi-process runs)"
-            )
         # bf16 stores cross the host→device wire at half width; solver
         # math stays f32 — cast on DEVICE, after the transfer
         if a.dtype != jnp.float32:

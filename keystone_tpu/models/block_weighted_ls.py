@@ -104,11 +104,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return self._fit(data.array, labels.array, data.n)
 
     def fit_stream_dataset(
-        self, data, labels, spill_dir=None, checkpoint_dir=None
+        self, data, labels, spill_dir=None, checkpoint_dir=None, prefetch=None
     ) -> BlockLinearMapper:
         """Out-of-core weighted fit: spill streamed features to a block
         store, then sweep blocks from disk (see block_ls._oc_bcd_fit).
-        The spill directory is deleted after a successful fit."""
+        ``prefetch`` — block read-ahead depth (None →
+        ``KEYSTONE_OC_PREFETCH``, else 2).  The spill directory is
+        deleted after a successful fit."""
         import shutil
 
         from keystone_tpu.models.block_ls import _spill_dir
@@ -121,11 +123,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             self.block_size,
             dtype=self.spill_dtype,
         )
-        fitted = self.fit_store(store, labels, checkpoint_dir=checkpoint_dir)
+        fitted = self.fit_store(
+            store, labels, checkpoint_dir=checkpoint_dir, prefetch=prefetch
+        )
         shutil.rmtree(store.directory, ignore_errors=True)
         return fitted
 
-    def fit_store(self, store, labels, checkpoint_dir=None) -> BlockLinearMapper:
+    def fit_store(
+        self, store, labels, checkpoint_dir=None, prefetch=None
+    ) -> BlockLinearMapper:
         from keystone_tpu.models.block_ls import (
             _check_store_rows,
             _oc_bcd_fit,
@@ -146,6 +152,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             self.num_iter,
             self.fit_intercept,
             checkpoint_dir=checkpoint_dir,
+            prefetch=prefetch,
         )
         return finish_block_model(
             weights, xm, ym, store.d, self.block_size, self.fit_intercept

@@ -32,13 +32,20 @@ from keystone_tpu.utils.precision import sdot
 _LOG2PI = 1.8378770664093453
 
 
-def _log_gaussians(x, means, variances, log_weights):
-    """(n, K) log w_k + log N(x; μ_k, diag σ²_k) via gemm expansion."""
+def _log_gaussians(x, means, variances, log_weights, dot=None):
+    """(n, K) log w_k + log N(x; μ_k, diag σ²_k) via gemm expansion.
+
+    ``dot`` overrides the two gemms — the Fisher-vector bf16 apply path
+    passes utils/precision.apply_dot so the posterior contractions ride
+    the policy; the default plain ``@`` keeps EM solver math (and every
+    other caller) bit-identical to before."""
+    if dot is None:
+        dot = lambda a, b: a @ b  # noqa: E731 - the inert gemm, verbatim
     inv = 1.0 / variances  # (K, d)
     # ‖(x−μ)/σ‖² = Σ x²/σ² − 2 Σ xμ/σ² + Σ μ²/σ²
     quad = (
-        (x * x) @ inv.T
-        - 2.0 * x @ (means * inv).T
+        dot(x * x, inv.T)
+        - 2.0 * dot(x, (means * inv).T)
         + jnp.sum(means * means * inv, axis=1)
     )
     log_norm = -0.5 * (jnp.sum(jnp.log(variances), axis=1) + x.shape[1] * _LOG2PI)

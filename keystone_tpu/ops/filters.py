@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from keystone_tpu.utils import precision
+
 
 def gaussian_kernel1d(sigma: float, truncate: float = 3.0) -> np.ndarray:
     """Normalized 1-D Gaussian, radius ⌈truncate·σ⌉ (≥1)."""
@@ -57,26 +59,35 @@ def _blur_matrix(extent: int, sigma: float, truncate: float = 3.0) -> np.ndarray
 _MATMUL_BLUR_MAX_EXTENT = 512
 
 
-def separable_gaussian_blur(x, sigma: float, strategy: str = "matmul"):
+def separable_apply(bh, bw, x, mxu: str = "f32"):
+    """Apply a separable (rows-operator, cols-operator) pair to
+    (n, h, w, c) maps as two MXU einsums: out = bh · x · bwᵀ per
+    channel.  The single physical form shared by the banded-matrix blur
+    below and the LCS box sums (ops/lcs.py); under the ``bf16_apply``
+    policy both einsums cast their inputs to bf16 with f32 accumulation
+    (utils/precision.apply_einsum), inert otherwise."""
+    out = precision.apply_einsum("ph,nhwc->npwc", bh, x, mode=mxu)
+    return precision.apply_einsum("qw,npwc->npqc", bw, out, mode=mxu)
+
+
+def separable_gaussian_blur(x, sigma: float, strategy: str = "matmul", mxu: str = "f32"):
     """Separable Gaussian blur of (n, h, w, c) maps.
 
     SAME zero padding (matches scipy ``mode="constant"``); accumulation
     in f32 regardless of input dtype.  ``strategy="matmul"`` (default)
     runs the two 1-D passes as banded-matrix einsums on the MXU, falling
     back to conv above ``_MATMUL_BLUR_MAX_EXTENT``; ``"conv"`` keeps the
-    depthwise-conv form (parity reference)."""
+    depthwise-conv form (parity reference).  ``mxu`` is the resolved
+    precision-policy mode: under ``bf16_apply`` the banded einsums cast
+    their inputs to bf16 (utils/precision.apply_einsum), accumulation
+    staying f32; the conv fallback stays true f32 in every mode."""
     if strategy == "matmul" and max(x.shape[1], x.shape[2]) > _MATMUL_BLUR_MAX_EXTENT:
         strategy = "conv"
     if strategy == "matmul":
         h, w = x.shape[1], x.shape[2]
         bh = jnp.asarray(_blur_matrix(h, float(sigma)))
         bw = jnp.asarray(_blur_matrix(w, float(sigma)))
-        out = jnp.einsum(
-            "ph,nhwc->npwc", bh, x, preferred_element_type=jnp.float32
-        )
-        return jnp.einsum(
-            "qw,npwc->npqc", bw, out, preferred_element_type=jnp.float32
-        )
+        return separable_apply(bh, bw, x, mxu=mxu)
     c = x.shape[-1]
     k1 = jnp.asarray(gaussian_kernel1d(sigma))
     eye = jnp.eye(c)[None, None]

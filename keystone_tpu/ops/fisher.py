@@ -19,6 +19,7 @@ descriptor sets with masks — MXU-shaped, replacing the per-image C++ loop.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -101,7 +102,12 @@ class FisherVector(Transformer):
             )
         else:
             out = _fisher_encode(
-                xs, mask, self.gmm.weights, self.gmm.means, self.gmm.variances
+                xs,
+                mask,
+                self.gmm.weights,
+                self.gmm.means,
+                self.gmm.variances,
+                mxu=precision.apply_mode(),
             )
         return out[0] if squeeze else out
 
@@ -134,16 +140,20 @@ class GMMFisherVectorEstimator(Estimator):
         return FisherVector(gmm)
 
 
-@jax.jit
-def _fisher_encode(xs, mask, w, mu, var):
+@partial(jax.jit, static_argnames=("mxu",))
+def _fisher_encode(xs, mask, w, mu, var, mxu: str = "f32"):
     """xs: (n, T, d); mask: (n, T); w: (K,); mu, var: (K, d).
 
-    Deliberately NOT under the bf16 matmul policy: the sufficient-statistic
-    einsums contract only over T and are OUTPUT-bound ((n, K, d) stays f32
-    either way), so bf16 input casts add materialization traffic without
-    shrinking the dominant stream — measured 0.64× at K=256, T=512 on
-    v5 lite.  The Pallas path gets its bf16 win at the HBM boundary
-    instead (ops/fisher_pallas.py).
+    NOT under the FEATURIZE bf16 policy: the sufficient-statistic einsums
+    contract only over T and are OUTPUT-bound ((n, K, d) stays f32 either
+    way), so bf16 input casts measured 0.64× in isolation at K=256,
+    T=512 on v5 lite; the Pallas path gets its bf16 win at the HBM
+    boundary instead (ops/fisher_pallas.py).  The opt-in APPLY policy
+    (``mxu='bf16_apply'``, utils/precision.py) converts the posterior
+    gemms and the s1/s2 einsums anyway — inside a fused forward program
+    the casts also halve the γ/descriptor streams between contractions,
+    and accumulation stays f32.  Inert modes trace the exact pre-policy
+    graph (CPU meshes bit-identical).
     """
     sigma = jnp.sqrt(var)  # (K, d)
     # responsibilities, batched over images
@@ -151,7 +161,16 @@ def _fisher_encode(xs, mask, w, mu, var):
 
     n, t, d = xs.shape
     flat = xs.reshape(n * t, d)
-    lg = _log_gaussians(flat, mu, var, jnp.log(w))  # (n*t, K)
+    if mxu == "bf16_apply":
+        # the two (n·t, d)×(d, K) posterior gemms under the apply
+        # policy; one copy of the math lives in gmm._log_gaussians, and
+        # EM fitting (solver math) keeps the inert default dot.
+        lg = _log_gaussians(
+            flat, mu, var, jnp.log(w),
+            dot=partial(precision.apply_dot, mode=mxu),
+        )  # (n*t, K)
+    else:
+        lg = _log_gaussians(flat, mu, var, jnp.log(w))  # (n*t, K)
     lr = lg - jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
     gamma = (jnp.exp(lr).reshape(n, t, -1)) * mask[..., None]  # (n, T, K)
 
@@ -160,10 +179,8 @@ def _fisher_encode(xs, mask, w, mu, var):
     # standardized descriptors per component: (x − μ_k)/σ_k
     # Σ_t γ_tk x_t  and  Σ_t γ_tk x_t²  via einsum (MXU), then recombine
     s0 = jnp.einsum("ntk->nk", gamma)  # (n, K)
-    s1 = jnp.einsum("ntk,ntd->nkd", gamma, xs, preferred_element_type=jnp.float32)
-    s2 = jnp.einsum(
-        "ntk,ntd->nkd", gamma, xs * xs, preferred_element_type=jnp.float32
-    )
+    s1 = precision.apply_einsum("ntk,ntd->nkd", gamma, xs, mode=mxu)
+    s2 = precision.apply_einsum("ntk,ntd->nkd", gamma, xs * xs, mode=mxu)
 
     # Φ¹ = (s1 − s0·μ)/σ;  Φ² = (s2 − 2μ·s1 + s0·μ²)/σ² − s0
     phi1 = (s1 - s0[..., None] * mu) / sigma

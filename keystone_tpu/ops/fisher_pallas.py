@@ -31,6 +31,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 _LOG2PI = 1.8378770664093453
 
+
+def _precision():
+    # deferred: keeps this kernel module importable without dragging the
+    # policy module into jax.experimental import time
+    from keystone_tpu.utils import precision
+
+    return precision
+
 # Max descriptors per VMEM tile when the GMM shape is unknown.  Measured
 # on v5 lite (T=784, K=256, d=64): one whole-image tile runs the kernel
 # at ~42 TF/s vs ~14 TF/s with 128-row tiles — per-program overhead
@@ -143,8 +151,10 @@ def fisher_encode_pallas(
     """xs: (n, T, d); mask: (n, T); GMM (w (K,), mu/var (K, d)) → (n, 2KD).
 
     Matches ops/fisher.py § _fisher_encode up to f32 rounding.  With
-    ``mxu='bf16'`` descriptors stream from HBM as bf16 (half the read
-    traffic of the bandwidth-bound kernel); all VMEM compute stays f32.
+    ``mxu='bf16'`` (the featurize policy) or ``mxu='bf16_apply'`` (the
+    apply policy — utils/precision.fdtype maps both to bf16) descriptors
+    stream from HBM as bf16 (half the read traffic of the
+    bandwidth-bound kernel); all VMEM compute stays f32.
     """
     n, t, d = xs.shape
     k = mu.shape[0]
@@ -183,7 +193,7 @@ def fisher_encode_pallas(
         ],
         interpret=interpret,
     )(
-        xs.astype(jnp.bfloat16 if mxu == "bf16" else jnp.float32),
+        xs.astype(_precision().fdtype(mxu)),
         mask.astype(jnp.float32)[:, None, :],
         logw.astype(jnp.float32),
         mu.astype(jnp.float32),

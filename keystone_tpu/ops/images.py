@@ -16,6 +16,7 @@ from jax import lax
 import numpy as np
 
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils import precision
 
 
 class Convolver(Transformer):
@@ -110,34 +111,51 @@ class Convolver(Transformer):
         )
 
     def apply_batch(self, xs, mask=None):
-        # Not under the bf16 matmul policy: XLA's default precision already
-        # runs f32 convs as bf16-grade MXU passes, so explicit bf16 casts
-        # only add materialization (measured 0.94× at CIFAR shapes on
-        # v5 lite) while costing input accuracy.  See utils/precision.py.
+        # The FEATURIZE bf16 policy skips the Convolver (XLA's default
+        # precision already runs f32 convs as bf16-grade MXU passes;
+        # explicit casts measured 0.94× at CIFAR shapes in isolation).
+        # The opt-in APPLY policy ('bf16_apply') converts it anyway: in a
+        # fused forward program the casts halve the inter-stage streams,
+        # and accumulation stays f32 (utils/precision.apply_dot/acast).
+        # apply_mode() is resolved at trace time; every jit wrapper that
+        # traces this (per-instance, class-shared, fused-chain) keys its
+        # cache on the resolved mode.
         if xs.ndim == 3:
             xs = xs[..., None]
         xs = xs.astype(jnp.float32)
+        mxu = precision.apply_mode()
         strategy = self.strategy
         if strategy == "auto":
             strategy = _pick_conv_strategy(
                 xs.shape[1], xs.shape[2], self.filters.shape, self.stride
             )
         if strategy == "im2col":
-            out = self._apply_im2col(xs)
+            out = self._apply_im2col(xs, mxu)
         else:
             rhs = jnp.transpose(self.filters, (1, 2, 3, 0))  # HWIO
-            out = lax.conv_general_dilated(
-                xs,
-                rhs,
-                window_strides=(self.stride, self.stride),
-                padding="VALID",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
+            if mxu == "bf16_apply":
+                xs_c, rhs_c = precision.acast(xs, rhs, mode=mxu)
+                out = lax.conv_general_dilated(
+                    xs_c,
+                    rhs_c,
+                    window_strides=(self.stride, self.stride),
+                    padding="VALID",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                out = lax.conv_general_dilated(
+                    xs,
+                    rhs,
+                    window_strides=(self.stride, self.stride),
+                    padding="VALID",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
         if self.offset is not None:
             out = out + self.offset
         return out
 
-    def _apply_im2col(self, xs):
+    def _apply_im2col(self, xs, mxu: str = "f32"):
         """Patch extraction + one gemm — the reference's own execution
         plan (Windower im2col → BLAS gemm, SURVEY.md §3.3), mapped to the
         MXU as a single (N·OH·OW, fh·fw·c) × (fh·fw·c, K) contraction."""
@@ -154,10 +172,8 @@ class Convolver(Transformer):
         # filters (k, fh, fw, c) -> (c, fh, fw, k) flattened to match the
         # patches' (c, fh, fw) minor order
         rhs = jnp.transpose(self.filters, (3, 1, 2, 0)).reshape(c * fh * fw, k)
-        out = jnp.dot(
-            patches.reshape(n * oh * ow, c * fh * fw),
-            rhs,
-            preferred_element_type=jnp.float32,
+        out = precision.apply_dot(
+            patches.reshape(n * oh * ow, c * fh * fw), rhs, mode=mxu
         )
         return out.reshape(n, oh, ow, k)
 

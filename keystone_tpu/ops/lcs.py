@@ -22,6 +22,7 @@ from jax import lax
 import numpy as np
 
 from keystone_tpu.workflow.transformer import Transformer
+from keystone_tpu.utils import precision
 
 _GRID = 4
 
@@ -42,7 +43,7 @@ class LCSExtractor(Transformer):
         xs = jnp.asarray(xs, jnp.float32)
         if xs.ndim == 3:
             xs = xs[..., None]
-        out = _lcs(xs, self.step, self.subpatch_size)
+        out = _lcs(xs, self.step, self.subpatch_size, mxu=precision.apply_mode())
         return out, jnp.ones(out.shape[:2], jnp.float32)
 
     def apply_one(self, x):
@@ -57,16 +58,40 @@ def _lcs_grid(extent: int, step: int, sub: int) -> np.ndarray:
     return np.arange(lo, hi, step, dtype=np.int32)
 
 
-@partial(jax.jit, static_argnames=("step", "sub"))
-def _lcs(xs, step, sub):
+def _box_matrix(extent: int, sub: int) -> np.ndarray:
+    """(extent−sub+1, extent) banded ones operator ≡ the VALID stride-1
+    1-D box sum along one axis: row y sums x[y : y+sub].  The matmul
+    twin of the reduce_window box filter, same trick as
+    ops/filters._blur_matrix."""
+    out = np.zeros((extent - sub + 1, extent), np.float32)
+    for y in range(out.shape[0]):
+        out[y, y : y + sub] = 1.0
+    return out
+
+
+@partial(jax.jit, static_argnames=("step", "sub", "mxu"))
+def _lcs(xs, step, sub, mxu: str = "f32"):
     n, h, w, c = xs.shape
     area = float(sub * sub)
     dims = (1, sub, sub, 1)
     ones = (1, 1, 1, 1)
     # box sums of x and x² with stride 1, VALID: index (y, x) = sum of
     # the sub×sub box whose top-left corner is (y, x)
-    s1 = lax.reduce_window(xs, 0.0, lax.add, dims, ones, "VALID")
-    s2 = lax.reduce_window(xs * xs, 0.0, lax.add, dims, ones, "VALID")
+    if mxu == "bf16_apply":
+        # apply policy (utils/precision.py): the separable box sums as
+        # banded-ones MXU einsums with bf16 inputs / f32 accumulation —
+        # the same linear-map-as-matmul rework (and the same physical
+        # form, filters.separable_apply) as the banded blur.  Inert
+        # modes keep the reduce_window form below bit-identical.
+        from keystone_tpu.ops.filters import separable_apply
+
+        bh = jnp.asarray(_box_matrix(h, sub))
+        bw = jnp.asarray(_box_matrix(w, sub))
+        s1 = separable_apply(bh, bw, xs, mxu=mxu)
+        s2 = separable_apply(bh, bw, xs * xs, mxu=mxu)
+    else:
+        s1 = lax.reduce_window(xs, 0.0, lax.add, dims, ones, "VALID")
+        s2 = lax.reduce_window(xs * xs, 0.0, lax.add, dims, ones, "VALID")
     mean = s1 / area
     var = jnp.maximum(s2 / area - mean * mean, 0.0)
     std = jnp.sqrt(var)

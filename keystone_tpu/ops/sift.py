@@ -225,9 +225,12 @@ def _dsift(
     # windowing choice: the matmul path runs it as banded-matrix MXU
     # einsums (r4 roofline: the depthwise convs ran at ~0.1× of their
     # byte bound); the conv path stays the bit-stable parity reference.
+    # The policy mode rides along so bf16_apply halves the blur's input
+    # stream too (the banded einsums are the first contraction the
+    # images hit).
     if sigma > 0.0:
         imgs = separable_gaussian_blur(
-            imgs[..., None], sigma, strategy=windowing
+            imgs[..., None], sigma, strategy=windowing, mxu=mxu
         )[..., 0]
 
     o = _NUM_ORIENTATIONS

@@ -113,9 +113,18 @@ class PaddedSparseRows:
             np.add.at(out[i], idx[i], val[i])
         return out
 
-    def matmul(self, w, intercept=None):
-        """Gather-based ``X @ w`` without densifying: (n_rows, k)."""
-        out = sparse_matmul(self.indices, self.values, jnp.asarray(w))
+    def matmul(self, w, intercept=None, mode: Optional[str] = None):
+        """Gather-based ``X @ w`` without densifying: (n_rows, k).
+
+        ``mode=None`` resolves the apply precision policy (this is the
+        SCORING path — LinearMapper / logistic inference); solver
+        callers contract through :func:`sparse_matmul` directly, whose
+        default stays inert f32."""
+        from keystone_tpu.utils import precision
+
+        if mode is None:
+            mode = precision.apply_mode()
+        out = sparse_matmul(self.indices, self.values, jnp.asarray(w), mode=mode)
         if intercept is not None:
             out = out + intercept
         return out
@@ -145,13 +154,21 @@ def _chunk_coo(indices, values, chunk: int):
     return idx, val
 
 
-def sparse_matmul(indices, values, w):
+def sparse_matmul(indices, values, w, mode: str = "f32"):
     """(rows, nnz) COO × (d, k) → (rows, k): gather rows of w, weight, sum.
 
     Padding entries (value 0) contribute nothing regardless of index.
     Large inputs are row-chunked so the (chunk, nnz, k) gather stays
-    within the working-set budget."""
+    within the working-set budget.
+
+    ``mode`` is the apply precision policy (utils/precision.py): the
+    default 'f32' is INERT — solver callers (logistic / L-BFGS
+    gradients) rely on that; scoring paths (PaddedSparseRows.matmul)
+    pass the resolved policy, under which the per-row contraction runs
+    with bf16 values/gathered weights and f32 accumulation."""
     from jax import lax
+
+    from keystone_tpu.utils import precision
 
     indices = jnp.asarray(indices)
     values = jnp.asarray(values)
@@ -161,16 +178,12 @@ def sparse_matmul(indices, values, w):
     chunk = _auto_chunk(rows, nnz, k)
     if rows <= chunk:
         wg = w[indices]  # (rows, nnz, k)
-        return jnp.einsum(
-            "rn,rnk->rk", values, wg, preferred_element_type=jnp.float32
-        )
+        return precision.apply_einsum("rn,rnk->rk", values, wg, mode=mode)
     idx, val = _chunk_coo(indices, values, chunk)
 
     def step(_, iv):
         i, v = iv
-        out = jnp.einsum(
-            "rn,rnk->rk", v, w[i], preferred_element_type=jnp.float32
-        )
+        out = precision.apply_einsum("rn,rnk->rk", v, w[i], mode=mode)
         return None, out
 
     _, out = lax.scan(step, None, (idx, val))

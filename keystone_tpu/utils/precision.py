@@ -30,6 +30,23 @@ Modes for the featurize policy:
   - ``auto`` (default): bf16 when the default backend is a TPU, f32
     otherwise (CPU test meshes keep full precision).
   - ``bf16`` / ``f32``: forced, e.g. for parity tests.
+  - ``bf16_apply``: everything ``auto``/``bf16`` does, PLUS the
+    opt-in APPLY policy — every hot forward contraction (FV
+    posterior/sufficient-statistic einsums, Convolver, blur einsums,
+    LCS box filters, block-linear scoring, sparse scoring) casts its
+    inputs to bf16 on device through :func:`apply_dot` /
+    :func:`apply_einsum`, always with f32 accumulation.  The measured
+    per-op story above (bf16 loses on output-bound contractions) is
+    about HBM traffic of the op in isolation; inside a fused forward
+    program the casts also halve every *inter*-contraction stream, so
+    the whole-pipeline win is a separate measurement — bench.py's
+    precision sweep is the arbiter.  ``bf16_apply`` resolves to the
+    INERT f32 policy off-TPU (CPU test meshes stay bit-identical; see
+    :func:`matmul_mode`) unless ``force_bf16_apply`` /
+    ``KEYSTONE_BF16_APPLY_FORCE=1`` overrides the gate for parity
+    testing.  Solver math (``sdot`` / ``solver_precision`` users:
+    Gramians, BCD epochs, L-BFGS, EM) is NOT under this policy in any
+    mode.
 
 Set via env ``KEYSTONE_MATMUL``, :func:`set_matmul`, or the
 :func:`matmul` context manager.  Compiled functions key their caches on
@@ -47,10 +64,15 @@ from contextlib import contextmanager
 import jax
 import jax.numpy as jnp
 
-_MODES = ("auto", "bf16", "f32")
+_MODES = ("auto", "bf16", "f32", "bf16_apply")
 _MODE = os.environ.get("KEYSTONE_MATMUL", "auto")
 if _MODE not in _MODES:
     raise ValueError(f"KEYSTONE_MATMUL must be one of {_MODES}, got {_MODE!r}")
+
+#: test/dev override: lets ``bf16_apply`` resolve ACTIVE on non-TPU
+#: backends so the bf16 numerics are exercisable on CPU meshes (the
+#: parity suite); never set in production.
+_APPLY_FORCE = os.environ.get("KEYSTONE_BF16_APPLY_FORCE", "0") == "1"
 
 _TPU_PLATFORMS = ("tpu", "axon")
 _DEFAULT_IS_TPU: bool | None = None
@@ -90,9 +112,17 @@ def set_matmul(mode: str) -> None:
 
 
 def matmul_mode() -> str:
-    """The resolved mode: 'bf16' or 'f32' (never 'auto')."""
+    """The resolved mode: 'bf16', 'f32', or 'bf16_apply' (never 'auto').
+
+    ``bf16_apply`` gates on REAL TPU hardware: off-chip it resolves to
+    'f32' — the inert policy — so CPU test meshes (and the multichip
+    dryrun's CPU mesh on a TPU host) produce bit-identical outputs with
+    the policy set or not.  ``force_bf16_apply`` /
+    ``KEYSTONE_BF16_APPLY_FORCE=1`` lifts the gate for parity testing."""
     if _MODE == "auto":
         return "bf16" if _on_tpu() else "f32"
+    if _MODE == "bf16_apply":
+        return "bf16_apply" if (_on_tpu() or _APPLY_FORCE) else "f32"
     return _MODE
 
 
@@ -104,6 +134,20 @@ def matmul(mode: str):
         yield
     finally:
         set_matmul(prev)
+
+
+@contextmanager
+def force_bf16_apply():
+    """Lift the on-TPU gate so ``bf16_apply`` resolves active on any
+    backend — the parity suite's way of exercising the bf16 numerics on
+    CPU meshes.  Production code never needs this."""
+    global _APPLY_FORCE
+    prev = _APPLY_FORCE
+    _APPLY_FORCE = True
+    try:
+        yield
+    finally:
+        _APPLY_FORCE = prev
 
 
 _SOLVER_PRECISIONS = ("default", "float32", "highest")
@@ -148,9 +192,11 @@ def sdot(a, b):
 
 
 def fdtype(mode: str | None = None):
-    """The featurize-matmul input dtype for ``mode`` (default: current)."""
+    """The featurize-matmul input dtype for ``mode`` (default: current).
+    ``bf16_apply`` is a superset of the featurize policy, so it maps to
+    bf16 here too."""
     m = matmul_mode() if mode is None else mode
-    return jnp.bfloat16 if m == "bf16" else jnp.float32
+    return jnp.bfloat16 if m in ("bf16", "bf16_apply") else jnp.float32
 
 
 def fcast(*xs, mode: str | None = None):
@@ -160,3 +206,54 @@ def fcast(*xs, mode: str | None = None):
     dt = fdtype(mode)
     out = tuple(jnp.asarray(x).astype(dt) for x in xs)
     return out if len(out) > 1 else out[0]
+
+
+# ------------------------------------------------------------------------
+# Apply-side policy: the opt-in bf16 fast path for the forward /
+# featurization contractions that the featurize policy deliberately
+# leaves alone.  Active ONLY when the resolved mode is "bf16_apply"
+# (on-TPU-gated above); in every other mode the helpers are identity
+# wrappers around jnp.dot / jnp.einsum with f32 accumulation, emitting
+# the exact graph the call sites emitted before the policy existed.
+
+
+def apply_mode(mode: str | None = None) -> str:
+    """Collapse the resolved policy to what the APPLY path cares about:
+    'bf16_apply' when the apply policy is active, else 'f32'.  Ops whose
+    only policy-sensitive contractions go through apply_dot/apply_einsum
+    use this as their static jit key so a featurize-only 'bf16' flip
+    does not force a pointless retrace of an identical program."""
+    m = matmul_mode() if mode is None else mode
+    return m if m == "bf16_apply" else "f32"
+
+
+def adtype(mode: str | None = None):
+    """Apply-policy contraction input dtype: bf16 iff active."""
+    m = matmul_mode() if mode is None else mode
+    return jnp.bfloat16 if m == "bf16_apply" else jnp.float32
+
+
+def acast(*xs, mode: str | None = None):
+    """Cast apply-policy contraction inputs (identity when inert).  Pair
+    with ``preferred_element_type=jnp.float32`` like :func:`fcast`."""
+    dt = adtype(mode)
+    out = tuple(jnp.asarray(x).astype(dt) for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def apply_dot(a, b, mode: str | None = None):
+    """Apply-policy matmul: bf16 inputs (when active) with f32
+    accumulation and f32 output.  Inert modes produce the exact
+    ``jnp.dot(a, b, preferred_element_type=f32)`` the converted call
+    sites used before — CPU meshes stay bit-identical by construction."""
+    a, b = acast(a, b, mode=mode)
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def apply_einsum(spec: str, *operands, mode: str | None = None):
+    """Apply-policy einsum: bf16 operands (when active), f32
+    accumulation/output.  See :func:`apply_dot`."""
+    ops = acast(*operands, mode=mode)
+    if len(operands) == 1:
+        ops = (ops,)
+    return jnp.einsum(spec, *ops, preferred_element_type=jnp.float32)

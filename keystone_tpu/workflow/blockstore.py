@@ -322,7 +322,9 @@ class FeatureBlockStore:
             finally:
                 put(sentinel)
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(
+            target=produce, daemon=True, name="blockstore-prefetch"
+        )
         t.start()
         try:
             while True:
@@ -334,6 +336,23 @@ class FeatureBlockStore:
                 yield item
         finally:
             stop.set()
+            # Join (bounded): when the consumer abandons the generator
+            # mid-sweep (early break, exception, GC close), the producer
+            # is parked on a full queue holding a GB-scale block; the
+            # stop flag makes its bounded put give up within ~0.1 s, and
+            # joining here makes the release PROMPT and deterministic
+            # instead of leaving a parked daemon thread (and its pinned
+            # block) to whenever the scheduler next runs it.  The
+            # timeout covers a producer mid-read on a slow disk — a
+            # leaked thread then still exits at the next put attempt.
+            t.join(timeout=10.0)
+            # drop any blocks still parked in the queue so their host
+            # buffers free with the generator, not with the GC
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
 
     def nbytes(self) -> int:
         itemsize = 2 if self.dtype == "bfloat16" else 4

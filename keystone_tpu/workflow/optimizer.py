@@ -349,8 +349,12 @@ class FusedTransformer(Transformer):
         return x
 
     def apply_batch(self, xs, mask=None):
-        # Keyed by the resolved matmul mode (utils/precision.py invariant):
-        # a policy flip must retrace, not reuse a stale-precision executable.
+        # Keyed by the resolved matmul mode (utils/precision.py
+        # invariant): a policy flip must retrace, not reuse a
+        # stale-precision executable.  'bf16_apply' is its own key — the
+        # fused chain is where the apply policy pays most (every stage's
+        # bf16 casts shrink the in-program streams XLA fuses across), so
+        # the whole chain recompiles under the new policy as one program.
         from keystone_tpu.utils import precision
 
         mode = precision.matmul_mode()

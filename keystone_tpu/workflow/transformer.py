@@ -335,9 +335,11 @@ class Transformer(Chainable):
         to the eager path.
 
         The per-instance cache is keyed by (matmul mode, traced signature):
-        the mode key makes precision-policy flips retrace instead of
-        reusing a stale executable, and the signature key confines a trace
-        failure to the one input signature that caused it — one odd
+        the mode key — the RESOLVED policy, one of f32/bf16/bf16_apply,
+        so e.g. enabling the bf16 apply path (utils/precision.py §
+        bf16_apply) retraces every chunked/whole-batch apply instead of
+        reusing a stale executable — and the signature key confines a
+        trace failure to the one input signature that caused it: one odd
         mask/dtype combination must not pin every later call of this
         instance to the eager path."""
         from keystone_tpu.utils import precision

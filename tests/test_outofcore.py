@@ -312,3 +312,107 @@ def test_oc_spill_dtype_plumbed_through_stream_fit(tmp_path, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-2
     )
+
+
+# ------------------------------------------------- prefetch + thread hygiene
+
+
+def _prefetch_spy(monkeypatch):
+    """Record the prefetch depth every iter_blocks call receives."""
+    from keystone_tpu.workflow import blockstore as bs_mod
+
+    seen = []
+    orig = bs_mod.FeatureBlockStore.iter_blocks
+
+    def spy(self, order, prefetch=2):
+        seen.append(prefetch)
+        return orig(self, order, prefetch=prefetch)
+
+    monkeypatch.setattr(bs_mod.FeatureBlockStore, "iter_blocks", spy)
+    return seen
+
+
+def test_oc_prefetch_plumbed_explicit(tmp_path, monkeypatch):
+    """fit_store(prefetch=) reaches every iter_blocks call of the sweep."""
+    seen = _prefetch_spy(monkeypatch)
+    x, y, _ = _problem(seed=11)
+    est = BlockLeastSquaresEstimator(block_size=16, num_iter=2, lam=1e-2)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    oc = est.fit_store(store, Dataset(y, n=y.shape[0]), prefetch=3)
+    assert seen and all(p == 3 for p in seen), seen
+    ref = est.fit_arrays(x, y)
+    np.testing.assert_allclose(
+        np.asarray(oc.flat_weights), np.asarray(ref.flat_weights), atol=2e-4
+    )
+
+
+def test_oc_prefetch_env_override(tmp_path, monkeypatch):
+    """KEYSTONE_OC_PREFETCH governs the depth when the caller passes
+    nothing; an explicit argument still wins over the env."""
+    from keystone_tpu.models.block_ls import _oc_prefetch
+
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "5")
+    assert _oc_prefetch() == 5
+    assert _oc_prefetch(3) == 3
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "junk")
+    assert _oc_prefetch() == 2  # malformed env falls back, with a warning
+
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "4")
+    seen = _prefetch_spy(monkeypatch)
+    x, y, _ = _problem(seed=12)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=16, num_iter=1, lam=1e-2, mixture_weight=0.25
+    )
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    est.fit_store(store, Dataset(y, n=y.shape[0]))
+    assert seen and all(p == 4 for p in seen), seen
+
+
+def test_oc_row_mismatch_raises_before_sweep(tmp_path):
+    """The hoisted row-count validation: a label array whose padded rows
+    cannot match the staged store blocks fails up front (once), not from
+    inside the per-(epoch, block) hot loop."""
+    from keystone_tpu.models.block_ls import _oc_bcd_fit
+
+    x, y, _ = _problem(seed=13)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    y_padded = np.pad(y, ((0, 4), (0, 0)))  # 4 extra pad rows vs the store
+    alpha = (np.arange(y_padded.shape[0]) < y.shape[0]).astype(np.float32)
+    with pytest.raises(ValueError, match="store rows pad to"):
+        _oc_bcd_fit(
+            store,
+            jnp.asarray(y_padded),
+            jnp.asarray(alpha),
+            float(y.shape[0]),
+            1e-2,
+            1,
+            False,
+        )
+
+
+def test_iter_blocks_close_joins_producer(tmp_path):
+    """Abandoning the generator mid-sweep must terminate the prefetch
+    thread promptly (releasing its parked in-flight block), not leave a
+    parked daemon thread behind."""
+    import threading
+    import time
+
+    def prefetch_threads():
+        return [
+            t
+            for t in threading.enumerate()
+            if t.name == "blockstore-prefetch" and t.is_alive()
+        ]
+
+    x = np.random.default_rng(3).normal(size=(16, 24)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=4)
+    assert not prefetch_threads()
+    order = list(range(store.num_blocks)) * 50  # long sweep, tiny consumer
+    gen = store.iter_blocks(order, prefetch=2)
+    b, blk = next(gen)
+    assert b == order[0]
+    gen.close()  # consumer abandons the sweep
+    deadline = time.monotonic() + 15.0
+    while prefetch_threads() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not prefetch_threads(), "prefetch thread leaked after close()"

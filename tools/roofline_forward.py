@@ -18,10 +18,20 @@ Also prints total device-busy time per iteration vs the program's wall
 marginal time (the overlap/dispatch picture).
 
 Run on the chip:  python tools/roofline_forward.py [--json] [--ms]
+                  [--precision {f32,default,bf16_apply,sweep}]
 
 ``--ms`` profiles the multi-scale vl_phow config instead (bins
 (4,6,8,10) + per-scale smoothing, batch 64 — the densest config the
 reference ran, and bench.py's second first-class forward metric).
+
+``--precision`` pins the matmul policy for the profiled program:
+``f32`` forces full-precision featurize matmuls, ``default`` is the
+shipped policy (auto: bf16 featurize on TPU), ``bf16_apply`` activates
+the apply-side bf16 path (utils/precision.py).  ``sweep`` runs all
+three back-to-back and prints one summary JSON line per mode with the
+achieved TF/s and mfu_bf16_eff — the per-mode numbers the r6 tentpole
+is judged on.  Every output carries ``precision``/``achieved_tflops``/
+``mfu_bf16_eff`` fields either way.
 """
 from __future__ import annotations
 
@@ -61,6 +71,22 @@ if "--batch" in sys.argv:  # e.g. --batch 256: probe the large-batch decay
         sys.exit("usage: roofline_forward.py [--json] [--ms] [--batch N]")
     _RUN_BATCH = int(sys.argv[_idx])
 
+#: --precision: the matmul policy the profiled program compiles under.
+#: CLI names map onto utils/precision modes ("default" == the shipped
+#: auto policy); "sweep" loops all three.
+_PRECISION_CLI_TO_MODE = {"f32": "f32", "default": "auto", "bf16_apply": "bf16_apply"}
+_PRECISION = "default"
+if "--precision" in sys.argv:
+    _idx = sys.argv.index("--precision") + 1
+    if _idx >= len(sys.argv) or sys.argv[_idx] not in (
+        *_PRECISION_CLI_TO_MODE,
+        "sweep",
+    ):
+        sys.exit(
+            "usage: roofline_forward.py [--precision {f32,default,bf16_apply,sweep}]"
+        )
+    _PRECISION = sys.argv[_idx]
+
 TRACE_ITERS = 8
 #: v5e bf16-grade MXU peak and HBM stream peak — per-op bounds use the
 #: bf16 rate for matmul/conv ops (XLA runs default-precision f32 matmuls
@@ -70,13 +96,16 @@ _PEAK_MXU = 1.97e14
 _PEAK_HBM = 8.1e11
 
 
-def run_and_trace(logdir: str):
+def _build_and_warm(precision_cli: str):
+    """Set the matmul policy, build + warm the jitted forward."""
     import jax
     import jax.numpy as jnp
 
+    from keystone_tpu.utils import precision as _prec
     from keystone_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    _prec.set_matmul(_PRECISION_CLI_TO_MODE[precision_cli])
     kw = {"bin_sizes": _BIN_SIZES}
     if _SMOOTHING is not None:
         kw["smoothing_magnif"] = _SMOOTHING
@@ -88,7 +117,13 @@ def run_and_trace(logdir: str):
     )
     for _ in range(3):
         np.asarray(fwd(x)[:1, :8])  # compile + warm
-    # wall marginal (one long run, marginal slope over two lengths)
+    return fwd, x
+
+
+def _wall_marginal(fwd, x) -> float:
+    """Marginal seconds/batch: slope between a 20- and a 60-iteration
+    pipelined run (real device→host read as the sync)."""
+
     def run(k):
         t0 = time.perf_counter()
         out = None
@@ -98,7 +133,21 @@ def run_and_trace(logdir: str):
         return time.perf_counter() - t0
 
     t20, t60 = run(20), run(60)
-    wall_marginal = (t60 - t20) / 40.0
+    return (t60 - t20) / 40.0
+
+
+def measure_wall(precision_cli: str) -> float:
+    """Wall-marginal seconds/batch under one policy (no profiler trace —
+    the --precision sweep wants the per-mode TF/s, not per-op tables)."""
+    fwd, x = _build_and_warm(precision_cli)
+    return _wall_marginal(fwd, x)
+
+
+def run_and_trace(logdir: str, precision_cli: str = "default"):
+    import jax
+
+    fwd, x = _build_and_warm(precision_cli)
+    wall_marginal = _wall_marginal(fwd, x)
     with jax.profiler.trace(logdir):
         out = None
         for _ in range(TRACE_ITERS):
@@ -159,8 +208,29 @@ def aggregate(ops):
 
 
 def main():
+    if _PRECISION == "sweep":
+        # per-mode TF/s + mfu_bf16_eff: one JSON line per policy, same
+        # program, traced-free wall marginal (bench.py's slope idea)
+        for cli in ("f32", "default", "bf16_apply"):
+            wall = measure_wall(cli)
+            ips = _RUN_BATCH / wall
+            tf = ips * flops_per_image(_BIN_SIZES) / 1e12
+            print(
+                json.dumps(
+                    {
+                        "precision": cli,
+                        "batch": _RUN_BATCH,
+                        "wall_marginal_us": round(wall * 1e6, 1),
+                        "images_per_sec": round(ips, 1),
+                        "achieved_tflops": round(tf, 2),
+                        "mfu_bf16_eff": round(tf * 1e12 / _PEAK_MXU, 3),
+                    }
+                )
+            )
+        return
+
     logdir = tempfile.mkdtemp(prefix="ks-roofline-")
-    wall = run_and_trace(logdir)
+    wall = run_and_trace(logdir, _PRECISION)
     rows = aggregate(parse_trace(logdir))
 
     # price the Pallas FV custom call analytically (model_flops = 0 for
@@ -199,12 +269,16 @@ def main():
                 "attr": r["attr"],
             }
         )
+    achieved_tf = _RUN_BATCH / wall * flops_per_image(_BIN_SIZES) / 1e12
     result = {
         "batch": _RUN_BATCH,
+        "precision": _PRECISION,
         "wall_marginal_us": round(wall * 1e6, 1),
         "device_busy_us": round(total_dev, 1),
         "overlap_or_gap_us": round(wall * 1e6 - total_dev, 1),
         "images_per_sec": round(_RUN_BATCH / wall, 1),
+        "achieved_tflops": round(achieved_tf, 2),
+        "mfu_bf16_eff": round(achieved_tf * 1e12 / _PEAK_MXU, 3),
         "analytic_flops_per_image": flops_per_image(_BIN_SIZES),
         "ops": out_rows,
     }
