@@ -309,10 +309,19 @@ def measure_fit(n: int = FIT_N) -> dict:
     through the REAL app build (two FV branches with in-graph
     PCA/GMM vocabulary fits, CSE-merged featurize, weighted BCD solve),
     honestly blocked at the end.  Data generation happens OUTSIDE the
-    timer — it is loader cost, not fit cost."""
+    timer — it is loader cost, not fit cost.
+
+    The leg runs under a run ledger (keystone_tpu.obs) and returns its
+    obs summary (stage top-k, retry totals, solver convergence points,
+    memory watermarks) under ``"obs"`` so every BENCH_rNN.json carries
+    the operational context of its own fit.  Ledger overhead is a
+    handful of JSONL writes per stage plus one tiny host callback per
+    solver epoch — noise against a minutes-scale fit."""
+    import tempfile
     import time as _time
 
     from keystone_tpu.loaders.imagenet import ImageNetLoader
+    from keystone_tpu.obs import ledger as obs_ledger
     from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
         Config,
         ImageNetSiftLcsFV,
@@ -330,27 +339,61 @@ def measure_fit(n: int = FIT_N) -> dict:
     train = ImageNetLoader.synthetic(
         n, FIT_CLASSES, size=(IMAGE_HW, IMAGE_HW), seed=1
     )
-    t0 = _time.perf_counter()
-    fitted = (
-        ImageNetSiftLcsFV.build(cfg, train.data, train.labels)
-        .fit()
-        .block_until_ready()
-    )
-    # REAL device→host read as the run-end sync: block_until_ready does
-    # not drain the execution stream on the axon backend.  read_back()
-    # transfers one element of EVERY fitted array (forcing each array's
-    # computation and its transitive dependencies), without the 1-image
-    # probe score the first r4 cut used — scoring traces ~5 one-row
-    # programs per fresh process, a measured 6–7 s of NON-fit work that
-    # was being charged to fit_seconds (interleaved A/B, BASELINE.md).
-    # The read is UNCONDITIONAL (python -O strips asserts; only the
-    # validity checks live in them).
-    scalars = fitted.read_back()
-    dt = _time.perf_counter() - t0
-    assert scalars.size >= 1
-    assert np.all(np.isfinite(scalars))
-    del fitted
-    return {"fit_seconds": dt, "fit_images_per_sec": n / dt}
+    obs_dir = tempfile.mkdtemp(prefix="kst_bench_obs_")
+    obs_ledger.start_run(obs_dir)
+    try:
+        t0 = _time.perf_counter()
+        fitted = (
+            ImageNetSiftLcsFV.build(cfg, train.data, train.labels)
+            .fit()
+            .block_until_ready()
+        )
+        # REAL device→host read as the run-end sync: block_until_ready
+        # does not drain the execution stream on the axon backend.
+        # read_back() transfers one element of EVERY fitted array
+        # (forcing each array's computation and its transitive
+        # dependencies), without the 1-image probe score the first r4
+        # cut used — scoring traces ~5 one-row programs per fresh
+        # process, a measured 6–7 s of NON-fit work that was being
+        # charged to fit_seconds (interleaved A/B, BASELINE.md).  The
+        # read is UNCONDITIONAL (python -O strips asserts; only the
+        # validity checks live in them).
+        scalars = fitted.read_back()
+        dt = _time.perf_counter() - t0
+        assert scalars.size >= 1
+        assert np.all(np.isfinite(scalars))
+        del fitted
+    finally:
+        # a failed leg must not leave its ledger attached to the process
+        # (the solver legs that follow would trace with obs on)
+        led = obs_ledger.active()
+        ledger_path = led.path if led is not None else None
+        obs_ledger.stop_run()
+    obs_summary = None
+    if ledger_path is not None:
+        try:
+            from tools.obs_report import summarize
+
+            s = summarize(ledger_path, top_k=5)
+            conv = s.get("convergence") or {}
+            obs_summary = {
+                "stage_top": s.get("stage_top"),
+                "retries": s.get("retries"),
+                "memory": s.get("memory"),
+                "solver_epochs": {k: len(v) for k, v in conv.items()},
+                "io": {
+                    k: v
+                    for k, v in (s.get("io") or {}).items()
+                    if isinstance(v, (int, float)) and v
+                },
+            }
+        except Exception as e:  # the summary must never fail the leg
+            obs_summary = {"error": repr(e)[:200]}
+    return {
+        "fit_seconds": dt,
+        "fit_images_per_sec": n / dt,
+        "obs": obs_summary,
+    }
 
 
 def solver_flops(n: int, d: int, k: int, bs: int, epochs: int) -> float:
@@ -697,6 +740,12 @@ def main():
                 "solver_block": FIT_SOLVER_BLOCK,
             },
         }
+        # operational context of the fit (stage top-k, retry totals,
+        # memory watermarks) from the first leg's run ledger, so the
+        # perf trajectory in BENCH_rNN.json explains itself
+        obs_leg = next((lg.get("obs") for lg in fit_legs if lg.get("obs")), None)
+        if obs_leg:
+            out["fit"]["obs"] = obs_leg
     if ms_legs:
         ms = [float(lg["leg_ips"]) for lg in ms_legs]
         out["multiscale"] = {

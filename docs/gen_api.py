@@ -24,6 +24,7 @@ MODULES = [
     ("keystone_tpu.loaders", "Loaders"),
     ("keystone_tpu.evaluation", "Evaluation"),
     ("keystone_tpu.utils", "Utils"),
+    ("keystone_tpu.obs", "Observability"),
 ]
 
 

@@ -28,6 +28,7 @@ Architecture (see SURVEY.md for the reference layer map):
 __version__ = "0.1.0"
 
 from keystone_tpu import faults  # noqa: F401
+from keystone_tpu import obs  # noqa: F401
 from keystone_tpu.workflow import (  # noqa: F401
     Transformer,
     Estimator,

@@ -303,10 +303,16 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
     process, per the spec's action.  File actions with no ``path`` fall
     back to raising, so a plan never silently does nothing.
     """
+    from keystone_tpu.obs import metrics
+
     with _LOCK:
         if phase != "publish":  # two-phase sites count once per operation
             CALLS[site] += 1
         plans = list(_STACK)
+    if phase != "publish":
+        # outside _LOCK: the registry has its own lock, and the mirror
+        # needs nothing from this module's critical section
+        metrics.inc("faults.calls", site=site)
     env = _env_plan()
     if env is not None:
         plans.append(env)
@@ -320,6 +326,10 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
                     INJECTED[site] += 1
             if not fire:
                 continue
+            # mirrored into the unified metrics registry so chaos
+            # reports and run ledgers read fault outcomes from the same
+            # place as every other subsystem (and survive reset_stats)
+            metrics.inc("faults.injected", site=site)
             logger.warning(
                 "fault injected at %s (action=%s%s)",
                 site,

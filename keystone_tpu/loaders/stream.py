@@ -30,6 +30,7 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from keystone_tpu.faults import fault_point
+from keystone_tpu.obs import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -42,8 +43,14 @@ def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndar
 
     def gen():
         for i in range(0, len(array), batch_size):
+            t0 = time.perf_counter()
             fault_point("stream.batch", index=i // batch_size)
-            yield array[i : i + batch_size]
+            batch = array[i : i + batch_size]
+            metrics.observe(
+                "stream.batch_seconds", time.perf_counter() - t0,
+                source="batched",
+            )
+            yield batch
 
     return gen
 
@@ -109,8 +116,14 @@ def resilient(
                 # dropped (its failure swallowed)
                 target = delivered + len(dropped)
                 idx = pos
+                t_fetch = time.perf_counter()
                 try:
                     batch = next(src)
+                    metrics.observe(
+                        "stream.batch_seconds",
+                        time.perf_counter() - t_fetch,
+                        source="resilient",
+                    )
                     pos += 1
                     swallowed_last = False
                 except StopIteration:
@@ -144,6 +157,7 @@ def resilient(
                         attempt_idx, attempt = idx, 0
                     attempt += 1
                     if attempt <= retries:
+                        metrics.inc("stream.retries")
                         delay = min(
                             max_delay, base_delay * (2.0 ** (attempt - 1))
                         )
@@ -163,6 +177,7 @@ def resilient(
                         continue
                     if idx >= target and len(dropped) < max_bad_batches:
                         dropped.add(idx)
+                        metrics.inc("stream.bad_batches")
                         attempt_idx, attempt = -1, 0
                         # if the source is a dead generator, the next
                         # fetch is StopIteration — flag it so the

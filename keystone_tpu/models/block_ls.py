@@ -209,15 +209,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         checkpoint fingerprints are content-based so resume still works)."""
         import shutil
 
+        from keystone_tpu.obs import ledger
         from keystone_tpu.workflow.blockstore import FeatureBlockStore
 
-        store = FeatureBlockStore.from_batches(
-            _spill_dir(spill_dir),
-            data.batches(),
-            data.n,
-            self.block_size,
-            dtype=self.spill_dtype,
-        )
+        with ledger.span("solver.spill", solver="bcd", n=data.n):
+            store = FeatureBlockStore.from_batches(
+                _spill_dir(spill_dir),
+                data.batches(),
+                data.n,
+                self.block_size,
+                dtype=self.spill_dtype,
+            )
         fitted = self.fit_store(
             store, labels, checkpoint_dir=checkpoint_dir, prefetch=prefetch
         )
@@ -274,8 +276,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             yc = (y - ym) * row_ok
         else:
             xc, yc = x, y
+        from keystone_tpu.obs import ledger
+
         weights = _bcd_fit(
-            blockify(xc, self.block_size), yc, nf, self.lam, self.num_iter
+            blockify(xc, self.block_size),
+            yc,
+            nf,
+            self.lam,
+            self.num_iter,
+            obs=ledger.solver_obs(),
         )
         return finish_block_model(
             weights, xm, ym, x.shape[1], self.block_size, self.fit_intercept
@@ -420,7 +429,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 w_sharding = w.sharding
             w = global_from_host(w_h, w_sharding)
             p = global_from_host(p_h, yc.sharding)
+        from keystone_tpu.obs import ledger, metrics
+
+        observe = ledger.solver_obs()
         for e in range(start, self.num_iter):
+            import time as _time
+
+            t_epoch = _time.perf_counter()
             w, p = _bcd_epoch(xb, yc, nf, self.lam, w, p)
             jax.block_until_ready(w)
             # the gathers are COLLECTIVES: every process must run them
@@ -433,6 +448,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             # sidecar, previous epoch rotated to <path>.1 — the
             # last-good fallback _read_checkpoint resumes from when the
             # newest save is later found corrupt
+            t_save = _time.perf_counter()
             if jax.process_index() == 0:
                 durable.save_npz(
                     path,
@@ -443,6 +459,16 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                         "problem": np.asarray(problem),
                     },
                     keep=2,
+                )
+            save_seconds = _time.perf_counter() - t_save
+            metrics.observe("solver.checkpoint_save_seconds", save_seconds)
+            if observe:
+                ledger.solver_epoch(
+                    "bcd.checkpointed",
+                    epoch=e,
+                    objective=float(np.asarray(_bcd_objective(yc, p, nf))),
+                    epoch_seconds=_time.perf_counter() - t_epoch,
+                    checkpoint_save_seconds=save_seconds,
                 )
         return finish_block_model(
             w, xm, ym, x.shape[1], self.block_size, self.fit_intercept
@@ -486,6 +512,15 @@ def finish_block_model(weights, xm, ym, d, block_size, fit_intercept):
 @jax.jit
 def _oc_wmean(alpha, a, wsum):
     return (alpha @ a) / wsum
+
+
+@jax.jit
+def _bcd_objective(yc, p, n):
+    """Residual objective 0.5·‖Y−P‖²/n of a BCD carry — one tiny jitted
+    reduction so obs-enabled host loops never pull the (n × k) residual
+    to host just to norm it (sharded inputs reduce via collectives)."""
+    r = yc - p
+    return 0.5 * jnp.vdot(r, r) / n
 
 
 @jax.jit
@@ -715,6 +750,12 @@ def _oc_bcd_fit(
     # overlapping block b's compute while bounding in-flight staging.
     from collections import deque
 
+    import time as _time
+
+    from keystone_tpu.obs import ledger, metrics
+
+    observe = ledger.solver_obs()
+    t_epoch = _time.perf_counter()
     pending: deque = deque()
     for i, (b, blk) in enumerate(store.iter_blocks(order, prefetch=prefetch)):
         if len(pending) >= 2:
@@ -722,6 +763,7 @@ def _oc_bcd_fit(
         w[b], p = _oc_block_step(stage(blk), xm[b], yc, sa, row_ok, p, w[b], lam_n)
         pending.append(w[b])
         if (i + 1) % nb == 0:
+            save_seconds = None
             if ckpt_path is not None:
                 jax.block_until_ready(p)
                 # collectives first (every process participates) …
@@ -733,6 +775,7 @@ def _oc_bcd_fit(
                 # tmp+fsync+rename + checksum sidecar + previous epoch
                 # rotated to <path>.1 (the resume scan's last-good
                 # fallback)
+                t_save = _time.perf_counter()
                 if jax.process_index() == 0:
                     durable.save_npz(
                         ckpt_path,
@@ -744,6 +787,17 @@ def _oc_bcd_fit(
                         },
                         keep=2,
                     )
+                save_seconds = _time.perf_counter() - t_save
+                metrics.observe("solver.checkpoint_save_seconds", save_seconds)
+            if observe:
+                ledger.solver_epoch(
+                    "bcd.out_of_core",
+                    epoch=epoch,
+                    objective=float(np.asarray(_bcd_objective(yc, p, n))),
+                    epoch_seconds=_time.perf_counter() - t_epoch,
+                    checkpoint_save_seconds=save_seconds,
+                )
+            t_epoch = _time.perf_counter()
             epoch += 1
     weights = jnp.stack(w)
     return weights, xm.reshape(-1), ym
@@ -791,11 +845,17 @@ def _bcd_epoch(xb, y, n, lam, w, p):
     return _bcd_epoch_body(xb, y, n, lam, (w, p))
 
 
-@partial(jax.jit, static_argnames=("num_iter",))
-def _bcd_fit(xb, y, n, lam, num_iter):
+@partial(jax.jit, static_argnames=("num_iter", "obs"))
+def _bcd_fit(xb, y, n, lam, num_iter, obs=False):
     """The hot loop (SURVEY.md §3.2) as one XLA program.
 
     xb: (nb, n_rows, bs) row-sharded; y: (n_rows, k).
+
+    ``obs`` (static): emit a per-epoch ``solver.epoch`` convergence
+    point (residual objective) to the active run ledger via
+    ``jax.debug.callback``.  Same math either way — the flag only adds
+    the host callback, and is resolved at trace time so the inert
+    program carries no callbacks at all.
     """
     nb, n_rows, bs = xb.shape
     k = y.shape[1]
@@ -804,8 +864,24 @@ def _bcd_fit(xb, y, n, lam, num_iter):
     w0 = jnp.zeros((nb, bs, k), jnp.float32)
     p0 = jnp.zeros_like(y)
 
-    def epoch(carry, _):
-        return _bcd_epoch_body(xb, y, n, lam, carry), None
+    def epoch(carry, e):
+        carry = _bcd_epoch_body(xb, y, n, lam, carry)
+        if obs:
+            from keystone_tpu.obs import ledger
 
-    (w, _), _ = lax.scan(epoch, (w0, p0), None, length=num_iter)
+            _, p = carry
+            r = y - p
+            jax.debug.callback(
+                ledger.solver_callback("bcd", "epoch", "objective"),
+                e,
+                0.5 * jnp.vdot(r, r) / n,
+            )
+        return carry, None
+
+    # xs only when observing — the inert program stays byte-identical
+    # to the pre-obs one (see models/kmeans.py)
+    if obs:
+        (w, _), _ = lax.scan(epoch, (w0, p0), jnp.arange(num_iter))
+    else:
+        (w, _), _ = lax.scan(epoch, (w0, p0), None, length=num_iter)
     return w

@@ -163,13 +163,15 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return self._fit(x, jnp.asarray(y), x.shape[0])
 
     def _fit(self, x, y, n) -> BlockLinearMapper:
+        from keystone_tpu.obs import ledger
+
         x = jnp.asarray(x, jnp.float32)
         y = jnp.asarray(y, jnp.float32)
         nf = jnp.float32(n)
         alpha = class_weights(y, nf, self.mixture_weight)
         weights, xm, ym = _weighted_bcd_fit(
             x, y, alpha, nf, self.lam, self.num_iter, self.block_size,
-            self.fit_intercept,
+            self.fit_intercept, obs=ledger.solver_obs(),
         )
         from keystone_tpu.models.block_ls import finish_block_model
 
@@ -178,8 +180,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
 
-@partial(jax.jit, static_argnames=("num_iter", "block_size", "fit_intercept"))
-def _weighted_bcd_fit(x, y, alpha, n, lam, num_iter, block_size, fit_intercept):
+@partial(
+    jax.jit,
+    static_argnames=("num_iter", "block_size", "fit_intercept", "obs"),
+)
+def _weighted_bcd_fit(
+    x, y, alpha, n, lam, num_iter, block_size, fit_intercept, obs=False
+):
     wsum = jnp.sum(alpha)
     if fit_intercept:
         xm = (alpha @ x) / wsum
@@ -213,8 +220,29 @@ def _weighted_bcd_fit(x, y, alpha, n, lam, num_iter, block_size, fit_intercept):
         p_new = constrain(p + xb[b] @ (wb_new - wb), DATA_AXIS, MODEL_AXIS)
         return w.at[b].set(wb_new), p_new
 
-    def epoch(carry, _):
-        return lax.fori_loop(0, nb, block_step, carry), None
+    def epoch(carry, e):
+        carry = lax.fori_loop(0, nb, block_step, carry)
+        if obs:
+            # per-epoch convergence point for the run ledger (static
+            # flag: the inert program carries no callback — see
+            # block_ls._bcd_fit)
+            from keystone_tpu.obs import ledger
 
-    (w, _), _ = lax.scan(epoch, (w0, p0), None, length=num_iter)
+            _, p = carry
+            r = yc - p
+            jax.debug.callback(
+                ledger.solver_callback(
+                    "bcd.weighted", "epoch", "objective"
+                ),
+                e,
+                0.5 * jnp.vdot(r, r) / n,
+            )
+        return carry, None
+
+    # xs only when observing — the inert program stays byte-identical
+    # to the pre-obs one (see models/kmeans.py)
+    if obs:
+        (w, _), _ = lax.scan(epoch, (w0, p0), jnp.arange(num_iter))
+    else:
+        (w, _), _ = lax.scan(epoch, (w0, p0), None, length=num_iter)
     return w, xm, ym

@@ -116,13 +116,16 @@ class GaussianMixtureModelEstimator(Estimator):
         )
 
     def fit_dataset(self, data: Dataset) -> GaussianMixtureModel:
+        from keystone_tpu.obs import ledger
+
+        obs = ledger.solver_obs()
         x = data.array
         if data.mask is not None:
             # ragged prep (flatten, mask, true count) lives INSIDE
             # _gmm_fit's jit — one program, not two
             w, m, v = _gmm_fit(
                 x, None, data.mask, self.k, self.max_iterations,
-                self.min_variance, self.seed, self.kmeans_iters,
+                self.min_variance, self.seed, self.kmeans_iters, obs=obs,
             )
         else:
             # row mask + PRNG key are built INSIDE _gmm_fit (row_ok=None)
@@ -130,29 +133,38 @@ class GaussianMixtureModelEstimator(Estimator):
             # compiled programs per fit (r5 call-site attribution)
             w, m, v = _gmm_fit(
                 x, float(data.n), None, self.k, self.max_iterations,
-                self.min_variance, self.seed, self.kmeans_iters,
+                self.min_variance, self.seed, self.kmeans_iters, obs=obs,
             )
         return GaussianMixtureModel(w, m, v)
 
     def fit_arrays(self, x) -> GaussianMixtureModel:
+        from keystone_tpu.obs import ledger
+
         x = jnp.asarray(x, jnp.float32)
         w, m, v = _gmm_fit(
             x, float(x.shape[0]), None, self.k, self.max_iterations,
             self.min_variance, self.seed, self.kmeans_iters,
+            obs=ledger.solver_obs(),
         )
         return GaussianMixtureModel(w, m, v)
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var):
+@partial(jax.jit, static_argnames=("iters", "obs"))
+def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var, obs=False):
     """``iters`` EM steps from a given initial GMM (the deterministic part
     of the fit; also the contract of the native C++ EM in
-    ops/fisher_ffi.py § gmm_em_ffi, which parity-tests against this)."""
+    ops/fisher_ffi.py § gmm_em_ffi, which parity-tests against this).
 
-    def em(carry, _):
+    ``obs`` (static): per-EM-iteration ``solver.epoch`` telemetry (mean
+    log-likelihood — the logsumexp is already computed for the E-step,
+    so the extra cost is one masked reduction) via
+    ``jax.debug.callback``; the inert program carries no callbacks."""
+
+    def em(carry, it):
         w, mu, var = carry
         lg = _log_gaussians(x, mu, var, jnp.log(w))
-        lr = lg - jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
+        lse = jax.scipy.special.logsumexp(lg, axis=1, keepdims=True)
+        lr = lg - lse
         r = jnp.exp(lr) * row_ok[:, None]  # (n, K)
         nk = constrain(jnp.sum(r, axis=0))  # psum over 'data'
         nk = jnp.maximum(nk, 1e-10)
@@ -160,14 +172,28 @@ def _em_steps(x, n, row_ok, w0, mu0, var0, iters, min_var):
         ex2 = constrain(sdot(r.T, x * x)) / nk[:, None]
         var_new = jnp.maximum(ex2 - mu_new * mu_new, min_var)
         w_new = nk / n
+        if obs:
+            from keystone_tpu.obs import ledger
+
+            loglik = constrain(jnp.sum(lse[:, 0] * row_ok)) / n
+            jax.debug.callback(
+                ledger.solver_callback("gmm", "epoch", "mean_log_likelihood"),
+                it,
+                loglik,
+            )
         return (w_new, mu_new, var_new), None
 
-    (w, mu, var), _ = lax.scan(em, (w0, mu0, var0), None, length=iters)
+    # xs only when observing — the inert program stays byte-identical
+    # to the pre-obs one (see models/kmeans.py)
+    if obs:
+        (w, mu, var), _ = lax.scan(em, (w0, mu0, var0), jnp.arange(iters))
+    else:
+        (w, mu, var), _ = lax.scan(em, (w0, mu0, var0), None, length=iters)
     return w, mu, var
 
 
-@partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters"))
-def _gmm_fit(x, n, row_ok, k, iters, min_var, seed, kmeans_iters):
+@partial(jax.jit, static_argnames=("k", "iters", "kmeans_iters", "obs"))
+def _gmm_fit(x, n, row_ok, k, iters, min_var, seed, kmeans_iters, obs=False):
     # the eager preambles (ragged flatten/mask/count; dense iota/less;
     # PRNGKey) were ~7 extra compiled programs per fit, each a ~0.1 s
     # compile-cache RPC on the tunneled backend (r5 call-site
@@ -191,9 +217,9 @@ def _gmm_fit(x, n, row_ok, k, iters, min_var, seed, kmeans_iters):
         row_ok = (jnp.arange(x.shape[0]) < n).astype(jnp.float32)
     key = jax.random.PRNGKey(seed)
     x = constrain(x.astype(jnp.float32), DATA_AXIS)
-    means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key)
+    means0 = _kmeans_fit(x, row_ok, k, kmeans_iters, key, obs=obs)
     gmean = jnp.sum(x * row_ok[:, None], axis=0) / n
     gvar = jnp.sum((x - gmean) ** 2 * row_ok[:, None], axis=0) / n
     var0 = jnp.tile(jnp.maximum(gvar, min_var)[None, :], (k, 1))
     w0 = jnp.full((k,), 1.0 / k, jnp.float32)
-    return _em_steps(x, n, row_ok, w0, means0, var0, iters, min_var)
+    return _em_steps(x, n, row_ok, w0, means0, var0, iters, min_var, obs=obs)

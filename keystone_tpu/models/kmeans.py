@@ -67,6 +67,8 @@ class KMeansPlusPlusEstimator(Estimator):
         return (self.num_means, self.max_iterations, self.seed)
 
     def fit_dataset(self, data: Dataset) -> KMeansModel:
+        from keystone_tpu.obs import ledger
+
         x = data.array
         if data.mask is not None:
             x = x.reshape(-1, x.shape[-1])
@@ -77,11 +79,13 @@ class KMeansPlusPlusEstimator(Estimator):
         return KMeansModel(
             _kmeans_fit(
                 x, row_ok, self.num_means, self.max_iterations,
-                jax.random.PRNGKey(self.seed),
+                jax.random.PRNGKey(self.seed), obs=ledger.solver_obs(),
             )
         )
 
     def fit_arrays(self, x) -> KMeansModel:
+        from keystone_tpu.obs import ledger
+
         x = jnp.asarray(x, jnp.float32)
         return KMeansModel(
             _kmeans_fit(
@@ -90,6 +94,7 @@ class KMeansPlusPlusEstimator(Estimator):
                 self.num_means,
                 self.max_iterations,
                 jax.random.PRNGKey(self.seed),
+                obs=ledger.solver_obs(),
             )
         )
 
@@ -110,9 +115,13 @@ def _row_at(x, idx):
     return constrain(sdot(onehot, x))
 
 
-@partial(jax.jit, static_argnames=("k", "iters"))
-def _kmeans_fit(x, row_ok, k, iters, key):
-    """row_ok: (n_rows,) 1.0 for real rows, 0.0 for padding/invalid."""
+@partial(jax.jit, static_argnames=("k", "iters", "obs"))
+def _kmeans_fit(x, row_ok, k, iters, key, obs=False):
+    """row_ok: (n_rows,) 1.0 for real rows, 0.0 for padding/invalid.
+
+    ``obs`` (static): per-Lloyd-iteration ``solver.epoch`` telemetry
+    (distortion + center shift) via ``jax.debug.callback`` — same math
+    either way; the inert program carries no callbacks."""
     x = constrain(x.astype(jnp.float32), DATA_AXIS)
     n_rows = x.shape[0]
 
@@ -134,7 +143,7 @@ def _kmeans_fit(x, row_ok, k, iters, key):
     centers, key = lax.fori_loop(1, k, seed_step, (centers0, key))
 
     # --- Lloyd iterations ---
-    def lloyd(centers, _):
+    def lloyd(centers, it):
         d = _sq_dists(x, centers)
         assign = jax.nn.one_hot(jnp.argmin(d, axis=1), k) * row_ok[:, None]
         counts = constrain(jnp.sum(assign, axis=0))  # psum over 'data'
@@ -142,7 +151,28 @@ def _kmeans_fit(x, row_ok, k, iters, key):
         new = sums / jnp.maximum(counts, 1.0)[:, None]
         # keep old center for empty clusters
         new = jnp.where((counts > 0)[:, None], new, centers)
+        if obs:
+            from keystone_tpu.obs import ledger
+
+            distortion = constrain(
+                jnp.sum(jnp.maximum(jnp.min(d, axis=1), 0.0) * row_ok)
+            )
+            shift = jnp.sqrt(jnp.sum((new - centers) ** 2))
+            jax.debug.callback(
+                ledger.solver_callback(
+                    "kmeans", "epoch", "distortion", "center_shift"
+                ),
+                it,
+                distortion,
+                shift,
+            )
         return new, None
 
-    centers, _ = lax.scan(lloyd, centers, None, length=iters)
+    # xs only exist when observing: the inert program must stay
+    # byte-identical to the pre-obs one (the sharding gate pins its HLO,
+    # and an iota xs measurably perturbs XLA's partitioning choices)
+    if obs:
+        centers, _ = lax.scan(lloyd, centers, jnp.arange(iters))
+    else:
+        centers, _ = lax.scan(lloyd, centers, None, length=iters)
     return centers

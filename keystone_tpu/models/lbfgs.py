@@ -36,8 +36,16 @@ def _lbfgs_machinery(
     m: int,
     tol: float,
     max_line_search: int,
+    obs_label: Optional[str] = None,
 ):
     """``(init, step)`` over FLAT iterates for the L-BFGS loop.
+
+    ``obs_label``: when set, every effective step emits a
+    ``solver.epoch`` convergence point (objective + grad norm) to the
+    active run ledger via ``jax.debug.callback``.  The label is resolved
+    at TRACE time and threaded as a static jit argument by the callers,
+    so with observability off the compiled program is exactly the
+    pre-obs one (no callbacks, no host traffic).
 
     ``vag_of_data(data, x) -> (f, g)`` with ``x`` in its ORIGINAL shape;
     ``data`` is an arbitrary pytree threaded through explicitly (rather
@@ -129,6 +137,18 @@ def _lbfgs_machinery(
             r_h = jnp.where(ok, rho_hist.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)), rho_hist)
             cnt = jnp.where(ok, count + 1, count)
             gnorm = jnp.sqrt(dot(g_new, g_new))
+            if obs_label is not None:
+                # fires only on EFFECTIVE steps (the cond's done branch
+                # skips it), so the ledger series is the true trajectory
+                from keystone_tpu.obs import ledger as _ledger
+
+                jax.debug.callback(
+                    _ledger.solver_callback(
+                        obs_label, "objective", "grad_norm"
+                    ),
+                    f_new,
+                    gnorm,
+                )
             return x_new, f_new, g_new, s_h, y_h, r_h, cnt, gnorm < tol
 
         def skip(_):
@@ -163,6 +183,7 @@ def lbfgs_minimize(
     history: int = 10,
     tol: float = 1e-7,
     max_line_search: int = 20,
+    obs_label: Optional[str] = None,
 ):
     """Minimize a smooth function of one array with L-BFGS.
 
@@ -176,7 +197,12 @@ def lbfgs_minimize(
     """
     shape = jnp.shape(x0)
     init, step = _lbfgs_machinery(
-        lambda _, x: value_and_grad(x), shape, history, tol, max_line_search
+        lambda _, x: value_and_grad(x),
+        shape,
+        history,
+        tol,
+        max_line_search,
+        obs_label=obs_label,
     )
     carry = init(None, jnp.asarray(x0).reshape(-1))
     (x, *_), _ = lax.scan(
@@ -240,18 +266,49 @@ def lbfgs_minimize_resumable(
     if carry is None:
         start = 0
         carry = jax.jit(init)(data, jnp.asarray(x0).reshape(-1))
+    from keystone_tpu.obs import ledger, metrics
+
+    observe = ledger.active() is not None
     it = start
     while it < max_iter:
+        import time as _time
+
+        t_chunk = _time.perf_counter()
         n_steps = min(checkpoint_every, max_iter - it)
         carry = chunk(data, carry, n_steps)
         it += n_steps
+        save_seconds = None
         if save_cb is not None:
             # the DEVICE carry is handed over: at d·k·(2m+2) scale the
             # host copy is GBs, and non-writer processes must not pay it
             # (save_cb converts after its process-index check)
             jax.block_until_ready(carry)
+            t_save = _time.perf_counter()
             save_cb(it, carry)
+            save_seconds = _time.perf_counter() - t_save
+            metrics.observe("solver.checkpoint_save_seconds", save_seconds)
+        if observe:
+            # per-chunk convergence point from the (replicated) carry;
+            # the per-iteration series inside the chunk rides the
+            # machinery's own callback when obs_label was threaded
+            f, gnorm = _carry_stats(carry[1], carry[2])
+            ledger.solver_epoch(
+                "lbfgs.chunk",
+                it=int(it),
+                objective=float(np.asarray(f)),
+                grad_norm=float(np.asarray(gnorm)),
+                chunk_seconds=_time.perf_counter() - t_chunk,
+                checkpoint_save_seconds=save_seconds,
+            )
     return carry[0].reshape(shape)
+
+
+@jax.jit
+def _carry_stats(f, g):
+    """(objective, ‖g‖) of a resumable-driver carry — one tiny program,
+    so the obs-enabled chunk loop never pulls the weight-sized gradient
+    to host just to norm it."""
+    return f, jnp.sqrt(jnp.vdot(g, g))
 
 
 def _lbfgs_checkpoint_callbacks(
@@ -394,6 +451,8 @@ class DenseLBFGSwithL2(LabelEstimator):
         return self._fit(x, jnp.asarray(y), x.shape[0])
 
     def _fit(self, x, y, n):
+        from keystone_tpu.obs import ledger
+
         w, b = _lbfgs_least_squares(
             jnp.asarray(x, jnp.float32),
             jnp.asarray(y, jnp.float32),
@@ -402,6 +461,7 @@ class DenseLBFGSwithL2(LabelEstimator):
             self.num_iterations,
             self.history,
             self.fit_intercept,
+            obs=ledger.solver_obs(),
         )
         return LinearMapper(w, b if self.fit_intercept else None)
 
@@ -532,6 +592,8 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
         k = by[0].shape[1]
         history = self._capped_history(d_aug, k)
         if checkpoint_dir is None:
+            from keystone_tpu.obs import ledger
+
             w = _lbfgs_sparse_least_squares(
                 tuple(bidx),
                 tuple(bvals),
@@ -542,6 +604,7 @@ class SparseLBFGSwithL2(DenseLBFGSwithL2):
                 self.num_iterations,
                 history,
                 intercept,
+                obs=ledger.solver_obs(),
             )
         else:
             w = _lbfgs_sparse_checkpointed(
@@ -636,10 +699,12 @@ def _sparse_vag(data, w, *, d: int, intercept: bool):
 
 
 @partial(
-    jax.jit, static_argnames=("d", "num_iterations", "history", "intercept")
+    jax.jit,
+    static_argnames=("d", "num_iterations", "history", "intercept", "obs"),
 )
 def _lbfgs_sparse_least_squares(
-    bidx, bvals, by, n, d, lam, num_iterations, history, intercept=False
+    bidx, bvals, by, n, d, lam, num_iterations, history, intercept=False,
+    obs=False,
 ):
     """Single-XLA-program sparse L-BFGS (objective: :func:`_sparse_vag`)."""
     k = by[0].shape[1]
@@ -650,6 +715,7 @@ def _lbfgs_sparse_least_squares(
         w0,
         max_iter=num_iterations,
         history=history,
+        obs_label="lbfgs.sparse" if obs else None,
     )
 
 
@@ -809,8 +875,13 @@ def _dense_vag(data, w):
     return f, g
 
 
-@partial(jax.jit, static_argnames=("num_iterations", "history", "fit_intercept"))
-def _lbfgs_least_squares(x, y, n, lam, num_iterations, history, fit_intercept):
+@partial(
+    jax.jit,
+    static_argnames=("num_iterations", "history", "fit_intercept", "obs"),
+)
+def _lbfgs_least_squares(
+    x, y, n, lam, num_iterations, history, fit_intercept, obs=False
+):
     xc, yc, xm, ym = _lbfgs_center.__wrapped__(x, y, n, fit_intercept)
     data = (xc, yc, n, lam)
     w0 = jnp.zeros((x.shape[1], y.shape[1]), jnp.float32)
@@ -819,6 +890,7 @@ def _lbfgs_least_squares(x, y, n, lam, num_iterations, history, fit_intercept):
         w0,
         max_iter=num_iterations,
         history=history,
+        obs_label="lbfgs.dense" if obs else None,
     )
     b = ym - xm @ w if fit_intercept else jnp.zeros((y.shape[1],), jnp.float32)
     return w, b

@@ -1,0 +1,27 @@
+"""Unified structured observability: metrics registry + run ledger.
+
+The reference leaned on Spark's event-log UI and a sampling profiler
+(SURVEY.md §5); the TPU rebuild replaces both with two process-wide
+primitives every subsystem reports through:
+
+- :mod:`keystone_tpu.obs.metrics` — thread-safe counters, gauges, and
+  histograms (``REGISTRY``), exported as JSON or Prometheus text.
+  Always on (a bump is one lock + dict update); ``KEYSTONE_METRICS=0``
+  disables recording entirely.
+- :mod:`keystone_tpu.obs.ledger` — a per-run JSONL span/event stream
+  (Dapper-style), activated by ``KEYSTONE_OBS_DIR`` or
+  ``ledger.start_run``; default OFF and inert.  Spans also annotate the
+  jax profiler timeline and sample HBM/RSS watermarks.
+
+Render a ledger with ``python tools/obs_report.py <run.jsonl>``.
+"""
+
+from keystone_tpu.obs import ledger, metrics  # noqa: F401
+from keystone_tpu.obs.ledger import (  # noqa: F401
+    RunLedger,
+    event,
+    span,
+    start_run,
+    stop_run,
+)
+from keystone_tpu.obs.metrics import REGISTRY, MetricsRegistry  # noqa: F401

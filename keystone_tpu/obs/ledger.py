@@ -1,0 +1,398 @@
+"""Run ledger: a per-run JSONL span/event stream (the Dapper-style
+trace the reference got for free from Spark's event log).
+
+One **run** = one JSONL file ``run_<run_id>.jsonl`` under the ledger
+directory.  Every line is one event::
+
+    {"ts": <unix seconds>, "run_id": "...", "seq": <monotonic int>,
+     "kind": "run_start"|"span_start"|"span_end"|"event"|"metrics",
+     "name": "...", "span": <id>, "parent": <id|null>, "attrs": {...}}
+
+``span_end`` lines additionally carry ``"seconds"`` (wall duration) and
+the final attrs (spans may accumulate attrs while open — the executor
+records attempt counts this way).  The schema is flat on purpose:
+``tools/obs_report.py`` and ad-hoc ``jq`` both read it without a parser
+library.
+
+Activation — default OFF and inert:
+
+- ``KEYSTONE_OBS_DIR=<dir>`` activates a process-wide ledger lazily (the
+  first ``span``/``event`` call creates it, ``atexit`` closes it) — the
+  zero-code route, mirroring ``KEYSTONE_FAULTS``.
+- ``start_run(dir)`` / ``stop_run()`` scope a ledger explicitly
+  (bench.py and tests use this; an explicit run wins over the env one).
+
+With neither, every hook in the codebase reduces to one ``None`` check
+(plus one ``os.environ`` lookup) — the disabled-mode zero-event
+guarantee tests pin.
+
+Spans also emit ``jax.profiler.TraceAnnotation`` so ledger stages line
+up by name with device traces captured via ``utils/tracing.py``, and
+sample the device HBM watermark (``memory_stats()``) plus host max-RSS
+at boundaries into the metrics registry (gauge ``hbm.bytes_in_use`` /
+``host.max_rss_bytes``).
+
+Solver telemetry rides :func:`solver_epoch` — host loops call it
+directly; jitted solver scans reach it through ``jax.debug.callback``
+(see ``models/lbfgs.py`` et al., gated by a static ``obs`` flag so the
+compiled program is byte-identical when observability is off).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from keystone_tpu.obs import metrics
+
+ENV_DIR = "KEYSTONE_OBS_DIR"
+
+#: per-process run discriminator: time.time() alone has 1-second
+#: resolution, and two runs started within the same second would
+#: silently append into the same JSONL file
+_RUN_COUNTER = itertools.count()
+
+
+def _json_safe(v):
+    """Best-effort JSON coercion: numpy scalars/arrays and exotic
+    objects must never kill the instrumented path."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_json_safe(x) for x in v]
+    item = getattr(v, "item", None)  # numpy scalar / 0-d array
+    if callable(item):
+        try:
+            return _json_safe(item())
+        except Exception:
+            pass
+    tolist = getattr(v, "tolist", None)
+    if callable(tolist):
+        try:
+            return _json_safe(tolist())
+        except Exception:
+            pass
+    return str(v)
+
+
+def _sample_memory() -> Dict[str, float]:
+    """Device HBM in-use bytes (when the backend exposes memory_stats)
+    plus host peak RSS.  Best-effort: CPU test meshes have no HBM stats
+    and must not error."""
+    out: Dict[str, float] = {}
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        used = stats.get("bytes_in_use")
+        if used is not None:
+            out["hbm_bytes_in_use"] = float(used)
+            metrics.gauge_max("hbm.bytes_in_use", float(used))
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                metrics.gauge_max("hbm.peak_bytes_in_use", float(peak))
+    except Exception:
+        pass
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out["host_max_rss_bytes"] = float(rss_kb) * 1024.0
+        metrics.gauge_max("host.max_rss_bytes", float(rss_kb) * 1024.0)
+    except Exception:
+        pass
+    return out
+
+
+class _Span:
+    """An open span: ``set(**attrs)`` merges attrs reported at close."""
+
+    __slots__ = ("span_id", "name", "attrs", "t0")
+
+    def __init__(self, span_id: int, name: str, attrs: Dict[str, Any]):
+        self.span_id = span_id
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+
+class RunLedger:
+    """Append-only JSONL event stream for one run."""
+
+    def __init__(self, directory: str, run_id: Optional[str] = None):
+        os.makedirs(directory, exist_ok=True)
+        if run_id is None:
+            run_id = (
+                f"{int(time.time()):x}-{os.getpid()}-{next(_RUN_COUNTER)}"
+            )
+        self.run_id = run_id
+        self.directory = directory
+        self.path = os.path.join(directory, f"run_{run_id}.jsonl")
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._tls = threading.local()  # per-thread open-span stack
+        self._closed = False
+        self._emit("run_start", "run", attrs={"pid": os.getpid()})
+
+    # ------------------------------------------------------------ emit
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _emit(
+        self,
+        kind: str,
+        name: str,
+        span: Optional[int] = None,
+        parent: Optional[int] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+        **extra,
+    ) -> None:
+        rec = {
+            "ts": time.time(),
+            "run_id": self.run_id,
+            "kind": kind,
+            "name": name,
+        }
+        if span is not None:
+            rec["span"] = span
+        if parent is not None:
+            rec["parent"] = parent
+        if attrs:
+            rec["attrs"] = _json_safe(attrs)
+        rec.update(extra)
+        with self._lock:
+            if self._closed:
+                return
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def event(self, name: str, **attrs) -> None:
+        st = self._stack()
+        self._emit(
+            "event",
+            name,
+            parent=st[-1].span_id if st else None,
+            attrs=attrs,
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Timed nested region.  Emits span_start/span_end, annotates the
+        jax profiler timeline by the same name, and samples memory
+        watermarks at both boundaries."""
+        with self._lock:
+            self._seq += 1
+            span_id = self._seq
+        st = self._stack()
+        parent = st[-1].span_id if st else None
+        sp = _Span(span_id, name, dict(attrs))
+        self._emit("span_start", name, span=span_id, parent=parent, attrs=attrs)
+        _sample_memory()
+        st.append(sp)
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            ann = contextlib.nullcontext()
+        try:
+            with ann:
+                yield sp
+        finally:
+            st.pop()
+            mem = _sample_memory()
+            end_attrs = dict(sp.attrs)
+            end_attrs.update(mem)
+            self._emit(
+                "span_end",
+                name,
+                span=span_id,
+                parent=parent,
+                attrs=end_attrs,
+                seconds=time.perf_counter() - sp.t0,
+            )
+
+    def metrics_snapshot(self) -> None:
+        """Embed the current registry snapshot as one ``metrics`` line
+        (the report's source for I/O totals and watermarks)."""
+        self._emit("metrics", "metrics.snapshot", attrs=metrics.snapshot())
+
+    def close(self, snapshot: bool = True) -> None:
+        if self._closed:
+            return
+        if snapshot:
+            self.metrics_snapshot()
+        self._emit("run_end", "run")
+        with self._lock:
+            self._closed = True
+            self._f.close()
+
+
+# ----------------------------------------------------------- activation
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional[RunLedger] = None  # start_run / attach
+_ENV_LEDGER: Optional[RunLedger] = None  # lazily created from KEYSTONE_OBS_DIR
+
+
+def active() -> Optional[RunLedger]:
+    """The current ledger, or None (the inert default).  An explicit
+    ``start_run``/``attach`` ledger wins; otherwise ``KEYSTONE_OBS_DIR``
+    lazily creates one process-wide run."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return None
+    global _ENV_LEDGER
+    with _LOCK:
+        if _ENV_LEDGER is None or (
+            _ENV_LEDGER._closed or _ENV_LEDGER.directory != directory
+        ):
+            _ENV_LEDGER = RunLedger(directory)
+            atexit.register(_ENV_LEDGER.close)
+    return _ENV_LEDGER
+
+
+def start_run(directory: str, run_id: Optional[str] = None) -> RunLedger:
+    """Explicitly open (and activate) a run ledger; pair with
+    :func:`stop_run`."""
+    global _ACTIVE
+    led = RunLedger(directory, run_id=run_id)
+    with _LOCK:
+        _ACTIVE = led
+    return led
+
+
+def attach(ledger: Optional[RunLedger]) -> None:
+    """Install an existing ledger as the active one (None detaches)."""
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = ledger
+
+
+def stop_run(snapshot: bool = True) -> None:
+    """Close and detach the explicitly-activated ledger."""
+    global _ACTIVE
+    with _LOCK:
+        led, _ACTIVE = _ACTIVE, None
+    if led is not None:
+        led.close(snapshot=snapshot)
+
+
+# ------------------------------------------------------------- frontends
+
+
+def event(name: str, **attrs) -> None:
+    """Record one event on the active ledger; no-op when inert."""
+    led = active()
+    if led is not None:
+        led.event(name, **attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Timed span on the active ledger; yields the span handle (or None
+    when inert) so callers can ``sp.set(...)`` extra attrs."""
+    led = active()
+    if led is None:
+        yield None
+        return
+    with led.span(name, **attrs) as sp:
+        yield sp
+
+
+def solver_obs() -> bool:
+    """Should solvers trace per-epoch telemetry?  Resolved at trace time
+    and threaded as a STATIC jit argument, so the compiled program is
+    exactly the pre-obs one when this is False."""
+    return active() is not None
+
+
+def solver_epoch(solver: str, **series) -> None:
+    """One solver convergence point (epoch/objective/grad-norm/...).
+    Host loops call this directly; jitted scans reach it via
+    :func:`solver_callback`."""
+    led = active()
+    if led is not None:
+        led.event("solver.epoch", solver=solver, **series)
+
+
+def fold_stage_spans(ledger_path: str) -> Dict[str, dict]:
+    """Aggregate a ledger's ``executor.stage`` span_end lines into
+    ``{key: {seconds, count, retries, failed_attempt_seconds}}``.
+
+    The ONE reader of this part of the schema — ``tools/obs_report.py``
+    and ``workflow/viz.ledger_overlay`` both fold through here, so a
+    schema change cannot silently drift them apart.  Keys are
+    ``"{node_id}:{label}"`` when the span recorded a node id (matching
+    the ``utils/tracing.stage_timings`` convention — distinct nodes
+    sharing a label stay distinct), else the bare label."""
+    out: Dict[str, dict] = {}
+    with open(ledger_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn final line must not hide the run
+            if e.get("kind") != "span_end" or e.get("name") != "executor.stage":
+                continue
+            attrs = e.get("attrs") or {}
+            label = str(attrs.get("node", "?"))
+            nid = attrs.get("node_id")
+            key = f"{nid}:{label}" if nid is not None else label
+            st = out.setdefault(
+                key,
+                {
+                    "label": label,
+                    "seconds": 0.0,
+                    "count": 0,
+                    "retries": 0,
+                    "failed_attempt_seconds": 0.0,
+                },
+            )
+            st["seconds"] += float(e.get("seconds") or 0.0)
+            st["count"] += 1
+            st["retries"] += int(attrs.get("retries") or 0)
+            st["failed_attempt_seconds"] += float(
+                attrs.get("failed_attempt_seconds") or 0.0
+            )
+    return out
+
+
+def solver_callback(solver: str, *names):
+    """A ``jax.debug.callback``-shaped emitter: positional traced values
+    are matched to ``names``.  Values arrive as numpy arrays; scalar
+    coercion happens in the JSON layer."""
+
+    def cb(*vals):
+        led = active()
+        if led is None:
+            return
+        led.event(
+            "solver.epoch",
+            solver=solver,
+            **{n: v for n, v in zip(names, vals)},
+        )
+
+    return cb

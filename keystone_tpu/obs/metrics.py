@@ -1,0 +1,235 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference surfaced operational numbers through Spark's metrics sinks
+and event-log UI; the TPU rebuild has no cluster manager underneath, so
+the registry itself is the sink every subsystem reports to: blockstore
+bytes and retries, durable-layer corruption/fallback counts, executor
+retry time, solver telemetry, fault-injection outcomes, HBM watermarks.
+One process == one registry (module-level :data:`REGISTRY`), mirroring
+``keystone_tpu.faults``' process-global counters.
+
+Design constraints (the reasons this module is stdlib-only and lockful):
+
+- **hot-path cheap**: a counter bump is one lock + one dict update —
+  the same order of cost as the ``fault_point`` hook already paid on
+  every instrumented path.  ``KEYSTONE_METRICS=0`` short-circuits every
+  recording call to a single env lookup (the disabled-mode guarantee
+  tests pin).
+- **no jax / no numpy at import**: ``keystone_tpu.faults`` imports this
+  module, and faults must stay importable before any backend exists.
+- **label-aware**: metrics key on ``(name, sorted(labels))`` so
+  per-site/per-rule breakdowns (``faults.injected{site=...}``) live next
+  to their totals without string mangling at record time.
+
+Exports ride two formats: :meth:`MetricsRegistry.snapshot` (plain dict,
+embedded in run-ledger JSONL and bench artifacts) and
+:meth:`MetricsRegistry.to_prometheus_text` (the text exposition format,
+for scraping or ad-hoc diffing).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+ENV_DISABLE = "KEYSTONE_METRICS"
+
+#: histogram bucket upper bounds (seconds-oriented; byte-scale values
+#: simply land in +Inf, where count/sum/min/max still describe them)
+DEFAULT_BUCKETS = (
+    0.001,
+    0.005,
+    0.01,
+    0.05,
+    0.1,
+    0.5,
+    1.0,
+    5.0,
+    10.0,
+    60.0,
+)
+
+
+def enabled() -> bool:
+    """Recording on?  ``KEYSTONE_METRICS=0`` disables every write path
+    (reads — snapshot/export — always work)."""
+    return os.environ.get(ENV_DISABLE, "1") != "0"
+
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: Dict[str, object]) -> _Key:
+    if not labels:
+        return (name, ())
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+class _Histogram:
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self, bounds=DEFAULT_BUCKETS):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets = [0] * (len(bounds) + 1)  # last = +Inf
+
+    def observe(self, value: float, bounds=DEFAULT_BUCKETS) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        for i, b in enumerate(bounds):
+            if value <= b:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named counters/gauges/histograms with labels."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[_Key, float] = {}
+        self._gauges: Dict[_Key, float] = {}
+        self._hists: Dict[_Key, _Histogram] = {}
+
+    # ----------------------------------------------------------- record
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        """Add ``value`` to a monotonic counter."""
+        if not enabled():
+            return
+        k = _key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + float(value)
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        """Set a point-in-time gauge."""
+        if not enabled():
+            return
+        with self._lock:
+            self._gauges[_key(name, labels)] = float(value)
+
+    def gauge_max(self, name: str, value: float, **labels) -> None:
+        """Raise a gauge to ``value`` if higher (watermark semantics —
+        HBM/RSS peaks survive later lower samples)."""
+        if not enabled():
+            return
+        k = _key(name, labels)
+        with self._lock:
+            prev = self._gauges.get(k)
+            if prev is None or value > prev:
+                self._gauges[k] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one sample into a histogram."""
+        if not enabled():
+            return
+        k = _key(name, labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Histogram()
+            h.observe(float(value))
+
+    # ------------------------------------------------------------- read
+    @staticmethod
+    def _fmt(k: _Key) -> str:
+        name, labels = k
+        if not labels:
+            return name
+        inner = ",".join(f"{lk}={lv}" for lk, lv in labels)
+        return f"{name}{{{inner}}}"
+
+    def snapshot(self) -> dict:
+        """Plain-dict view: ``{"counters": {...}, "gauges": {...},
+        "histograms": {...}}`` with ``name{label=value}`` keys."""
+        with self._lock:
+            return {
+                "counters": {self._fmt(k): v for k, v in self._counters.items()},
+                "gauges": {self._fmt(k): v for k, v in self._gauges.items()},
+                "histograms": {
+                    self._fmt(k): h.as_dict() for k, h in self._hists.items()
+                },
+            }
+
+    def counter_value(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(_key(name, labels), 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter over every label combination."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items() if n == name)
+
+    def gauge_value(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(_key(name, labels))
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition format.  Metric names sanitize
+        ``.``/``-`` to ``_``; histograms export ``_count``/``_sum`` plus
+        cumulative ``_bucket{le=...}`` series."""
+
+        def san(name: str) -> str:
+            return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+        def lbl(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+            parts = [f'{lk}="{lv}"' for lk, lv in labels]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        lines: List[str] = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"{san(name)}_total{lbl(labels)} {v:g}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"{san(name)}{lbl(labels)} {v:g}")
+            for (name, labels), h in sorted(self._hists.items()):
+                base = san(name)
+                lines.append(f"{base}_count{lbl(labels)} {h.count}")
+                lines.append(f"{base}_sum{lbl(labels)} {h.sum:g}")
+                cum = 0
+                for bound, n in zip(DEFAULT_BUCKETS, h.buckets):
+                    cum += n
+                    le = 'le="%g"' % bound
+                    lines.append(f"{base}_bucket{lbl(labels, le)} {cum}")
+                cum += h.buckets[-1]
+                inf = 'le="+Inf"'
+                lines.append(f"{base}_bucket{lbl(labels, inf)} {cum}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: the process-wide registry every subsystem reports to
+REGISTRY = MetricsRegistry()
+
+# module-level conveniences (the instrumented call sites use these)
+inc = REGISTRY.inc
+observe = REGISTRY.observe
+set_gauge = REGISTRY.set_gauge
+gauge_max = REGISTRY.gauge_max
+snapshot = REGISTRY.snapshot
+reset = REGISTRY.reset

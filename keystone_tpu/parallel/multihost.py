@@ -79,7 +79,14 @@ def initialize(
     if initialization_timeout is not None:
         kwargs["initialization_timeout"] = int(initialization_timeout)
 
+    import time as _time
+
+    from keystone_tpu.obs import metrics
+
+    t0 = _time.perf_counter()
+
     def _init():
+        metrics.inc("multihost.init_attempts")
         fault_point("multihost.init")
         try:
             jax.distributed.initialize(
@@ -109,6 +116,11 @@ def initialize(
         retry_on=(OSError, ConnectionError, RuntimeError),
         description="distributed init",
     )
+    dt = _time.perf_counter() - t0
+    metrics.observe("multihost.init_seconds", dt)
+    from keystone_tpu.obs import ledger
+
+    ledger.event("multihost.init", seconds=dt)
 
 
 def hybrid_mesh(model_parallelism: int = 1):

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 import numpy as np
 
 from keystone_tpu.faults import FaultInjected, fault_point
+from keystone_tpu.obs import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +104,7 @@ def verify_checksum(path: str, required: bool = False) -> bool:
         expected = f.read().strip()
     actual = compute_checksum(path)
     if actual != expected:
+        metrics.inc("durable.corruption")
         raise CorruptStateError(
             f"checksum mismatch for {path}: content={actual[:12]}… "
             f"sidecar={expected[:12]}…"
@@ -164,6 +166,7 @@ def with_retries(
             attempt += 1
             if attempt > retries:
                 raise
+            metrics.inc("durable.retries")
             delay = next(delays)
             logger.warning(
                 "transient I/O failure%s (%s); retry %d/%d in %.2fs",
@@ -315,9 +318,11 @@ def load_npz(
                 _read, description=f"checkpoint load {os.path.basename(cand)}"
             )
         except CorruptStateError as e:
+            metrics.inc("durable.skipped_corrupt")
             logger.warning("skipping corrupt checkpoint %s: %s", cand, e)
             continue
         except Exception as e:
+            metrics.inc("durable.skipped_unreadable")
             logger.warning("skipping unreadable checkpoint %s: %s", cand, e)
             continue
         if validate is not None:
@@ -330,6 +335,7 @@ def load_npz(
                 logger.info("checkpoint %s rejected by validator", cand)
                 continue
         if cand != path:
+            metrics.inc("durable.fallback")
             logger.warning(
                 "resumed from fallback checkpoint %s (newer candidates "
                 "invalid)",
@@ -354,5 +360,6 @@ def quarantine(path: str) -> Optional[str]:
             os.replace(side, checksum_path(dest))
         except OSError:
             pass
+    metrics.inc("durable.quarantined")
     logger.warning("quarantined corrupt state file %s -> %s", path, dest)
     return dest

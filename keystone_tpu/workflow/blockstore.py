@@ -41,6 +41,7 @@ from typing import Iterable, Iterator, Optional, Sequence, Tuple
 import numpy as np
 
 from keystone_tpu.faults import fault_point
+from keystone_tpu.obs import metrics
 
 _META = "meta.json"
 _DTYPES = ("float32", "bfloat16")
@@ -161,6 +162,8 @@ class FeatureBlockStore:
             fault_point(
                 "blockstore.write", path=self._block_path(self.directory, b)
             )
+            metrics.inc("blockstore.write_bytes", int(chunk.nbytes))
+        metrics.inc("blockstore.writes")
         self._cursor = stop
 
     def finalize(self) -> None:
@@ -262,8 +265,10 @@ class FeatureBlockStore:
         expected_bytes = (
             self.n * self.block_size * np.dtype(self._disk_dtype).itemsize
         )
+        attempts = [0]
 
         def _read():
+            attempts[0] += 1
             fault_point("blockstore.read", path=path)
             if os.path.getsize(path) < expected_bytes:
                 raise durable.CorruptStateError(
@@ -285,6 +290,10 @@ class FeatureBlockStore:
             return raw
 
         raw = durable.with_retries(_read, description=f"block read {path}")
+        metrics.inc("blockstore.reads")
+        metrics.inc("blockstore.read_bytes", int(raw.nbytes))
+        if attempts[0] > 1:
+            metrics.inc("blockstore.read_retries", attempts[0] - 1)
         if self.dtype == "bfloat16":
             return raw.view(_bf16())
         return raw

@@ -81,43 +81,75 @@ class GraphExecutor:
             )
         op = self.graph.operators[target]
         deps = [self._eval(d) for d in self.graph.dependencies[target]]
-        t0 = time.perf_counter() if self.profile else 0.0
+        from keystone_tpu.obs import ledger, metrics
+
         delays = None
-        for attempt in range(self.node_retries + 1):
-            try:
-                # the fault site sits INSIDE the retry scope: an injected
-                # stage fault with retries configured must be survived,
-                # which is exactly what the chaos tests assert
-                from keystone_tpu.faults import fault_point
+        failed_seconds = 0.0
+        with ledger.span(
+            "executor.stage", node=op.label(), node_id=target.id
+        ) as sp:
+            for attempt in range(self.node_retries + 1):
+                # t0 restarts per attempt: profile timings charge each
+                # node ONLY its successful attempt — failed attempts and
+                # the retry backoff sleeps used to skew
+                # ProfilingAutoCacheRule placement (a flaky node looked
+                # expensive exactly when it should not have)
+                t0 = time.perf_counter()
+                try:
+                    # the fault site sits INSIDE the retry scope: an
+                    # injected stage fault with retries configured must be
+                    # survived, which is exactly what the chaos tests
+                    # assert
+                    from keystone_tpu.faults import fault_point
 
-                fault_point("executor.stage", node=op.label())
-                result = self._execute_op(op, deps)
-                break
-            except Exception as e:
-                if attempt >= self.node_retries:
-                    raise
-                logger.warning(
-                    "stage %s failed (%s); retry %d/%d",
-                    op.label(),
-                    e,
-                    attempt + 1,
-                    self.node_retries,
-                )
-                # brief backoff (+jitter) before the re-run: transient
-                # causes (preemption, flaky interconnect) need a beat to
-                # clear, and decorrelating parallel executors helps
-                if delays is None:
-                    from keystone_tpu.utils.durable import backoff_delays
-
-                    delays = iter(
-                        backoff_delays(
-                            self.node_retries, base_delay=0.05, max_delay=1.0
-                        )
+                    fault_point("executor.stage", node=op.label())
+                    result = self._execute_op(op, deps)
+                    break
+                except Exception as e:
+                    failed_seconds += time.perf_counter() - t0
+                    if attempt >= self.node_retries:
+                        if failed_seconds:
+                            metrics.inc(
+                                "executor.failed_attempt_seconds", failed_seconds
+                            )
+                        raise
+                    metrics.inc("executor.stage_retries")
+                    ledger.event(
+                        "executor.retry",
+                        node=op.label(),
+                        attempt=attempt + 1,
+                        error=f"{type(e).__name__}: {e}"[:200],
                     )
-                time.sleep(next(delays, 1.0))
-        if self.profile:
-            _sync_expr(result)
-            self.timings[target] = time.perf_counter() - t0
+                    logger.warning(
+                        "stage %s failed (%s); retry %d/%d",
+                        op.label(),
+                        e,
+                        attempt + 1,
+                        self.node_retries,
+                    )
+                    # brief backoff (+jitter) before the re-run: transient
+                    # causes (preemption, flaky interconnect) need a beat to
+                    # clear, and decorrelating parallel executors helps
+                    if delays is None:
+                        from keystone_tpu.utils.durable import backoff_delays
+
+                        delays = iter(
+                            backoff_delays(
+                                self.node_retries, base_delay=0.05, max_delay=1.0
+                            )
+                        )
+                    time.sleep(next(delays, 1.0))
+            if failed_seconds:
+                # failed-attempt time is real cost, but it belongs to the
+                # RETRY budget, not the node's compute profile
+                metrics.inc("executor.failed_attempt_seconds", failed_seconds)
+            if sp is not None:
+                sp.set(attempts=attempt + 1, retries=attempt)
+                if failed_seconds:
+                    sp.set(failed_attempt_seconds=failed_seconds)
+            if self.profile:
+                _sync_expr(result)
+                self.timings[target] = time.perf_counter() - t0
         if not getattr(op, "no_memoize", False):
             # no_memoize nodes (over the HBM budget — workflow/profiling.py)
             # recompute per consumer instead of pinning their output

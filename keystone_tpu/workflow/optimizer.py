@@ -63,13 +63,29 @@ class Optimizer:
         self.batches = list(batches)
 
     def execute(self, graph: G.Graph) -> G.Graph:
-        for batch in self.batches:
-            for _ in range(batch.strategy.max_iterations):
-                before = _graph_fingerprint(graph)
-                for rule in batch.rules:
-                    graph = rule.apply(graph)
-                if _graph_fingerprint(graph) == before:
-                    break
+        import time
+
+        from keystone_tpu.obs import ledger, metrics
+
+        with ledger.span("optimizer.execute"):
+            for batch in self.batches:
+                for _ in range(batch.strategy.max_iterations):
+                    before = _graph_fingerprint(graph)
+                    for rule in batch.rules:
+                        t0 = time.perf_counter()
+                        graph = rule.apply(graph)
+                        dt = time.perf_counter() - t0
+                        metrics.observe(
+                            "optimizer.rule_seconds", dt, rule=rule.name
+                        )
+                        ledger.event(
+                            "optimizer.rule",
+                            rule=rule.name,
+                            batch=batch.name,
+                            seconds=dt,
+                        )
+                    if _graph_fingerprint(graph) == before:
+                        break
         return graph
 
 

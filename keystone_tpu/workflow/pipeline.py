@@ -233,7 +233,31 @@ class Pipeline(Chainable):
         """Optimize, execute every estimator fit, and return a pure
         transformer pipeline (the reference's ``Pipeline.fit():
         PipelineModel``).  Fits are memoized via the executor, so shared
-        prefixes run once."""
+        prefixes run once.
+
+        Observability: with ``KEYSTONE_OBS_DIR`` set (or a ledger
+        attached via ``obs.ledger.start_run``) the whole fit runs inside
+        a ``pipeline.fit`` span — per-stage executor spans, solver
+        convergence events, and I/O counters land in the run's JSONL
+        ledger, and a metrics snapshot is flushed at fit end so
+        ``tools/obs_report.py`` can summarize a run even if the process
+        later dies.  Unset, all hooks are inert."""
+        from keystone_tpu.obs import ledger as _ledger
+
+        with _ledger.span("pipeline.fit"):
+            fitted_pipe = self._fit_inner()
+        led = _ledger.active()
+        if led is not None:
+            try:
+                import jax
+
+                jax.effects_barrier()  # flush in-flight solver callbacks
+            except Exception:
+                pass
+            led.metrics_snapshot()
+        return fitted_pipe
+
+    def _fit_inner(self) -> "FittedPipeline":
         opt = PipelineEnv.get_optimizer()
         g = opt.execute(self.graph)
         g = _auto_out_of_core(g)
@@ -266,11 +290,14 @@ class Pipeline(Chainable):
         g = StageFusionRule().apply(g)
         return FittedPipeline(g, self.source, self.sink)
 
-    def to_dot(self, name: str = "pipeline") -> str:
-        """Graphviz DOT of this pipeline's DAG (Pipeline.toDOT analogue)."""
+    def to_dot(self, name: str = "pipeline", timings=None, retries=None) -> str:
+        """Graphviz DOT of this pipeline's DAG (Pipeline.toDOT analogue).
+        ``timings``/``retries`` overlay measured per-node seconds and
+        retry counts (see ``workflow/viz.py`` — ``ledger_overlay`` folds
+        them out of a run ledger)."""
         from keystone_tpu.workflow.viz import to_dot
 
-        return to_dot(self.graph, name)
+        return to_dot(self.graph, name, timings=timings, retries=retries)
 
     def __repr__(self):
         return f"Pipeline({self.graph!r})"

@@ -275,16 +275,20 @@ class ProfilingAutoCacheRule(Rule):
         )
         remaining = self.budget_bytes
         shared_bytes = 0
+        pinned_bytes = 0
+        demotions = 0
         for n in shared:
             prof = profiles.get(n)
             cost = prof.full_bytes if prof else 0
             shared_bytes += cost
             if cost <= remaining:
                 remaining -= cost
+                pinned_bytes += cost
                 graph = _insert_cacher(graph, n)
             else:
                 op = graph.operators[n]
                 if isinstance(op, G.TransformerOperator):
+                    demotions += 1
                     logger.info(
                         "over HBM budget: %s (%.1f MB) will recompute per consumer",
                         op.label(),
@@ -303,6 +307,19 @@ class ProfilingAutoCacheRule(Rule):
                 "shared_bytes": int(shared_bytes),
                 "budget_bytes": int(self.budget_bytes),
             }
+        )
+        from keystone_tpu.obs import ledger, metrics
+
+        metrics.set_gauge("optimizer.pinned_bytes", float(pinned_bytes))
+        if demotions:
+            metrics.inc("optimizer.no_memoize_demotions", demotions)
+        ledger.event(
+            "optimizer.cache_placement",
+            shared_nodes=len(shared),
+            pinned_bytes=int(pinned_bytes),
+            no_memoize_demotions=int(demotions),
+            shared_bytes=int(shared_bytes),
+            budget_bytes=int(self.budget_bytes),
         )
         return graph
 

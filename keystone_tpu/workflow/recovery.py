@@ -136,6 +136,18 @@ def fit_with_recovery(
                 return fitted, attempt
             except Exception as e:
                 last_err = e
+                # per-attempt fault stats land in the run ledger BEFORE
+                # any reset/restart, so chaos reports keep the full
+                # per-restart history instead of only the final window
+                from keystone_tpu import faults
+                from keystone_tpu.obs import ledger
+
+                ledger.event(
+                    "faults.stats",
+                    attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    stats=faults.stats(),
+                )
                 if attempt >= max_restarts:
                     raise
                 logger.warning(

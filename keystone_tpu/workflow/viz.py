@@ -3,15 +3,67 @@
 The reference exposes ``Pipeline.toDOT`` for debugging its DAGs
 (workflow/Pipeline.scala); same idea here, plus optimizer before/after
 diffing is just two calls.
+
+Observability overlay: pass per-node ``timings`` (seconds) and/or
+``retries`` keyed by node label — either hand-built, from
+``utils/tracing.stage_timings`` (keys ``"{node_id}:{label}"`` also
+match), or folded out of a run ledger with :func:`ledger_overlay` — and
+nodes render with their measured time (and retry count) under the
+label, shaded by share of total time::
+
+    timings, retries = ledger_overlay("/tmp/obs/run_abc.jsonl")
+    dot = to_dot(pipe.graph, timings=timings, retries=retries)
 """
 
 from __future__ import annotations
 
+from typing import Dict, Optional, Tuple
+
 from keystone_tpu.workflow import graph as G
 
 
-def to_dot(graph: G.Graph, name: str = "pipeline") -> str:
+def ledger_overlay(ledger_path: str) -> Tuple[Dict[str, float], Dict[str, int]]:
+    """(timings, retries) per node from a run-ledger JSONL file — the
+    shared ``obs.ledger.fold_stage_spans`` fold (one reader of the span
+    schema, shared with tools/obs_report.py).  Unique labels key by bare
+    label (matches any graph the caller overlays onto); duplicate
+    labels key by ``"{node_id}:{label}"`` so two branches holding the
+    same transformer type stay distinct instead of each displaying the
+    merged total."""
+    from collections import Counter
+
+    from keystone_tpu.obs.ledger import fold_stage_spans
+
+    folded = fold_stage_spans(ledger_path)
+    label_count = Counter(st["label"] for st in folded.values())
+    timings: Dict[str, float] = {}
+    retries: Dict[str, int] = {}
+    for key, st in folded.items():
+        k = st["label"] if label_count[st["label"]] == 1 else key
+        timings[k] = st["seconds"]
+        if st["retries"]:
+            retries[k] = st["retries"]
+    return timings, retries
+
+
+def _lookup(overlay: Optional[dict], n, label: str):
+    """Overlay value for a node: exact label, or a stage_timings-style
+    ``"{node_id}:{label}"`` key."""
+    if not overlay:
+        return None
+    if label in overlay:
+        return overlay[label]
+    return overlay.get(f"{n.id}:{label}")
+
+
+def to_dot(
+    graph: G.Graph,
+    name: str = "pipeline",
+    timings: Optional[Dict[str, float]] = None,
+    retries: Optional[Dict[str, int]] = None,
+) -> str:
     lines = [f"digraph {name} {{", "  rankdir=LR;", "  node [shape=box, fontsize=10];"]
+    total = sum(timings.values()) if timings else 0.0
     for s in graph.sources:
         lines.append(f'  "{s!r}" [shape=ellipse, label="source {s.id}"];')
     for n in graph.topological_nodes():
@@ -22,7 +74,23 @@ def to_dot(graph: G.Graph, name: str = "pipeline") -> str:
             G.EstimatorOperator: "house",
         }.get(type(op), "box")
         label = op.label().replace('"', "'")
-        lines.append(f'  "{n!r}" [shape={shape}, label="{label}"];')
+        extra = ""
+        seconds = _lookup(timings, n, op.label())
+        nretries = _lookup(retries, n, op.label())
+        annot = []
+        if seconds is not None:
+            annot.append(f"{seconds:.3f}s")
+        if nretries:
+            annot.append(f"x{int(nretries)} retries")
+        if annot:
+            label = label + "\\n" + " ".join(annot)
+        if seconds is not None and total > 0:
+            # share-of-total shading: the hot path jumps out of the graph
+            share = min(1.0, seconds / total)
+            extra = (
+                ', style=filled, fillcolor="0.08 %0.2f 1.0"' % (0.1 + 0.8 * share)
+            )
+        lines.append(f'  "{n!r}" [shape={shape}, label="{label}"{extra}];')
         for d in graph.dependencies[n]:
             lines.append(f'  "{d!r}" -> "{n!r}";')
     for k, d in graph.sink_dependencies.items():

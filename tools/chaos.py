@@ -144,11 +144,23 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--tmp", default=None, help="scratch dir (default: a fresh tempdir)"
     )
+    ap.add_argument(
+        "--ledger",
+        default=None,
+        metavar="DIR",
+        help="write a run ledger (JSONL spans/events) under DIR: per-"
+        "restart fault stats() land there instead of being lost to "
+        "reset_stats() between restart attempts, and the report reads "
+        "per-site counts from the unified metrics registry "
+        "(render with tools/obs_report.py)",
+    )
     args = ap.parse_args(argv)
 
     import tempfile
 
     from keystone_tpu import faults
+    from keystone_tpu.obs import ledger as obs_ledger
+    from keystone_tpu.obs import metrics
 
     plan = faults.parse_plan(args.plan)  # fail fast on grammar errors
     tmp = args.tmp or tempfile.mkdtemp(prefix="kst_chaos_")
@@ -160,7 +172,13 @@ def main(argv=None) -> int:
         fn = getattr(importlib.import_module(modname), fnname or "main")
         run = fn
 
+    led = None
+    if args.ledger:
+        led = obs_ledger.start_run(args.ledger)
+        led.event("chaos.start", plan=args.plan, workload=args.workload)
+
     faults.reset_stats()
+    metrics.reset()  # the report window starts here, registry included
     error = None
     with faults.inject(plan):
         try:
@@ -169,6 +187,27 @@ def main(argv=None) -> int:
             error = f"{type(e).__name__}: {e}"
 
     stats = faults.stats()
+    # the unified registry accumulates across restarts (reset_stats only
+    # clears the faults-module window): prefer it for per-site counts so
+    # the report and the ledger agree
+    snap = metrics.snapshot()
+    reg_sites = {}
+    for key, v in (snap.get("counters") or {}).items():
+        for name in ("faults.calls", "faults.injected"):
+            if key.startswith(name + "{site="):
+                site = key[len(name) + 6 : -1]
+                reg_sites.setdefault(site, {"calls": 0, "injected": 0})
+                reg_sites[site][
+                    "calls" if name.endswith("calls") else "injected"
+                ] += int(v)
+    if reg_sites:
+        stats = {
+            site: {
+                "calls": c["calls"],
+                "injected": c["injected"],
+            }
+            for site, c in reg_sites.items()
+        }
     escaped_site = None
     if error is not None and "injected fault at" in error:
         for site in faults.SITES:
@@ -200,6 +239,16 @@ def main(argv=None) -> int:
             for site, counts in sorted(stats.items())
         },
     }
+    if led is not None:
+        led.event(
+            "faults.stats",
+            final=True,
+            completed=error is None,
+            error=error,
+            stats=report["sites"],
+        )
+        report["ledger"] = led.path
+        obs_ledger.stop_run()
     print(json.dumps(report, indent=2))
     return 0 if error is None else 1
 

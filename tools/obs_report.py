@@ -1,0 +1,357 @@
+"""Render a run-ledger JSONL file into a human (or JSON) summary.
+
+The read side of ``keystone_tpu.obs``: ``Pipeline.fit`` (with
+``KEYSTONE_OBS_DIR`` set), ``tools/chaos.py --ledger``, and bench.py all
+write JSONL ledgers; this tool folds one back into the questions an
+operator actually asks::
+
+    JAX_PLATFORMS=cpu python tools/obs_report.py /tmp/obs/run_abc.jsonl
+    python tools/obs_report.py /tmp/obs            # newest run in a dir
+    python tools/obs_report.py run.jsonl --json    # machine-readable
+
+Sections (each only when the run recorded it):
+
+- **stages**: top executor stages by total span seconds, with attempt /
+  retry counts and failed-attempt time;
+- **retries**: retry totals across executor, durable I/O, blockstore,
+  and streams;
+- **convergence**: per-solver epoch series (objective / grad norm /
+  distortion / log-likelihood — whatever the solver emitted);
+- **io**: blockstore bytes read/written, durable corruption/fallback,
+  stream batch latency, from the run's last metrics snapshot;
+- **memory**: HBM and host-RSS watermarks;
+- **faults**: per-site injected counts (chaos runs).
+
+``summarize()`` / ``render()`` are importable — bench.py embeds the
+summary dict in its round artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def resolve_ledger_path(path: str) -> str:
+    """A .jsonl file, or a directory → its most recently modified run."""
+    if os.path.isdir(path):
+        runs = glob.glob(os.path.join(path, "run_*.jsonl"))
+        if not runs:
+            raise FileNotFoundError(f"no run_*.jsonl ledgers under {path}")
+        return max(runs, key=os.path.getmtime)
+    return path
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a torn final line (killed process) must not hide the
+                # rest of the run
+                continue
+    return events
+
+
+def _counter_total(snapshot: dict, name: str) -> float:
+    return sum(
+        v
+        for k, v in (snapshot.get("counters") or {}).items()
+        if k == name or k.startswith(name + "{")
+    )
+
+
+def _fault_sites(snapshot: dict, name: str) -> Dict[str, float]:
+    """``faults.injected{site=x}`` counters → {site: count}."""
+    out: Dict[str, float] = {}
+    for k, v in (snapshot.get("counters") or {}).items():
+        if not k.startswith(name + "{"):
+            continue
+        labels = k[len(name) + 1 : -1]
+        for part in labels.split(","):
+            lk, _, lv = part.partition("=")
+            if lk == "site":
+                out[lv] = out.get(lv, 0.0) + v
+    return out
+
+
+def summarize(path: str, top_k: int = 10) -> dict:
+    """Fold one ledger into a summary dict (see module docstring)."""
+    path = resolve_ledger_path(path)
+    events = load_events(path)
+
+    # ------------------------------------------------------------ stages
+    # the span fold is shared with workflow/viz.ledger_overlay (ONE
+    # reader of the executor.stage span schema)
+    from keystone_tpu.obs.ledger import fold_stage_spans
+
+    stages = fold_stage_spans(path)
+    retry_events = sum(
+        1
+        for e in events
+        if e.get("kind") == "event" and e.get("name") == "executor.retry"
+    )
+    top = sorted(stages.values(), key=lambda st: -st["seconds"])[:top_k]
+    stage_top = [
+        {
+            "node": st["label"],
+            "seconds": st["seconds"],
+            "count": st["count"],
+            "retries": st["retries"],
+            "failed_attempt_seconds": st["failed_attempt_seconds"],
+        }
+        for st in top
+    ]
+
+    # ------------------------------------------------------- convergence
+    convergence: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("kind") == "event" and e.get("name") == "solver.epoch":
+            attrs = dict(e.get("attrs") or {})
+            solver = str(attrs.pop("solver", "?"))
+            convergence.setdefault(solver, []).append(attrs)
+
+    # ----------------------------------------------- metrics (last snap)
+    snapshot: dict = {}
+    for e in events:
+        if e.get("kind") == "metrics":
+            snapshot = e.get("attrs") or snapshot
+    gauges = snapshot.get("gauges") or {}
+    hists = snapshot.get("histograms") or {}
+
+    io = {
+        "blockstore_read_bytes": _counter_total(snapshot, "blockstore.read_bytes"),
+        "blockstore_write_bytes": _counter_total(snapshot, "blockstore.write_bytes"),
+        "blockstore_reads": _counter_total(snapshot, "blockstore.reads"),
+        "blockstore_writes": _counter_total(snapshot, "blockstore.writes"),
+        "blockstore_read_retries": _counter_total(
+            snapshot, "blockstore.read_retries"
+        ),
+        "durable_retries": _counter_total(snapshot, "durable.retries"),
+        "durable_corruption": _counter_total(snapshot, "durable.corruption"),
+        "durable_fallback": _counter_total(snapshot, "durable.fallback"),
+        "durable_quarantined": _counter_total(snapshot, "durable.quarantined"),
+        "stream_bad_batches": _counter_total(snapshot, "stream.bad_batches"),
+        "stream_retries": _counter_total(snapshot, "stream.retries"),
+        "stream_batch_seconds": {
+            k: v for k, v in hists.items() if k.startswith("stream.batch_seconds")
+        },
+    }
+
+    retries = {
+        "executor_retry_events": retry_events,
+        "executor_stage_retries": _counter_total(
+            snapshot, "executor.stage_retries"
+        ),
+        "executor_failed_attempt_seconds": _counter_total(
+            snapshot, "executor.failed_attempt_seconds"
+        ),
+        "durable_retries": io["durable_retries"],
+        "blockstore_read_retries": io["blockstore_read_retries"],
+        "stream_retries": io["stream_retries"],
+    }
+
+    memory = {
+        "hbm_bytes_in_use": gauges.get("hbm.bytes_in_use"),
+        "hbm_peak_bytes_in_use": gauges.get("hbm.peak_bytes_in_use"),
+        "host_max_rss_bytes": gauges.get("host.max_rss_bytes"),
+    }
+    # span-boundary samples are watermarks too (a run killed before its
+    # snapshot still has per-span samples)
+    for e in events:
+        if e.get("kind") == "span_end":
+            attrs = e.get("attrs") or {}
+            for src, dst in (
+                ("hbm_bytes_in_use", "hbm_bytes_in_use"),
+                ("host_max_rss_bytes", "host_max_rss_bytes"),
+            ):
+                v = attrs.get(src)
+                if v is not None and (
+                    memory.get(dst) is None or float(v) > float(memory[dst])
+                ):
+                    memory[dst] = float(v)
+
+    # ------------------------------------------------------------ faults
+    faults: Dict[str, dict] = {}
+    injected = _fault_sites(snapshot, "faults.injected")
+    calls = _fault_sites(snapshot, "faults.calls")
+    for site in sorted(set(injected) | set(calls)):
+        faults[site] = {
+            "calls": int(calls.get(site, 0)),
+            "injected": int(injected.get(site, 0)),
+        }
+    # per-restart stats events (fit_with_recovery / chaos.py)
+    fault_events = [
+        e["attrs"]
+        for e in events
+        if e.get("kind") == "event" and e.get("name") == "faults.stats"
+    ]
+
+    run_ids = {e.get("run_id") for e in events if e.get("run_id")}
+    t0 = min((e["ts"] for e in events if "ts" in e), default=None)
+    t1 = max((e["ts"] for e in events if "ts" in e), default=None)
+    return {
+        "path": path,
+        "run_id": sorted(run_ids)[0] if run_ids else None,
+        "events": len(events),
+        "wall_seconds": (t1 - t0) if (t0 is not None and t1 is not None) else None,
+        "stage_top": stage_top,
+        "retries": retries,
+        "convergence": convergence,
+        "io": io,
+        "memory": memory,
+        "faults": faults,
+        "fault_restarts": fault_events,
+    }
+
+
+def _fmt_bytes(b: Optional[float]) -> str:
+    if b is None:
+        return "-"
+    b = float(b)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(b) < 1024 or unit == "TB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} TB"
+
+
+def render(summary: dict) -> str:
+    """Human-readable text for one :func:`summarize` dict."""
+    out: List[str] = []
+    out.append(f"run {summary.get('run_id')}  ({summary.get('path')})")
+    ws = summary.get("wall_seconds")
+    out.append(
+        f"  events: {summary.get('events')}"
+        + (f"   wall: {ws:.2f}s" if ws is not None else "")
+    )
+
+    if summary.get("stage_top"):
+        out.append("\n== top stages by time ==")
+        out.append(
+            f"  {'seconds':>9}  {'runs':>4}  {'retries':>7}  "
+            f"{'failed_s':>8}  stage"
+        )
+        for st in summary["stage_top"]:
+            out.append(
+                f"  {st['seconds']:>9.3f}  {st['count']:>4}  "
+                f"{st['retries']:>7}  {st['failed_attempt_seconds']:>8.3f}  "
+                f"{st['node']}"
+            )
+
+    r = summary.get("retries") or {}
+    if any(v for v in r.values()):
+        out.append("\n== retries ==")
+        for k, v in r.items():
+            if v:
+                out.append(f"  {k}: {v:g}")
+
+    conv = summary.get("convergence") or {}
+    if conv:
+        out.append("\n== solver convergence ==")
+        for solver, series in conv.items():
+            out.append(f"  {solver}: {len(series)} points")
+            head = series[: 3]
+            tail = series[-2:] if len(series) > 5 else []
+            for pt in head:
+                out.append("    " + json.dumps(pt, sort_keys=True))
+            if tail:
+                out.append("    ...")
+                for pt in tail:
+                    out.append("    " + json.dumps(pt, sort_keys=True))
+
+    io = summary.get("io") or {}
+    if any(v for k, v in io.items() if isinstance(v, (int, float))):
+        out.append("\n== I/O ==")
+        out.append(
+            "  blockstore: "
+            f"read {_fmt_bytes(io.get('blockstore_read_bytes'))} "
+            f"({io.get('blockstore_reads', 0):g} reads, "
+            f"{io.get('blockstore_read_retries', 0):g} retries), "
+            f"wrote {_fmt_bytes(io.get('blockstore_write_bytes'))} "
+            f"({io.get('blockstore_writes', 0):g} appends)"
+        )
+        out.append(
+            "  durable: "
+            f"retries {io.get('durable_retries', 0):g}, "
+            f"corruption {io.get('durable_corruption', 0):g}, "
+            f"fallbacks {io.get('durable_fallback', 0):g}, "
+            f"quarantined {io.get('durable_quarantined', 0):g}"
+        )
+        out.append(
+            "  stream: "
+            f"retries {io.get('stream_retries', 0):g}, "
+            f"bad batches {io.get('stream_bad_batches', 0):g}"
+        )
+        for k, h in (io.get("stream_batch_seconds") or {}).items():
+            mean = h["sum"] / h["count"] if h.get("count") else 0.0
+            out.append(
+                f"  {k}: n={h.get('count')} mean={mean * 1e3:.2f}ms "
+                f"max={(h.get('max') or 0) * 1e3:.2f}ms"
+            )
+
+    mem = summary.get("memory") or {}
+    if any(v is not None for v in mem.values()):
+        out.append("\n== memory watermarks ==")
+        if mem.get("hbm_bytes_in_use") is not None:
+            out.append(f"  HBM in use: {_fmt_bytes(mem['hbm_bytes_in_use'])}")
+        if mem.get("hbm_peak_bytes_in_use") is not None:
+            out.append(
+                f"  HBM peak:   {_fmt_bytes(mem['hbm_peak_bytes_in_use'])}"
+            )
+        if mem.get("host_max_rss_bytes") is not None:
+            out.append(
+                f"  host peak RSS: {_fmt_bytes(mem['host_max_rss_bytes'])}"
+            )
+
+    faults = summary.get("faults") or {}
+    if faults:
+        out.append("\n== faults ==")
+        out.append(f"  {'site':<20} {'calls':>7} {'injected':>9}")
+        for site, c in faults.items():
+            out.append(f"  {site:<20} {c['calls']:>7} {c['injected']:>9}")
+    if summary.get("fault_restarts"):
+        out.append(
+            f"  restart stats events: {len(summary['fault_restarts'])}"
+        )
+
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a keystone_tpu run-ledger JSONL file"
+    )
+    ap.add_argument(
+        "ledger",
+        help="path to a run_*.jsonl file, or a KEYSTONE_OBS_DIR directory "
+        "(newest run is picked)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the summary dict as JSON"
+    )
+    ap.add_argument(
+        "--top", type=int, default=10, help="stages to list (default 10)"
+    )
+    args = ap.parse_args(argv)
+    summary = summarize(args.ledger, top_k=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
