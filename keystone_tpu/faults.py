@@ -34,7 +34,14 @@ Plan grammar: ``site:token:token;site:token...`` where tokens are
   ``OSError`` so every transient-I/O retry path treats it as
   retryable), ``corrupt`` (flip bytes in the site's file), ``truncate``
   (halve the site's file), ``exit`` / ``exit=CODE`` (``os._exit`` — the
-  kill-worker action).
+  kill-worker action), and the **latency actions** ``delay=SECONDS``
+  (stall the operation, then let it proceed) and ``hang`` (stall far
+  past any deadline — ``KEYSTONE_HANG_SECONDS``, default 3600 s).
+  Latency actions are valid at every site; the stalls ride
+  ``utils.guard.interruptible_sleep``, so a watchdog
+  (``guard.run_with_deadline``) that gives up on the hung operation
+  also unparks the injected sleep — the deadline/watchdog/breaker
+  layer can be chaos-tested without hour-long test runs.
 
 Everything is deterministic given the plan string and the call
 sequence: probabilistic specs draw from a private ``random.Random(seed)``
@@ -68,7 +75,7 @@ SITES = {
     "executor.stage",
 }
 
-_ACTIONS = ("raise", "corrupt", "truncate", "exit")
+_ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")
 
 # file-damaging actions only make sense once the file is durably
 # published; failure actions fire while the operation is in flight.
@@ -105,6 +112,7 @@ class SiteSpec:
         seed: int = 0,
         times: Optional[int] = None,
         exit_code: int = 42,
+        delay_seconds: float = 0.0,
     ):
         self.site = site
         self.action = action
@@ -114,6 +122,7 @@ class SiteSpec:
         self.seed = int(seed)
         self.times = None if times is None else int(times)
         self.exit_code = int(exit_code)
+        self.delay_seconds = float(delay_seconds)
         self.reset()
 
     def reset(self) -> None:
@@ -190,11 +199,20 @@ def parse_plan(text: str) -> FaultPlan:
             if not tok:
                 continue
             key, _, val = tok.partition("=")
-            if key in _ACTIONS and not val:
+            if key in _ACTIONS and not val and key != "delay":
                 kwargs["action"] = key
             elif key == "exit":
                 kwargs["action"] = "exit"
                 kwargs["exit_code"] = int(val)
+            elif key == "delay":
+                try:
+                    kwargs["delay_seconds"] = float(val)
+                except ValueError:
+                    raise FaultPlanError(
+                        f"delay needs seconds (delay=0.5), got {tok!r} in "
+                        f"clause {clause!r}"
+                    )
+                kwargs["action"] = "delay"
             elif key == "after":
                 kwargs["after"] = int(val)
             elif key == "every":
@@ -338,6 +356,20 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
             )
             if spec.action == "exit":
                 os._exit(spec.exit_code)
+            if spec.action in ("delay", "hang"):
+                # latency, not failure: stall the operation in flight,
+                # then let it proceed.  The sleep is cancel-aware
+                # (guard.interruptible_sleep) so a watchdog that gave up
+                # on this operation also unparks the injected stall.
+                from keystone_tpu.utils import guard
+
+                seconds = (
+                    spec.delay_seconds
+                    if spec.action == "delay"
+                    else guard.hang_seconds()
+                )
+                guard.interruptible_sleep(seconds)
+                continue
             if spec.action == "corrupt" and path and os.path.exists(path):
                 _corrupt_file(path)
                 continue  # damage is silent: the *load* must detect it

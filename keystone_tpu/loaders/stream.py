@@ -35,6 +35,15 @@ from keystone_tpu.obs import metrics
 logger = logging.getLogger(__name__)
 
 
+def _deadline_exceeded_type():
+    """Lazy accessor for guard.DeadlineExceeded (resilient() must stay
+    usable before utils.guard — and its obs imports — are loaded when no
+    timeout is configured)."""
+    from keystone_tpu.utils.guard import DeadlineExceeded
+
+    return DeadlineExceeded
+
+
 def batched(array: np.ndarray, batch_size: int) -> Callable[[], Iterator[np.ndarray]]:
     """Re-iterable batch source over an in-memory array.  Carries the
     ``stream.batch`` fault site so chaos plans can flake any pipeline
@@ -62,6 +71,7 @@ def resilient(
     base_delay: float = 0.05,
     max_delay: float = 1.0,
     sleep: Callable[[float], None] = time.sleep,
+    timeout: Optional[float] = None,
 ) -> Callable[[], Iterator]:
     """Re-iterable batch source that survives transient per-batch
     failures (the Spark-task-retry analogue for input streams).
@@ -76,6 +86,21 @@ def resilient(
     error propagates.  ``max_bad_batches=0`` (default) means retry-only:
     transient flakiness is absorbed, deterministic failure still fails
     the fit.
+
+    ``timeout`` (seconds, per batch fetch): a watchdog around each
+    ``next()`` — a source that silently HANGS (stuck NFS read, wedged
+    decoder) raises ``utils.guard.DeadlineExceeded``, an ``OSError``,
+    so it is retried and then counted against ``max_bad_batches``
+    exactly like a raising batch, instead of blocking the iterator
+    forever.  The fetch runs on a watchdog worker thread only when a
+    timeout is configured (default None: same-thread, zero overhead);
+    after a timeout the suspect iterator is abandoned and a fresh one
+    replays, per the retry contract above.  Costs to know about: each
+    guarded fetch spawns one short-lived thread (~tens of µs — noise
+    against ms-scale batch decode, but don't configure timeouts on
+    microsecond-batch sources), and each ABANDONED fetch parks a daemon
+    thread in ``next()`` until the source wakes — bounded by
+    ``retries + max_bad_batches`` per stream, never unbounded.
 
     A source that ends BEFORE the replay position raises rather than
     silently truncating the stream.  One ambiguity is undetectable from
@@ -106,7 +131,30 @@ def resilient(
         attempt = 0  # failures of the batch at `attempt_idx`
         attempt_idx = -1  # the budget is PER BATCH, not pooled
         swallowed_last = False  # previous fetch was a dropped batch failing
+        stall = 0  # consecutive restarts with zero progress
+        progress_mark = None  # (delivered, len(dropped)) at last restart
+        last_err = None  # the exception that ended the previous cycle
         while True:
+            # a restart cycle that neither delivered nor dropped anything
+            # AND ended in a fetch timeout is spinning (e.g. a dropped
+            # batch that HANGS on every replay — it cannot be skipped,
+            # only re-executed): fail loudly after a bounded number of
+            # such cycles instead of paying one timeout per cycle
+            # forever.  Raise-y transient failures are exempt — their
+            # budget is PER BATCH (the module's documented contract),
+            # and alternating failures across different replay batches
+            # must not pool into one abort.
+            mark = (delivered, len(dropped))
+            barren = progress_mark is not None and mark == progress_mark
+            if not barren:
+                stall = 0
+            elif timeout is not None and isinstance(
+                last_err, _deadline_exceeded_type()
+            ):
+                stall += 1
+                if stall > retries:
+                    raise last_err
+            progress_mark = mark
             src = source() if callable(source) else iter(source)
             pos = 0  # absolute index of the next fetch from this iterator
             restart = False
@@ -118,7 +166,17 @@ def resilient(
                 idx = pos
                 t_fetch = time.perf_counter()
                 try:
-                    batch = next(src)
+                    if timeout is None:
+                        batch = next(src)
+                    else:
+                        from keystone_tpu.utils import guard
+
+                        batch = guard.run_with_deadline(
+                            lambda: next(src),
+                            guard.Deadline.after(timeout),
+                            site="stream.batch",
+                            index=idx,
+                        )
                     metrics.observe(
                         "stream.batch_seconds",
                         time.perf_counter() - t_fetch,
@@ -149,8 +207,29 @@ def resilient(
                     return
                 except Exception as e:
                     pos += 1
+                    last_err = e
+                    # a timed-out fetch may leave the abandoned watchdog
+                    # worker still INSIDE next(src) — pulling more from
+                    # that iterator would blow up ("generator already
+                    # executing") and charge the error to the next
+                    # healthy batch.  The drop/swallow paths WANT to
+                    # continue the same iterator (that is how a
+                    # batch-resumable source skips past a bad batch), so
+                    # give the worker a short grace to vacate — cancel-
+                    # aware work exits promptly — and only fall back to
+                    # a fresh-iterator replay when it is truly stuck.
+                    occupied = False
+                    if timeout is not None and isinstance(
+                        e, _deadline_exceeded_type()
+                    ):
+                        w = getattr(e, "worker", None)
+                        if w is not None:
+                            w.join(min(1.0, timeout))
+                        occupied = w is None or w.is_alive()
                     if idx in dropped:
                         swallowed_last = True
+                        if occupied:
+                            restart = True
                         continue  # a written-off batch failing again
                     swallowed_last = False
                     if idx != attempt_idx:
@@ -191,6 +270,8 @@ def resilient(
                             len(dropped),
                             max_bad_batches,
                         )
+                        if occupied:
+                            restart = True  # see timeout note above
                         continue
                     # out of quota — or an already-DELIVERED batch failed
                     # its replay (dropping it would desync the consumer)

@@ -435,6 +435,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         for e in range(start, self.num_iter):
             import time as _time
 
+            # one sick host must abort ALL hosts at the epoch boundary
+            # (SickHostError / DeadlineExceeded in bounded time) rather
+            # than deadlock its peers inside the epoch's collectives.
+            # Inert single-process / without KEYSTONE_HEALTH_TIMEOUT.
+            from keystone_tpu.parallel.multihost import maybe_health_barrier
+
+            maybe_health_barrier("bcd.checkpointed.epoch")
             t_epoch = _time.perf_counter()
             w, p = _bcd_epoch(xb, yc, nf, self.lam, w, p)
             jax.block_until_ready(w)
@@ -763,6 +770,11 @@ def _oc_bcd_fit(
         w[b], p = _oc_block_step(stage(blk), xm[b], yc, sa, row_ok, p, w[b], lam_n)
         pending.append(w[b])
         if (i + 1) % nb == 0:
+            # epoch boundary: abort collectively if a peer host went
+            # sick mid-sweep (see fit_checkpointed's barrier) — the
+            # checkpoint gathers below are collectives every process
+            # must enter, and a dead peer would park them forever
+            _mh.maybe_health_barrier("oc_bcd.epoch")
             save_seconds = None
             if ckpt_path is not None:
                 jax.block_until_ready(p)

@@ -319,6 +319,29 @@ def span(name: str, **attrs):
         yield sp
 
 
+def capture_context():
+    """Snapshot the calling thread's open-span stack (opaque token).
+    The span stack is thread-local, so work handed to a worker thread —
+    ``utils/guard.run_with_deadline`` watchdogs are the in-repo case —
+    would otherwise emit spans/events with no parent.  Capture on the
+    calling thread, :func:`restore_context` inside the worker, and the
+    worker's spans nest where the caller's would have."""
+    led = active()
+    if led is None:
+        return None
+    return (led, list(led._stack()))
+
+
+def restore_context(token) -> None:
+    """Install a :func:`capture_context` snapshot on the CURRENT thread
+    (a copy — the originating thread's stack is never shared or
+    mutated).  No-op for a None token."""
+    if token is None:
+        return
+    led, stack = token
+    led._tls.stack = list(stack)
+
+
 def solver_obs() -> bool:
     """Should solvers trace per-epoch telemetry?  Resolved at trace time
     and threaded as a STATIC jit argument, so the compiled program is

@@ -179,6 +179,13 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get(_key(name, labels))
 
+    def remove_gauge(self, name: str, **labels) -> None:
+        """Drop one gauge series (registry owners evicting dead keys —
+        e.g. guard's breaker registry — keep export cardinality bounded
+        by removing the series along with the owner's entry)."""
+        with self._lock:
+            self._gauges.pop(_key(name, labels), None)
+
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 

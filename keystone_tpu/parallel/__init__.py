@@ -39,3 +39,8 @@ from keystone_tpu.parallel.collectives import (  # noqa: F401
     sharded_matmul,
     tree_psum,
 )
+from keystone_tpu.parallel.multihost import (  # noqa: F401
+    SickHostError,
+    health_barrier,
+    maybe_health_barrier,
+)

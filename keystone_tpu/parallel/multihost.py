@@ -30,6 +30,52 @@ from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 logger = logging.getLogger(__name__)
 
+ENV_HEALTH_TIMEOUT = "KEYSTONE_HEALTH_TIMEOUT"
+
+
+class SickHostError(RuntimeError):
+    """A peer host reported unhealthy at a :func:`health_barrier` — the
+    job must abort *together* (collectives are SPMD; continuing without
+    the sick host would deadlock the healthy ones).  Deliberately not an
+    ``OSError``: in-process retry cannot heal a dead peer, job-level
+    restart (with checkpoint resume) owns recovery."""
+
+
+#: substrings marking a RuntimeError as connection-shaped, i.e. worth
+#: the retry/backoff budget.  jax's distributed runtime surfaces both
+#: transient coordinator races and deterministic config errors as bare
+#: RuntimeError — only the former should burn backoff time.
+_TRANSIENT_INIT_MARKERS = (
+    "connect",
+    "connection",
+    "unavailable",
+    "timed out",
+    "timeout",
+    "deadline",
+    "refused",
+    "reset",
+    "barrier",
+    "coordinator",
+    "heartbeat",
+    "grpc",
+    "socket",
+    "temporar",  # temporary/temporarily
+    "again",  # EAGAIN-style "try again"
+)
+
+
+def _transient_init_error(e: BaseException) -> bool:
+    """Should the init retry loop absorb ``e``?  OSErrors (including
+    injected faults) and ConnectionErrors: always.  RuntimeErrors: only
+    when the message looks connection-shaped — a deterministic config
+    error (mismatched ``num_processes``, bad process id) must fail
+    fast instead of burning the full backoff budget before surfacing
+    (tests/test_regressions.py pins both directions)."""
+    if isinstance(e, (OSError, ConnectionError)):
+        return True
+    msg = str(e).lower()
+    return any(m in msg for m in _TRANSIENT_INIT_MARKERS)
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -52,8 +98,10 @@ def initialize(
     default 2 — restarted jobs routinely race their coordinator coming
     back up), ``initialization_timeout`` forwards to jax's barrier
     timeout, and the attempt carries the ``multihost.init`` fault site
-    so chaos plans can exercise exactly this path.  Deterministic
-    initialization errors still propagate once the budget is spent.
+    so chaos plans can exercise exactly this path.  Only
+    connection-shaped errors are retried (``_transient_init_error``): a
+    deterministic config error — e.g. mismatched ``num_processes`` —
+    fails fast instead of burning the backoff budget before surfacing.
     """
     import os
 
@@ -114,6 +162,7 @@ def initialize(
         base_delay=0.5,
         max_delay=10.0,
         retry_on=(OSError, ConnectionError, RuntimeError),
+        retry_if=_transient_init_error,
         description="distributed init",
     )
     dt = _time.perf_counter() - t0
@@ -121,6 +170,71 @@ def initialize(
     from keystone_tpu.obs import ledger
 
     ledger.event("multihost.init", seconds=dt)
+
+
+def health_barrier(
+    ok: bool = True, timeout: Optional[float] = None, tag: str = "health"
+) -> bool:
+    """All-gather one ok-bit per host, under a watchdog.
+
+    The multi-process failure mode stage retry cannot cover: one host
+    goes sick (OOM-killed fit thread, wedged local disk) while its peers
+    park forever inside the next collective.  Calling this at natural
+    sync points (epoch boundaries, restart attempts) converts that
+    deadlock into a clean, *collective* abort:
+
+    - every healthy host sees the sick host's 0-bit and raises
+      :class:`SickHostError` — all processes abort together, and
+      job-level restart resumes from durable checkpoints;
+    - a host that is too dead to even join the gather trips the
+      ``timeout`` watchdog instead
+      (``utils.guard.DeadlineExceeded``).
+
+    Single-process: an immediate no-op ``True`` (the inert path — CPU
+    tests and laptops never pay for a collective).  Pass ``ok=False``
+    on a host that knows it is failing so peers abort deterministically
+    at the same barrier."""
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    from keystone_tpu.obs import ledger, metrics
+    from keystone_tpu.utils import guard
+
+    arr = np.asarray([1 if ok else 0], np.int32)
+
+    def gather():
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    deadline = None if timeout is None else guard.Deadline.after(float(timeout))
+    bits = guard.run_with_deadline(
+        gather, deadline, site="multihost.health", tag=tag
+    )
+    sick = [i for i, b in enumerate(bits.reshape(-1).tolist()) if not b]
+    if sick:
+        metrics.inc("multihost.sick_hosts", tag=tag)
+        ledger.event("multihost.sick_host", tag=tag, sick=sick)
+        raise SickHostError(
+            f"host(s) {sick} reported unhealthy at the {tag!r} barrier; "
+            "aborting collectively (restart the job to resume from "
+            "checkpoints)"
+        )
+    return True
+
+
+def maybe_health_barrier(tag: str, ok: bool = True) -> bool:
+    """Env-gated :func:`health_barrier` for hook sites (epoch drivers,
+    recovery attempts): inert unless ``KEYSTONE_HEALTH_TIMEOUT`` is set
+    to a positive number AND the job is multi-process — single-process
+    callers pay one env lookup, nothing else.  ``guard.env_float`` owns
+    the parse, so ``0`` means "disabled" here exactly as it does for
+    every other guard knob (not a zero-second deadline)."""
+    from keystone_tpu.utils.guard import env_float
+
+    timeout = env_float(ENV_HEALTH_TIMEOUT)
+    if timeout is None or jax.process_count() == 1:
+        return True
+    return health_barrier(ok=ok, timeout=timeout, tag=tag)
 
 
 def hybrid_mesh(model_parallelism: int = 1):

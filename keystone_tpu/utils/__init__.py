@@ -17,6 +17,14 @@ from keystone_tpu.utils.stats import (  # noqa: F401
 from keystone_tpu.utils import tracing  # noqa: F401
 from keystone_tpu.utils import durable  # noqa: F401
 from keystone_tpu.utils.durable import CorruptStateError  # noqa: F401
+from keystone_tpu.utils import guard  # noqa: F401
+from keystone_tpu.utils.guard import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    run_with_deadline,
+)
 
 # Test-fixture generators (the reference's src/test/scala/utils/TestUtils
 # analogue) live in keystone_tpu.utils.test_utils — import that module

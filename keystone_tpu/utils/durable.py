@@ -76,12 +76,17 @@ def checksum_path(path: str) -> str:
     return path + CHECKSUM_SUFFIX
 
 
-def write_checksum(path: str) -> str:
+def write_checksum(path: str, digest: Optional[str] = None) -> str:
     """Write ``<path>.b2`` (atomically) for the current content of
-    ``path``; returns the digest."""
-    digest = compute_checksum(path)
+    ``path`` — or for a caller-supplied ``digest`` (publishers that
+    hashed their own bytes before the rename, so the sidecar can never
+    describe somebody else's payload); returns the digest."""
+    import threading
+
+    if digest is None:
+        digest = compute_checksum(path)
     side = checksum_path(path)
-    tmp = f"{side}.tmp.{os.getpid()}"
+    tmp = f"{side}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(digest + "\n")
         f.flush()
@@ -147,12 +152,17 @@ def with_retries(
     retry_on: Tuple = TRANSIENT,
     description: str = "",
     sleep: Callable[[float], None] = time.sleep,
+    retry_if: Optional[Callable[[BaseException], bool]] = None,
 ):
     """Call ``fn()`` with up to ``retries`` bounded retries on transient
     errors.  ``retries=None`` resolves ``KEYSTONE_IO_RETRIES`` (default
     2) so every I/O path honors the knob without plumbing.  Exceptions
     outside ``retry_on`` — notably :class:`CorruptStateError` —
-    propagate immediately."""
+    propagate immediately.  ``retry_if``: an extra predicate a caught
+    exception must ALSO satisfy to be retried — for callers whose
+    transient/deterministic split is finer than exception types (e.g.
+    ``multihost.initialize``, where only connection-shaped
+    ``RuntimeError``s are worth the backoff budget)."""
     if retries is None:
         retries = max(0, _env_int("KEYSTONE_IO_RETRIES", 2))
     delays = iter(backoff_delays(retries, base_delay, max_delay))
@@ -162,6 +172,8 @@ def with_retries(
             return fn()
         except retry_on as e:
             if isinstance(e, CorruptStateError):
+                raise
+            if retry_if is not None and not retry_if(e):
                 raise
             attempt += 1
             if attempt > retries:
@@ -196,18 +208,40 @@ def _fsync_dir(dirpath: str) -> None:
         os.close(fd)
 
 
+#: serializes the payload-rename + sidecar-publish PAIR within this
+#: process: a watchdog-abandoned checkpoint attempt racing its own
+#: retry (utils/guard.run_with_deadline) must not interleave the two
+#: renames — payload B with sidecar A would make the newest checkpoint
+#: read as corrupt.  Cross-process writers to one path remain
+#: last-writer-wins (unchanged; solver checkpoints are process-0-only).
+import threading as _threading
+
+_PUBLISH_LOCK = _threading.Lock()
+
+
 def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
     """Publish a file atomically: ``write_fn(tmp)`` writes the payload,
     then fsync + rename + dir fsync + checksum sidecar.  The tmp name is
-    per-pid so concurrent writers on a shared directory never truncate
-    each other mid-write."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    per-pid AND per-thread so concurrent writers — other processes on a
+    shared directory, or a watchdog-abandoned stage attempt racing its
+    own retry (utils/guard.run_with_deadline) — never truncate each
+    other mid-write.  The digest is computed from OUR tmp bytes before
+    the rename and the rename+sidecar pair is published under a
+    process-wide lock, so the sidecar always describes the payload that
+    landed with it; publication stays last-writer-wins, which is
+    idempotent for the stage-retry case because stages are pure
+    functions of memoized inputs."""
+    import threading
+
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     write_fn(tmp)
     with open(tmp, "rb") as f:
         os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(os.path.abspath(path)))
-    write_checksum(path)
+    digest = compute_checksum(tmp)
+    with _PUBLISH_LOCK:
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        write_checksum(path, digest=digest)
 
 
 def _rotated(path: str, i: int) -> str:

@@ -164,6 +164,7 @@ class StreamDataset(Dataset):
         host: bool = False,
         retries: int = 0,
         max_bad_batches: int = 0,
+        timeout: Optional[float] = None,
     ):
         self.name = name
         self.n = int(n)
@@ -179,14 +180,20 @@ class StreamDataset(Dataset):
                 "returning a fresh iterator (or a list of batches), not a "
                 "one-shot generator/iterator"
             )
-        if retries > 0 or max_bad_batches > 0:
+        if retries > 0 or max_bad_batches > 0 or timeout is not None:
             # flaky-source hardening (loaders/stream.resilient): bounded
             # per-batch retry with backoff, then a drop quota — wrapped
-            # UNDER prefetched so retries run on the producer thread
+            # UNDER prefetched so retries run on the producer thread.
+            # ``timeout`` adds a per-fetch watchdog: a silently-hung
+            # source raises (DeadlineExceeded, an OSError) into the
+            # same retry/quota machinery instead of stalling the fit
             from keystone_tpu.loaders.stream import resilient
 
             source = resilient(
-                source, retries=retries, max_bad_batches=max_bad_batches
+                source,
+                retries=retries,
+                max_bad_batches=max_bad_batches,
+                timeout=timeout,
             )
         if prefetch > 0:
             from keystone_tpu.loaders.stream import prefetched

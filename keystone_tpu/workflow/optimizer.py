@@ -487,6 +487,12 @@ def _fusable(op) -> bool:
         and not op.transformer.is_host
         and getattr(op.transformer, "fusable", True)
         and not isinstance(op.transformer, Cacher)
+        # degradation-declaring stages (optional / with_fallback —
+        # workflow/executor.py) must stay standalone nodes: fusing one
+        # into a chain would make the executor fail the WHOLE chain
+        # where the user asked for that one stage to degrade
+        and not getattr(op.transformer, "optional", False)
+        and getattr(op.transformer, "fallback", None) is None
     )
 
 

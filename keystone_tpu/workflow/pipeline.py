@@ -229,11 +229,20 @@ class Pipeline(Chainable):
         return PipelineDatum(g, self.sink)
 
     # --------------------------------------------------------------- fit
-    def fit(self) -> "FittedPipeline":
+    def fit(self, deadline=None) -> "FittedPipeline":
         """Optimize, execute every estimator fit, and return a pure
         transformer pipeline (the reference's ``Pipeline.fit():
         PipelineModel``).  Fits are memoized via the executor, so shared
         prefixes run once.
+
+        ``deadline``: a wall-clock budget for the whole fit — seconds or
+        a ``utils.guard.Deadline``.  The executor apportions it over the
+        stages (see ``GraphExecutor``): a stage that overruns its share
+        raises ``DeadlineExceeded`` inside the stage-retry scope, so
+        hung stages are retried, degraded (``optional`` /
+        ``with_fallback`` nodes), or fail the fit in bounded time
+        instead of stalling it forever.  Default None: no watchdog, no
+        threads — the pre-deadline behavior exactly.
 
         Observability: with ``KEYSTONE_OBS_DIR`` set (or a ledger
         attached via ``obs.ledger.start_run``) the whole fit runs inside
@@ -245,7 +254,7 @@ class Pipeline(Chainable):
         from keystone_tpu.obs import ledger as _ledger
 
         with _ledger.span("pipeline.fit"):
-            fitted_pipe = self._fit_inner()
+            fitted_pipe = self._fit_inner(deadline=deadline)
         led = _ledger.active()
         if led is not None:
             try:
@@ -257,11 +266,13 @@ class Pipeline(Chainable):
             led.metrics_snapshot()
         return fitted_pipe
 
-    def _fit_inner(self) -> "FittedPipeline":
+    def _fit_inner(self, deadline=None) -> "FittedPipeline":
         opt = PipelineEnv.get_optimizer()
         g = opt.execute(self.graph)
         g = _auto_out_of_core(g)
-        ex = GraphExecutor(g)
+        # ONE executor (and one resolved Deadline) for every estimator
+        # in the walk: memoized prefixes and the fit budget are shared
+        ex = GraphExecutor(g, deadline=deadline)
         fitted: dict = {}
         for n in g.topological_nodes():
             if isinstance(g.operators[n], G.EstimatorOperator):
@@ -308,7 +319,7 @@ class FittedPipeline(Pipeline):
     (the analogue of the reference's serialized PipelineModel +
     workflow/SavedStateLoadRule.scala)."""
 
-    def fit(self) -> "FittedPipeline":
+    def fit(self, deadline=None) -> "FittedPipeline":
         return self
 
     def _walk_fitted(self, visit=None) -> None:
@@ -595,11 +606,14 @@ class PipelineDataset:
         self.sink = sink
         self._result: Optional[Dataset] = None
 
-    def get(self) -> Dataset:
+    def get(self, deadline=None) -> Dataset:
+        """Trigger optimize + execute (cached).  ``deadline``: wall-clock
+        budget for the apply, apportioned per stage by the executor —
+        the scoring-path twin of ``Pipeline.fit(deadline=…)``."""
         if self._result is None:
             opt = PipelineEnv.get_optimizer()
             g = opt.execute(self.graph)
-            ex = GraphExecutor(g)
+            ex = GraphExecutor(g, deadline=deadline)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatasetExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected dataset")
@@ -619,10 +633,10 @@ class PipelineDatum:
         self._result = None
         self._done = False
 
-    def get(self):
+    def get(self, deadline=None):
         if not self._done:
             g = PipelineEnv.get_optimizer().execute(self.graph)
-            ex = GraphExecutor(g)
+            ex = GraphExecutor(g, deadline=deadline)
             expr = ex.execute(g.sink_dependencies.get(self.sink, self.sink))
             if not isinstance(expr, DatumExpr):
                 raise TypeError(f"sink produced {type(expr).__name__}, expected datum")

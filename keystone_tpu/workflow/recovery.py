@@ -129,6 +129,15 @@ def fit_with_recovery(
         last_err: Optional[BaseException] = None
         for attempt in range(max_restarts + 1):
             try:
+                # multi-process: verify every peer is alive and healthy
+                # BEFORE launching a collective fit — a dead host fails
+                # this barrier (DeadlineExceeded / SickHostError) in
+                # bounded time instead of deadlocking the first
+                # all-reduce.  Inert single-process and with no
+                # KEYSTONE_HEALTH_TIMEOUT configured.
+                from keystone_tpu.parallel import multihost
+
+                multihost.maybe_health_barrier("fit_with_recovery.attempt")
                 fitted = build_fn().fit()
                 # force materialization so failures surface HERE, inside
                 # the retry scope, not at first use of the fitted model

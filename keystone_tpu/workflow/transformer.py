@@ -192,6 +192,28 @@ class Transformer(Chainable):
     #: inline the inner program and embed its traced stage parameters
     #: as outer-program constants, nullifying cross-instance sharing.
     self_jitted: bool = False
+    #: Graceful degradation (workflow/executor.py): an ``optional``
+    #: stage whose retry/deadline budget is exhausted — or whose circuit
+    #: breaker is open — is replaced by :class:`Identity` (its input
+    #: passes through untouched) instead of failing the run.  A
+    #: ``fallback`` transformer (set via :meth:`with_fallback`) is the
+    #: substitute applied instead.  Default: neither — failure
+    #: propagates, exactly as before.
+    optional: bool = False
+    fallback: Optional["Transformer"] = None
+
+    def with_fallback(self, substitute: "Transformer") -> "Transformer":
+        """A copy of this transformer that degrades to ``substitute``:
+        when this stage's failure budget (retries, deadline) is spent or
+        its breaker is open, the executor applies ``substitute`` to the
+        stage's input and emits a ``degraded`` ledger event instead of
+        failing the run.  The substitute must accept the same input
+        (e.g. a cheaper featurizer, or a constant-output scorer)."""
+        import copy
+
+        c = copy.copy(self)
+        c.fallback = substitute
+        return c
 
     @property
     def label(self) -> str:
@@ -204,7 +226,17 @@ class Transformer(Chainable):
 
     def signature(self):
         p = self.params()
-        return None if p is None else (type(self).__name__, p)
+        if p is None:
+            return None
+        sig = (type(self).__name__, p)
+        if self.optional or self.fallback is not None:
+            # degradation declarations are part of node identity: CSE
+            # merging an optional/fallback node with a plain twin would
+            # silently widen (or drop) the degradation contract
+            fb = self.fallback
+            fb_sig = None if fb is None else (fb.signature() or id(fb))
+            sig = sig + ("degrade", self.optional, fb_sig)
+        return sig
 
     def jit_static(self):
         """Hashable key covering every non-traced attribute that affects

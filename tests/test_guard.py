@@ -1,0 +1,775 @@
+"""Tier-1 tests for the deadline/watchdog/breaker layer
+(keystone_tpu/utils/guard.py) and its wiring: executor per-stage
+deadlines, graceful degradation (optional / with_fallback), stream fetch
+timeouts, latency fault actions (delay / hang), and the multihost init
+retry filter.  The acceptance scenario — a chaos plan injecting ``hang``
+at ``executor.stage`` and ``delay`` at ``stream.batch`` completing under
+a configured deadline with ``deadline_exceeded`` / ``breaker.transition``
+/ ``degraded`` ledger events — lives at the bottom.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.obs import ledger, metrics
+from keystone_tpu.utils import guard
+from keystone_tpu.workflow import Dataset, GraphExecutor, Pipeline, Transformer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_guard_state():
+    guard.reset_breakers()
+    yield
+    guard.reset_breakers()
+
+
+def _ledger_events(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------- Deadline
+
+
+def test_deadline_remaining_and_expiry():
+    dl = guard.Deadline.after(10.0)
+    assert 9.0 < dl.remaining() <= 10.0
+    assert not dl.expired()
+    assert guard.Deadline.after(-1.0).expired()
+
+
+def test_deadline_child_never_outlives_parent():
+    parent = guard.Deadline.after(0.5)
+    child = parent.child(100.0)
+    assert child.remaining() <= parent.remaining() + 1e-6
+    tight = parent.child(0.1)
+    assert tight.remaining() <= 0.1 + 1e-6
+    inherit = parent.child(None)
+    assert abs(inherit.at - parent.at) < 1e-9
+
+
+def test_as_deadline_coercions():
+    assert guard.as_deadline(None) is None
+    dl = guard.Deadline.after(5)
+    assert guard.as_deadline(dl) is dl
+    assert isinstance(guard.as_deadline(2.5), guard.Deadline)
+
+
+# ----------------------------------------------------- run_with_deadline
+
+
+def test_run_with_deadline_none_is_same_thread_passthrough():
+    """The inert guarantee: deadline=None runs fn on the CALLING thread
+    (no watchdog thread, no queue — one None check)."""
+    seen = []
+    out = guard.run_with_deadline(
+        lambda: seen.append(threading.current_thread()) or "v", None
+    )
+    assert out == "v"
+    assert seen == [threading.current_thread()]
+
+
+def test_run_with_deadline_returns_result_and_propagates_errors():
+    assert guard.run_with_deadline(lambda: 41 + 1, guard.Deadline.after(5)) == 42
+    with pytest.raises(ValueError, match="boom"):
+        guard.run_with_deadline(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            guard.Deadline.after(5),
+        )
+
+
+def test_watchdog_fires_on_sleeping_fn():
+    """A fn that sleeps past the budget raises DeadlineExceeded — an
+    OSError, so every transient-I/O retry path absorbs overruns — and
+    the abandoned worker is unparked via the cooperative cancel flag."""
+    released = threading.Event()
+
+    def sleepy():
+        guard.interruptible_sleep(30.0)
+        released.set()
+
+    t0 = time.perf_counter()
+    with pytest.raises(guard.DeadlineExceeded) as ei:
+        guard.run_with_deadline(sleepy, guard.Deadline.after(0.2), site="t")
+    took = time.perf_counter() - t0
+    assert took < 5.0  # the watchdog, not the sleep, set the pace
+    assert isinstance(ei.value, OSError)
+    assert released.wait(timeout=5.0)  # cancel flag unparked the worker
+    assert metrics.REGISTRY.counter_value("guard.deadline_exceeded", site="t") >= 1
+
+
+def test_expired_deadline_fails_fast_without_running():
+    ran = []
+    with pytest.raises(guard.DeadlineExceeded):
+        guard.run_with_deadline(
+            lambda: ran.append(1), guard.Deadline.after(-1.0), site="t2"
+        )
+    assert not ran
+
+
+def test_deadline_exceeded_event_lands_in_ledger(tmp_path):
+    led = ledger.start_run(str(tmp_path))
+    with pytest.raises(guard.DeadlineExceeded):
+        guard.run_with_deadline(
+            lambda: time.sleep(5), guard.Deadline.after(0.1), site="ev"
+        )
+    ledger.stop_run()
+    evs = _ledger_events(led.path)
+    hits = [e for e in evs if e.get("name") == "deadline_exceeded"]
+    assert hits and hits[0]["attrs"]["site"] == "ev"
+
+
+# ------------------------------------------------------- CircuitBreaker
+
+
+def test_breaker_open_halfopen_close_cycle():
+    clk = [0.0]
+    b = guard.CircuitBreaker("cyc", threshold=2, reset_timeout=10.0, clock=lambda: clk[0])
+    assert b.allow() and b.state() == guard.CLOSED
+    b.record_failure()
+    assert b.state() == guard.CLOSED  # one failure < threshold
+    b.record_failure()
+    assert b.state() == guard.OPEN
+    assert not b.allow()
+    clk[0] = 10.0  # reset timeout elapses -> half-open, ONE probe
+    assert b.allow()
+    assert b.state() == guard.HALF_OPEN
+    assert not b.allow()  # second caller is not the probe
+    b.record_success()
+    assert b.state() == guard.CLOSED and b.allow()
+
+
+def test_breaker_halfopen_failure_reopens():
+    clk = [0.0]
+    b = guard.CircuitBreaker("re", threshold=1, reset_timeout=5.0, clock=lambda: clk[0])
+    b.record_failure()
+    assert b.state() == guard.OPEN
+    clk[0] = 5.0
+    assert b.allow()  # the probe
+    b.record_failure()  # probe failed
+    assert b.state() == guard.OPEN
+    assert not b.allow()  # clock has not advanced again
+    clk[0] = 9.0  # reset clock restarted at reopen (t=5), not the first open
+    assert not b.allow()
+    clk[0] = 10.0
+    assert b.allow()
+
+
+def test_breaker_unrecorded_probe_does_not_wedge_halfopen():
+    """A half-open probe whose outcome is never recorded (its caller
+    died, or its failure was deliberately not charged) must not wedge
+    the breaker: after another reset_timeout a fresh probe is admitted."""
+    clk = [0.0]
+    b = guard.CircuitBreaker("wedge", threshold=1, reset_timeout=5.0, clock=lambda: clk[0])
+    b.record_failure()  # open
+    clk[0] = 5.0
+    assert b.allow()  # probe admitted … and its outcome never recorded
+    assert not b.allow()
+    clk[0] = 9.9
+    assert not b.allow()  # stale-probe window not yet elapsed
+    clk[0] = 10.0
+    assert b.allow()  # presumed lost -> fresh probe
+    b.record_success()
+    assert b.state() == guard.CLOSED
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = guard.CircuitBreaker("cnt", threshold=2, reset_timeout=5.0)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state() == guard.CLOSED  # failures were not consecutive
+
+
+def test_breaker_transitions_mirror_into_metrics_and_ledger(tmp_path):
+    led = ledger.start_run(str(tmp_path))
+    b = guard.CircuitBreaker("obs-key", threshold=1, reset_timeout=60.0)
+    b.record_failure()
+    ledger.stop_run()
+    assert metrics.REGISTRY.gauge_value("breaker.state", key="obs-key") == 2.0
+    assert metrics.REGISTRY.counter_value("breaker.opens", key="obs-key") == 1.0
+    evs = _ledger_events(led.path)
+    tr = [e for e in evs if e.get("name") == "breaker.transition"]
+    assert tr and tr[-1]["attrs"] == {
+        "key": "obs-key",
+        "from_state": "closed",
+        "to_state": "open",
+    }
+
+
+def test_breaker_registry_is_per_key_and_stable():
+    a = guard.breaker("a", threshold=5)
+    assert guard.breaker("a", threshold=9) is a  # settings fixed at creation
+    assert a.threshold == 5
+    assert guard.breaker("b") is not a
+    guard.reset_breakers()
+    assert guard.breaker("a") is not a
+
+
+# ------------------------------------------- executor wiring: degradation
+
+
+class _AddOne(Transformer):
+    def params(self):
+        return ()
+
+    def apply_dataset(self, ds):
+        return ds.with_array(ds.array + 1.0)
+
+
+class _Broken(Transformer):
+    """Deterministically-failing stage; counts apply attempts."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def params(self):
+        return None
+
+    def apply_dataset(self, ds):
+        self.calls += 1
+        raise OSError("broken stage")
+
+
+class _Const(Transformer):
+    def params(self):
+        return None
+
+    def apply_dataset(self, ds):
+        import jax.numpy as jnp
+
+        return ds.with_array(jnp.full_like(ds.array, 9.0))
+
+
+def test_optional_node_degrades_to_identity(tmp_path):
+    led = ledger.start_run(str(tmp_path))
+    t = _Broken()
+    t.optional = True
+    lazy = Pipeline.of(t)(Dataset(np.full((4, 2), 7.0, np.float32)))
+    out = GraphExecutor(lazy.graph, node_retries=1).execute(lazy.graph.sinks[0])
+    ledger.stop_run()
+    np.testing.assert_allclose(np.asarray(out.dataset.array), 7.0)
+    assert t.calls == 2  # the retry budget really was spent first
+    evs = _ledger_events(led.path)
+    deg = [e for e in evs if e.get("name") == "degraded"]
+    assert deg and deg[0]["attrs"]["substitute"] == "Identity"
+    assert deg[0]["attrs"]["reason"] == "budget_exhausted"
+
+
+def test_with_fallback_substitutes_and_original_untouched():
+    t = _Broken()
+    fb = t.with_fallback(_Const())
+    assert t.fallback is None  # with_fallback returns a copy
+    lazy = Pipeline.of(fb)(Dataset(np.ones((4, 2), np.float32)))
+    out = GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    np.testing.assert_allclose(np.asarray(out.dataset.array), 9.0)
+    assert metrics.REGISTRY.counter_value("executor.degraded", node="_Broken") >= 1
+
+
+def test_mandatory_node_failure_still_propagates():
+    t = _Broken()
+    lazy = Pipeline.of(t)(Dataset(np.ones((4, 2), np.float32)))
+    with pytest.raises(OSError, match="broken stage"):
+        GraphExecutor(lazy.graph, node_retries=1).execute(lazy.graph.sinks[0])
+    assert t.calls == 2
+
+
+def test_degradation_declarations_block_stage_fusion():
+    """An optional/fallback stage fused into a chain would lose its
+    per-stage degradation contract — the fusion rule must skip it."""
+    from keystone_tpu.workflow.optimizer import _fusable
+    from keystone_tpu.workflow.graph import TransformerOperator
+
+    assert _fusable(TransformerOperator(_AddOne()))
+    opt = _AddOne()
+    opt.optional = True
+    assert not _fusable(TransformerOperator(opt))
+    assert not _fusable(TransformerOperator(_AddOne().with_fallback(_Const())))
+
+
+def test_degradation_declarations_split_cse_signature():
+    plain = _AddOne()
+    optional = _AddOne()
+    optional.optional = True
+    assert plain.signature() != optional.signature()
+    assert plain.signature() != _AddOne().with_fallback(_Const()).signature()
+
+
+# --------------------------------------------- executor wiring: breakers
+
+
+def test_breaker_open_short_circuits_next_run(monkeypatch):
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "1")
+    t = _Broken()
+    lazy = Pipeline.of(t)(Dataset(np.ones((4, 2), np.float32)))
+    with pytest.raises(OSError):
+        GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    assert t.calls == 1
+    # breaker is now open for this node label: the next run is REFUSED
+    # without calling the transformer again
+    with pytest.raises(guard.CircuitOpenError):
+        GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    assert t.calls == 1
+
+
+def test_breaker_open_degrades_optional_node(monkeypatch):
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "1")
+    t = _Broken()
+    t.optional = True
+    lazy = Pipeline.of(t)(Dataset(np.full((4, 2), 3.0, np.float32)))
+    out1 = GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    np.testing.assert_allclose(np.asarray(out1.dataset.array), 3.0)
+    assert t.calls == 1
+    out2 = GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    np.testing.assert_allclose(np.asarray(out2.dataset.array), 3.0)
+    assert t.calls == 1  # second run never attempted the broken stage
+    assert metrics.REGISTRY.counter_total("breaker.opens") >= 1
+
+
+def test_breaker_keys_are_per_node_not_per_label(monkeypatch):
+    """One flaky node must not open the breaker of a healthy twin with
+    the same label: signatureless same-class nodes get per-node keys."""
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "1")
+    bad, good = _Broken(), _Broken()
+    lazy_bad = Pipeline.of(bad)(Dataset(np.ones((4, 2), np.float32)))
+    lazy_good = Pipeline.of(good)(Dataset(np.ones((4, 2), np.float32)))
+    with pytest.raises(OSError):
+        GraphExecutor(lazy_bad.graph, node_retries=0).execute(
+            lazy_bad.graph.sinks[0]
+        )
+    # the OTHER node (same class, same label) is still attempted — its
+    # own breaker is untouched.  It fails on its own merits, but with
+    # OSError (a real attempt), not CircuitOpenError (a refusal).
+    with pytest.raises(OSError):
+        GraphExecutor(lazy_good.graph, node_retries=0).execute(
+            lazy_good.graph.sinks[0]
+        )
+    assert good.calls == 1
+
+
+def test_breaker_opening_mid_retry_loop_stops_remaining_retries(monkeypatch):
+    """Once a failure opens the node's breaker, the remaining retry
+    budget must not be burned against it — that repeated cost is what
+    the breaker exists to stop paying."""
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "1")
+    t = _Broken()
+    lazy = Pipeline.of(t)(Dataset(np.ones((4, 2), np.float32)))
+    with pytest.raises(OSError, match="broken stage"):
+        GraphExecutor(lazy.graph, node_retries=5).execute(lazy.graph.sinks[0])
+    assert t.calls == 1  # threshold=1: first failure opened it, no retries
+
+
+def test_breakers_disabled_by_default_no_registry_entries():
+    guard.reset_breakers()
+    t = _AddOne()
+    lazy = Pipeline.of(t)(Dataset(np.ones((4, 2), np.float32)))
+    GraphExecutor(lazy.graph).execute(lazy.graph.sinks[0])
+    assert not guard._BREAKERS  # no KEYSTONE_BREAKER_THRESHOLD -> no lookups
+
+
+# ------------------------------------------------- fit/apply deadline API
+
+
+def test_fit_deadline_bitmatches_undeadlined_fit(monkeypatch):
+    """A generous budget changes nothing: same bits as a plain fit, and
+    the per-stage env knob alone leaves the solver output untouched —
+    the deadline layer is host-side only (no traced-program effect)."""
+    from keystone_tpu.models import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = rng.normal(size=(64, 2)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=2, lam=1e-3)
+    ref = est.with_data(Dataset(x), Dataset(y)).fit()(Dataset(x)).get().numpy()
+
+    got = (
+        est.with_data(Dataset(x), Dataset(y))
+        .fit(deadline=300.0)(Dataset(x))
+        .get(deadline=300.0)
+        .numpy()
+    )
+    np.testing.assert_array_equal(ref, got)
+
+    monkeypatch.setenv(guard.ENV_STAGE_DEADLINE, "300")
+    env_got = est.with_data(Dataset(x), Dataset(y)).fit()(Dataset(x)).get().numpy()
+    np.testing.assert_array_equal(ref, env_got)
+
+
+def test_solver_program_hlo_identical_under_stage_deadline(monkeypatch):
+    """The acceptance pin: with or without a configured deadline the
+    traced solver program is byte-identical — the watchdog lives
+    entirely outside jit."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.models.block_ls import _bcd_epoch_body
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)), jnp.float32)
+    y = jnp.ones((16, 2), jnp.float32)
+    w = jnp.zeros((2, 8, 2), jnp.float32)
+    p = jnp.zeros((16, 2), jnp.float32)
+
+    def step(xb, y, w, p):
+        return _bcd_epoch_body(xb, y, jnp.float32(16.0), 1e-3, (w, p))
+
+    monkeypatch.delenv(guard.ENV_STAGE_DEADLINE, raising=False)
+    plain = jax.jit(step).lower(x, y, w, p).as_text()
+    monkeypatch.setenv(guard.ENV_STAGE_DEADLINE, "0.001")
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "1")
+    guarded = jax.jit(step).lower(x, y, w, p).as_text()
+    assert plain == guarded
+
+
+def test_blown_pipeline_budget_fails_in_bounded_time():
+    """An expired executor-wide budget must fail fast even with a retry
+    budget configured: further attempts are born expired, so the loop
+    must not burn node_retries × backoff sleeps per remaining node."""
+    t = _AddOne()
+    lazy = Pipeline.of(t)(Dataset(np.ones((4, 2), np.float32)))
+    ex = GraphExecutor(lazy.graph, node_retries=3, deadline=guard.Deadline.after(-1.0))
+    before = metrics.REGISTRY.counter_value("executor.stage_retries")
+    t0 = time.perf_counter()
+    with pytest.raises(guard.DeadlineExceeded):
+        ex.execute(lazy.graph.sinks[0])
+    assert time.perf_counter() - t0 < 1.0  # no backoff sleeps
+    assert metrics.REGISTRY.counter_value("executor.stage_retries") == before
+
+
+def test_stage_span_parenting_survives_watchdog_thread(monkeypatch, tmp_path):
+    """With a deadline configured the stage body runs on the watchdog
+    worker thread; ledger events it emits must still nest under the
+    executor.stage span (the span stack is thread-local and is carried
+    into the worker by run_with_deadline)."""
+    monkeypatch.setenv(guard.ENV_STAGE_DEADLINE, "60")
+
+    class Emitting(Transformer):
+        def params(self):
+            return None
+
+        def apply_dataset(self, ds):
+            ledger.event("inner.probe")
+            return ds
+
+    led = ledger.start_run(str(tmp_path))
+    lazy = Pipeline.of(Emitting())(Dataset(np.ones((4, 2), np.float32)))
+    GraphExecutor(lazy.graph, node_retries=0).execute(lazy.graph.sinks[0])
+    ledger.stop_run()
+    evs = _ledger_events(led.path)
+    probe = [e for e in evs if e.get("name") == "inner.probe"]
+    stage_spans = {
+        e["span"]: (e.get("attrs") or {}).get("node")
+        for e in evs
+        if e.get("kind") == "span_start" and e.get("name") == "executor.stage"
+    }
+    assert probe and probe[0].get("parent") in stage_spans
+    assert stage_spans[probe[0]["parent"]] == "Emitting"
+
+
+# ------------------------------------------------ stream fetch timeouts
+
+
+class _HangSource:
+    """Batch-resumable source whose ``bad`` batch hangs (cancel-aware)."""
+
+    def __init__(self, n, bad, hang_for=30.0):
+        self.n, self.bad, self.hang_for = n, bad, hang_for
+        self.hangs = 0
+
+    def __call__(self):
+        outer = self
+
+        class It:
+            def __init__(self):
+                self.i = 0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if self.i >= outer.n:
+                    raise StopIteration
+                i = self.i
+                self.i += 1
+                if i == outer.bad:
+                    outer.hangs += 1
+                    guard.interruptible_sleep(outer.hang_for)
+                return np.full((4, 2), i, np.float32)
+
+        return It()
+
+
+def test_resilient_timeout_retries_then_drops_hung_batch():
+    from keystone_tpu.loaders.stream import resilient
+
+    src = _HangSource(5, bad=2)
+    out = list(
+        resilient(
+            src, retries=1, max_bad_batches=1, base_delay=0.0, timeout=0.2
+        )()
+    )
+    assert [int(b[0, 0]) for b in out] == [0, 1, 3, 4]
+    assert src.hangs == 2  # first attempt + one retry, both timed out
+
+
+def test_resilient_timeout_zero_quota_propagates():
+    from keystone_tpu.loaders.stream import resilient
+
+    with pytest.raises(guard.DeadlineExceeded):
+        list(
+            resilient(
+                _HangSource(5, bad=1), retries=1, base_delay=0.0, timeout=0.2
+            )()
+        )
+
+
+def test_stream_dataset_timeout_plumbs_through():
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    src = _HangSource(4, bad=1)
+    ds = StreamDataset(src, n=16, retries=1, max_bad_batches=1, timeout=0.2)
+    rows = sum(np.asarray(b).shape[0] for b, _m in ds.device_batches())
+    assert rows == 12  # one 4-row batch dropped against the quota
+
+
+def test_resilient_timeout_generator_source_transient_hang():
+    """A timed-out fetch abandons a GENERATOR iterator mid-next(); the
+    replay must use a fresh iterator — pulling more from the occupied
+    one would raise 'generator already executing' against the next
+    healthy batch."""
+    from keystone_tpu.loaders.stream import resilient
+
+    hangs = {"n": 0}
+
+    def source():
+        def it():
+            for i in range(5):
+                if i == 2 and hangs["n"] < 1:
+                    hangs["n"] += 1
+                    guard.interruptible_sleep(30.0)
+                yield np.full((4, 2), i, np.float32)
+
+        return it()
+
+    out = list(resilient(source, retries=2, base_delay=0.0, timeout=0.2)())
+    assert [int(b[0, 0]) for b in out] == [0, 1, 2, 3, 4]
+    assert hangs["n"] == 1
+
+
+def test_resilient_timeout_permanent_hang_fails_bounded():
+    """A NON-cooperative batch (plain time.sleep — the worker never
+    vacates the iterator) that hangs on every replay cannot be skipped
+    on a generator source; the stall bound converts what would be an
+    infinite timeout-per-cycle spin into a loud, bounded failure."""
+    from keystone_tpu.loaders.stream import resilient
+
+    def source():
+        def it():
+            for i in range(5):
+                if i == 2:
+                    time.sleep(30.0)
+                yield np.full((4, 2), i, np.float32)
+
+        return it()
+
+    t0 = time.perf_counter()
+    with pytest.raises(guard.DeadlineExceeded):
+        list(
+            resilient(
+                source,
+                retries=1,
+                max_bad_batches=1,
+                base_delay=0.0,
+                timeout=0.2,
+            )()
+        )
+    assert time.perf_counter() - t0 < 10.0  # bounded, not a spin
+
+
+def test_stall_guard_exempts_transient_raises_across_batches():
+    """The stall bound targets un-skippable HANGS only: alternating
+    raise-y transient failures across different replay batches stay on
+    the documented per-batch budget and must complete."""
+    from collections import defaultdict
+
+    from keystone_tpu.loaders.stream import resilient
+
+    counts = defaultdict(int)
+
+    class It:
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.i >= 6:
+                raise StopIteration
+            i = self.i
+            self.i += 1
+            counts[i] += 1
+            # batches 1 and 3 alternate transient failures over several
+            # replay cycles — zero progress between restarts, but each
+            # batch stays within its own retry budget
+            if i == 1 and counts[1] in (2, 4):
+                raise OSError(f"transient at 1 (visit {counts[1]})")
+            if i == 3 and counts[3] in (1, 3):
+                raise OSError(f"transient at 3 (visit {counts[3]})")
+            return np.full((2, 2), i, np.float32)
+
+    out = list(resilient(It, retries=2, base_delay=0.0, timeout=30.0)())
+    assert [int(b[0, 0]) for b in out] == [0, 1, 2, 3, 4, 5]
+
+
+def test_resilient_no_timeout_stays_same_thread():
+    """timeout=None keeps fetches on the calling thread (inert path)."""
+    from keystone_tpu.loaders.stream import resilient
+
+    threads = []
+
+    def source():
+        def it():
+            threads.append(threading.current_thread())
+            yield np.zeros((1, 1), np.float32)
+
+        return it()
+
+    list(resilient(source, retries=0)())
+    assert threads == [threading.current_thread()]
+
+
+# --------------------------------------------------- latency fault plans
+
+
+def test_delay_action_stalls_then_proceeds():
+    t0 = time.perf_counter()
+    with faults.inject("stream.batch:times=1:delay=0.15"):
+        faults.fault_point("stream.batch")
+        faults.fault_point("stream.batch")  # spec exhausted: no stall
+    assert 0.15 <= time.perf_counter() - t0 < 2.0
+
+
+def test_latency_actions_valid_at_every_site():
+    for site in sorted(faults.SITES):
+        plan = faults.parse_plan(f"{site}:delay=0.01;{site}:hang")
+        assert {s.action for s in plan.specs} == {"delay", "hang"}
+
+
+def test_bare_delay_token_rejected():
+    with pytest.raises(faults.FaultPlanError, match="delay needs seconds"):
+        faults.parse_plan("stream.batch:delay")
+
+
+@pytest.mark.chaos
+def test_chaos_hang_at_executor_stage_survives_deadline_plus_retry(
+    monkeypatch, tmp_path
+):
+    """A hung stage under a per-stage deadline is retried like a raised
+    fault and the run completes."""
+    monkeypatch.setenv(guard.ENV_STAGE_DEADLINE, "0.3")
+    led = ledger.start_run(str(tmp_path))
+    lazy = Pipeline.of(_AddOne())(Dataset(np.ones((4, 2), np.float32)))
+    with faults.inject("executor.stage:times=1:hang"):
+        ex = GraphExecutor(lazy.graph, node_retries=1)
+        out = ex.execute(lazy.graph.sinks[0])
+    ledger.stop_run()
+    np.testing.assert_allclose(np.asarray(out.dataset.array), 2.0)
+    evs = _ledger_events(led.path)
+    assert any(e.get("name") == "deadline_exceeded" for e in evs)
+    assert any(e.get("name") == "executor.retry" for e in evs)
+
+
+@pytest.mark.chaos
+def test_chaos_delay_at_stream_batch_survives_timeout(monkeypatch):
+    """An injected per-batch delay longer than the fetch timeout is
+    converted to DeadlineExceeded and absorbed by the stream retry
+    budget — the consumer sees every row."""
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    monkeypatch.setenv(faults.ENV_VAR, "stream.batch:after=1:times=1:delay=5")
+    ds = StreamDataset(batched(x, 8), n=16, retries=2, timeout=0.3)
+    rows = np.concatenate([np.asarray(b) for b, _m in ds.device_batches()])
+    np.testing.assert_array_equal(rows, x)
+
+
+@pytest.mark.chaos
+@pytest.mark.hangs
+def test_acceptance_hang_and_delay_complete_under_deadline(
+    monkeypatch, tmp_path
+):
+    """The PR's acceptance scenario: one plan injects ``hang`` at
+    executor.stage (repeatedly — enough to open the stage's breaker)
+    and ``delay`` at stream.batch; with a stage deadline, stage retries,
+    a stream fetch timeout, and an optional featurizer stage, the
+    pipeline completes and the ledger holds all three event kinds:
+    ``deadline_exceeded``, ``breaker.transition``, and ``degraded``."""
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    monkeypatch.setenv(guard.ENV_STAGE_DEADLINE, "0.3")
+    monkeypatch.setenv(guard.ENV_BREAKER_THRESHOLD, "2")
+    x = np.ones((16, 4), np.float32)
+
+    led = ledger.start_run(str(tmp_path))
+    # after=1 skips the (non-degradable) Dataset source node: both hangs
+    # land on the optional _AddOne stage — attempt + retry — which opens
+    # its breaker (threshold 2) and then degrades
+    plan = "executor.stage:after=1:times=2:hang;stream.batch:times=1:delay=0.05"
+    with faults.inject(plan):
+        # the delayed (but sub-timeout) stream still yields every row
+        ds = StreamDataset(batched(x, 8), n=16, retries=2, timeout=2.0)
+        rows = np.concatenate([np.asarray(b) for b, _m in ds.device_batches()])
+
+        # the hung stage: retries spend the injected hangs, the breaker
+        # opens after 2 consecutive deadline overruns, and the optional
+        # declaration degrades the stage instead of failing the run
+        t = _AddOne()
+        t.optional = True
+        lazy = Pipeline.of(t)(Dataset(np.full((4, 2), 5.0, np.float32)))
+        ex = GraphExecutor(lazy.graph, node_retries=1)
+        out = ex.execute(lazy.graph.sinks[0])
+    ledger.stop_run()
+
+    np.testing.assert_array_equal(rows, x)
+    # degraded to identity: the input passes through unchanged
+    np.testing.assert_allclose(np.asarray(out.dataset.array), 5.0)
+
+    names = {e.get("name") for e in _ledger_events(led.path)}
+    assert "deadline_exceeded" in names
+    assert "breaker.transition" in names
+    assert "degraded" in names
+
+
+# ------------------------------------------------- multihost health/init
+
+
+def test_health_barrier_single_process_inert():
+    from keystone_tpu.parallel import multihost
+
+    t0 = time.perf_counter()
+    assert multihost.health_barrier(timeout=0.1) is True
+    assert multihost.maybe_health_barrier("t") is True
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_transient_init_error_classifier():
+    from keystone_tpu.parallel.multihost import _transient_init_error
+
+    assert _transient_init_error(OSError("disk"))
+    assert _transient_init_error(ConnectionError("nope"))
+    assert _transient_init_error(
+        RuntimeError("failed to connect to coordinator: UNAVAILABLE")
+    )
+    assert _transient_init_error(RuntimeError("Barrier timed out"))
+    assert not _transient_init_error(
+        RuntimeError("Number of processes 4 does not match num_processes 2")
+    )
+    assert not _transient_init_error(RuntimeError("process_id out of range"))

@@ -111,3 +111,62 @@ def test_heterogeneous_docs_fall_back_to_python_path():
     docs = Dataset([{"a": 1.0}, {"b": 2.0}])
     model = CommonSparseFeatures(4).fit_dataset(docs)
     assert set(model.vocab) == {"a", "b"}
+
+
+def test_multihost_init_deterministic_error_fails_fast(monkeypatch):
+    """The init retry loop used to retry on bare RuntimeError, so a
+    deterministic config error (e.g. mismatched num_processes) burned
+    the full backoff budget before surfacing.  It must fail on the
+    FIRST attempt; connection-shaped RuntimeErrors keep their retries."""
+    import jax
+
+    from keystone_tpu.parallel import multihost
+
+    calls = {"n": 0}
+
+    def die(**kwargs):
+        calls["n"] += 1
+        raise RuntimeError(
+            "Number of processes 4 does not match num_processes 2"
+        )
+
+    monkeypatch.setattr(jax.distributed, "initialize", die)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    with pytest.raises(RuntimeError, match="does not match num_processes"):
+        multihost.initialize(
+            coordinator_address="localhost:1",
+            num_processes=2,
+            process_id=0,
+            retries=3,
+        )
+    assert calls["n"] == 1  # fail-fast: no backoff budget burned
+
+
+def test_multihost_init_connection_error_still_retried(monkeypatch):
+    """The other direction: a coordinator race (connection-shaped
+    RuntimeError) must still consume the retry budget."""
+    import jax
+
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.utils import durable
+
+    calls = {"n": 0}
+
+    def flaky(**kwargs):
+        calls["n"] += 1
+        raise RuntimeError("failed to connect to coordinator: UNAVAILABLE")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    # no real sleeping inside the regression suite: zero-length backoff
+    monkeypatch.setattr(
+        durable, "backoff_delays", lambda *a, **k: iter([0.0] * 8)
+    )
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        multihost.initialize(
+            coordinator_address="localhost:1",
+            num_processes=2,
+            process_id=0,
+            retries=2,
+        )
+    assert calls["n"] == 3  # initial attempt + both retries

@@ -20,9 +20,19 @@ block coordinate descent), ``ooc`` (out-of-core streamed BCD — spills a
 FeatureBlockStore, exercising blockstore.*), ``lbfgs`` (chunk-
 checkpointed dense L-BFGS), ``stream`` (a resilient StreamDataset sweep).
 
+Latency plans (``delay=SECONDS`` / ``hang`` actions) are first-class:
+pair them with ``--stage-deadline`` / ``--stream-timeout`` (and
+``--stage-retries``) so the deadline/watchdog/breaker layer
+(``utils/guard.py``) converts injected stalls into retried or degraded
+operations, and the report's ``guard`` section shows deadline hits,
+breaker opens, and degraded nodes alongside the per-site fault counts.
+
 Exit code 0 = workload completed under the plan (all injected faults
 survived); 1 = the workload failed — the report's ``error`` names the
-escaping fault/exception.
+escaping fault/exception; 2 = the workload completed but a site named
+in the plan never injected (``not-exercised`` — a typo'd trigger or a
+workload that never reaches the site must not read as a green chaos
+run).
 """
 
 from __future__ import annotations
@@ -108,9 +118,17 @@ def _stream(tmp, restarts):
     from keystone_tpu.loaders.stream import batched
     from keystone_tpu.workflow.dataset import StreamDataset
 
+    from keystone_tpu.utils.guard import env_float
+
     rng = np.random.default_rng(0)
     x = rng.normal(size=(512, 8)).astype(np.float32)
-    ds = StreamDataset(batched(x, 32), n=512, retries=3)
+    ds = StreamDataset(
+        batched(x, 32),
+        n=512,
+        retries=3,
+        # env_float: "0" means disabled, same as every other guard knob
+        timeout=env_float("KEYSTONE_STREAM_TIMEOUT"),
+    )
     total = sum(np.asarray(b).shape[0] for b in ds.batches())
     if total != 512:
         raise RuntimeError(f"stream delivered {total}/512 rows")
@@ -154,7 +172,40 @@ def main(argv=None) -> int:
         "per-site counts from the unified metrics registry "
         "(render with tools/obs_report.py)",
     )
+    ap.add_argument(
+        "--stage-deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-stage watchdog budget for the workload "
+        "(KEYSTONE_STAGE_DEADLINE): a hang injected at executor.stage "
+        "becomes a retried/degraded stage instead of a stalled run",
+    )
+    ap.add_argument(
+        "--stage-retries",
+        type=int,
+        default=None,
+        metavar="N",
+        help="stage retry budget (KEYSTONE_STAGE_RETRIES) — the budget "
+        "deadline overruns are retried from",
+    )
+    ap.add_argument(
+        "--stream-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-batch fetch watchdog for the 'stream' workload "
+        "(KEYSTONE_STREAM_TIMEOUT): a hung source counts against the "
+        "retry/bad-batch quota instead of blocking the iterator",
+    )
     args = ap.parse_args(argv)
+
+    if args.stage_deadline is not None:
+        os.environ["KEYSTONE_STAGE_DEADLINE"] = str(args.stage_deadline)
+    if args.stage_retries is not None:
+        os.environ["KEYSTONE_STAGE_RETRIES"] = str(args.stage_retries)
+    if args.stream_timeout is not None:
+        os.environ["KEYSTONE_STREAM_TIMEOUT"] = str(args.stream_timeout)
 
     import tempfile
 
@@ -214,29 +265,77 @@ def main(argv=None) -> int:
             if repr(site) in error:
                 escaped_site = site
 
+    # every site the plan NAMES must appear in the report, even with
+    # zero calls — a typo'd trigger (after=100 on a 5-call site) or a
+    # workload that never reaches the site otherwise vanishes entirely
+    # and the run reads green
+    planned = {s.site for s in plan.specs}
+    for site in planned:
+        stats.setdefault(site, {"calls": 0, "injected": 0})
+
     def survived(site, counts):
         # only claim survival when it is attributable: a clean run
-        # survived everything; an escaped FaultInjected pins one site;
-        # any other failure (e.g. a downstream CorruptStateError from a
-        # corrupt action) leaves per-site survival unknown -> null
+        # survived everything it was actually GIVEN; a planned site
+        # that never injected is "not-exercised", not survived; an
+        # escaped FaultInjected pins one site; any other failure (e.g.
+        # a downstream CorruptStateError from a corrupt action) leaves
+        # per-site survival unknown -> null
+        if counts["injected"] == 0:
+            return None
         if error is None:
             return counts["injected"]
         if site == escaped_site:
             return counts["injected"] - 1
         return None
 
+    def verdict(site, counts):
+        if counts["injected"] == 0:
+            return "not-exercised" if site in planned else "no-injections"
+        if error is None:
+            return "survived"
+        if site == escaped_site:
+            return "escaped"
+        return "unknown"
+
+    not_exercised = sorted(
+        site
+        for site in planned
+        if stats.get(site, {}).get("injected", 0) == 0
+    )
+
+    def _labeled(name, label):
+        """{label_value: total} for one counter family in the snapshot."""
+        out = {}
+        prefix = name + "{" + label + "="
+        for key, v in (snap.get("counters") or {}).items():
+            if key == name:
+                out[""] = out.get("", 0) + int(v)
+            elif key.startswith(prefix) and key.endswith("}"):
+                out[key[len(prefix) : -1]] = int(v)
+        return out
+
     report = {
         "plan": args.plan,
         "workload": args.workload,
         "completed": error is None,
         "error": error,
+        "not_exercised": not_exercised,
         "sites": {
             site: {
                 "calls": counts["calls"],
                 "injected": counts["injected"],
                 "survived": survived(site, counts),
+                "verdict": verdict(site, counts),
             }
             for site, counts in sorted(stats.items())
+        },
+        # the deadline/watchdog/breaker layer's outcomes (utils/guard.py)
+        # — how injected latency was absorbed, from the same registry
+        # the per-site counts come from
+        "guard": {
+            "deadline_exceeded": _labeled("guard.deadline_exceeded", "site"),
+            "breaker_opens": _labeled("breaker.opens", "key"),
+            "degraded": _labeled("executor.degraded", "node"),
         },
     }
     if led is not None:
@@ -250,7 +349,11 @@ def main(argv=None) -> int:
         report["ledger"] = led.path
         obs_ledger.stop_run()
     print(json.dumps(report, indent=2))
-    return 0 if error is None else 1
+    if error is not None:
+        return 1
+    # completed, but a named site never fired: the plan did not test
+    # what it claims to test — fail the run so CI catches the typo
+    return 2 if not_exercised else 0
 
 
 if __name__ == "__main__":
