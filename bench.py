@@ -118,6 +118,21 @@ SCALE_LEGS = int(os.environ.get("BENCH_SCALE_LEGS", "2"))
 # runs so the artifact shape is identical everywhere.
 PRECISION_MODES = ("f32", "auto", "bf16_apply")
 PRECISION_LEGS = int(os.environ.get("BENCH_PRECISION_LEGS", "1"))
+
+# --- serve leg (ISSUE 5): the online-serving subsystem under overload
+# (tools/serve_bench.py open-loop generator, offered QPS > capacity via
+# a serve.batch delay plan emulating a heavier model).  The numbers the
+# round artifact tracks: achieved QPS, p50/p99 latency, mean batch
+# occupancy (>1 = micro-batching is amortizing program launches), shed
+# rate (excess load counted, not queued unboundedly), deadline misses
+# (0 = every completed request beat its deadline).
+SERVE_LEGS = int(os.environ.get("BENCH_SERVE_LEGS", "1"))
+SERVE_QPS = 1500.0
+SERVE_DURATION_S = 2.0
+SERVE_MAX_BATCH = 16
+SERVE_QUEUE_BOUND = 64
+SERVE_DEADLINE_MS = 250.0
+SERVE_BATCH_DELAY_MS = 10.0
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -578,6 +593,28 @@ def main():
         print(json.dumps(out))
         return
 
+    if "--leg-serve" in sys.argv:
+        from tools import serve_bench
+
+        svc, item_shape = serve_bench.build_service(
+            max_batch=SERVE_MAX_BATCH,
+            queue_bound=SERVE_QUEUE_BOUND,
+            deadline_ms=SERVE_DEADLINE_MS,
+        )
+        try:
+            rep = serve_bench.run_bench(
+                svc,
+                item_shape,
+                qps=SERVE_QPS,
+                duration=SERVE_DURATION_S,
+                deadline_ms=SERVE_DEADLINE_MS,
+                batch_delay_ms=SERVE_BATCH_DELAY_MS,
+            )
+        finally:
+            svc.close()
+        print(json.dumps(rep))
+        return
+
     if "--leg-solver-scale" in sys.argv:
         print(json.dumps(measure_solver_at_scale()))
         return
@@ -668,6 +705,20 @@ def main():
         for lg in (
             subprocess_leg("--leg-fit-scale", required=("fit_seconds",))
             for _ in range(SCALE_LEGS)
+        )
+        if lg
+    ]
+
+    # serve leg (ISSUE 5): the online endpoint under deterministic
+    # overload — one process leg (the serving layer's numbers are
+    # scheduler-dominated, not device-clock-dominated)
+    serve_legs = [
+        lg
+        for lg in (
+            subprocess_leg(
+                "--leg-serve", required=("achieved_qps", "p50_ms")
+            )
+            for _ in range(SERVE_LEGS)
         )
         if lg
     ]
@@ -767,6 +818,17 @@ def main():
                 "epochs": ATSCALE_EPOCHS, "block": FIT_SOLVER_BLOCK,
             },
         }
+    if serve_legs:
+        # one leg's full report, medians over legs for the headline keys
+        sv = dict(serve_legs[0])
+        if len(serve_legs) > 1:
+            for key in ("achieved_qps", "p50_ms", "p95_ms", "p99_ms"):
+                vals = [
+                    float(lg[key]) for lg in serve_legs if lg.get(key) is not None
+                ]
+                if vals:
+                    sv[key] = round(float(np.median(vals)), 2)
+        out["serve"] = sv
     if fit_scale_legs:
         fss = [float(lg["fit_seconds"]) for lg in fit_scale_legs]
         out["fit_at_scale"] = {

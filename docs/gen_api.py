@@ -25,6 +25,7 @@ MODULES = [
     ("keystone_tpu.evaluation", "Evaluation"),
     ("keystone_tpu.utils", "Utils"),
     ("keystone_tpu.obs", "Observability"),
+    ("keystone_tpu.serve", "Serving"),
 ]
 
 

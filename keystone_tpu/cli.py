@@ -1,6 +1,7 @@
 """CLI dispatcher — the bin/run-pipeline.sh analogue.
 
     python -m keystone_tpu.cli <PipelineName> [pipeline flags...]
+    python -m keystone_tpu.cli serve --model model.pkl [serve flags...]
     python -m keystone_tpu.cli --list
 """
 
@@ -35,15 +36,107 @@ def _apply_platform_env() -> None:
         jax.config.update("jax_platforms", platform)
 
 
+def _serve_main(argv) -> int:
+    """``serve`` subcommand: load a saved fitted pipeline and expose it
+    over HTTP (POST /predict, GET /healthz, GET /metrics) through the
+    micro-batching service (keystone_tpu/serve)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.cli serve",
+        description="serve a saved fitted pipeline over HTTP with "
+        "dynamic micro-batching and admission control",
+    )
+    ap.add_argument(
+        "--model",
+        required=True,
+        help="path to a FittedPipeline saved via save()/fit_or_load()",
+    )
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=5.0,
+        help="flush the micro-batch when the oldest request has waited "
+        "this long (or when --max-batch requests are queued)",
+    )
+    ap.add_argument(
+        "--queue-bound",
+        type=int,
+        default=128,
+        help="admission control: reject (HTTP 429) past this queue depth",
+    )
+    ap.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        help="default per-request deadline; doomed requests are shed "
+        "(HTTP 504) instead of executed",
+    )
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--example-shape",
+        default=None,
+        metavar="D0[,D1,...]",
+        help="per-datum input shape (e.g. '24' or '3,32,32'): primes "
+        "every padding bucket's compiled program BEFORE serving, so no "
+        "request ever pays a trace+compile against its deadline.  "
+        "Without it the first request per bucket compiles in-band.",
+    )
+    args = ap.parse_args(argv)
+
+    from keystone_tpu.serve import HttpFrontend, serve
+    from keystone_tpu.workflow import FittedPipeline
+
+    fitted = FittedPipeline.load(args.model)
+    example = None
+    if args.example_shape:
+        import numpy as np
+
+        shape = tuple(int(d) for d in args.example_shape.split(","))
+        example = np.zeros(shape, np.float32)
+    svc = serve(
+        fitted,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_bound=args.queue_bound,
+        deadline_ms=args.deadline_ms,
+        example=example,
+    )
+    front = HttpFrontend(svc, host=args.host, port=args.port)
+    print(
+        f"serving {args.model} on http://{args.host}:{front.port} "
+        f"(max_batch={args.max_batch}, max_wait_ms={args.max_wait_ms}, "
+        f"queue_bound={args.queue_bound})",
+        flush=True,
+    )
+    try:
+        front.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down (draining in-flight requests)", flush=True)
+    finally:
+        front.server.server_close()
+        svc.close()
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
+        print("       python -m keystone_tpu.cli serve --model model.pkl [flags]")
         print("pipelines:")
         for name in _PIPELINE_MODULES:
             print(f"  {name}")
         return 0
     name, rest = argv[0], argv[1:]
+    if name == "serve":
+        _apply_platform_env()
+        from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        return _serve_main(rest)
     if name not in _PIPELINE_MODULES:
         print(f"unknown pipeline {name!r}; use --list", file=sys.stderr)
         return 2

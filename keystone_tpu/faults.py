@@ -17,6 +17,8 @@ Named **sites** are threaded through the codebase::
     stream.batch        loaders.stream.batched / resilient sources
     multihost.init      parallel.multihost.initialize
     executor.stage      GraphExecutor stage execution (inside retry scope)
+    serve.enqueue       serve.PipelineService.submit (admission path)
+    serve.batch         serve micro-batch flush (batcher worker thread)
 
 A **plan** activates faults at sites, either via the ``inject`` context
 manager (tests) or the ``KEYSTONE_FAULTS`` environment variable — the
@@ -73,6 +75,8 @@ SITES = {
     "stream.batch",
     "multihost.init",
     "executor.stage",
+    "serve.enqueue",
+    "serve.batch",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")

@@ -1,0 +1,28 @@
+"""Online serving: dynamic micro-batching pipeline endpoint with
+admission control (the Clipper-layer over frozen keystone_tpu
+pipelines; see ``serve/service.py`` for the design).
+
+Deliberately NOT imported by ``keystone_tpu/__init__`` — the offline
+library import path (and every traced program) is byte-identical
+whether or not a service exists in the process (pinned by
+tests/test_serve.py).
+"""
+
+from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
+from keystone_tpu.serve.service import (  # noqa: F401
+    Overloaded,
+    PipelineService,
+    ServiceClosed,
+    default_buckets,
+    serve,
+)
+
+__all__ = [
+    "HttpFrontend",
+    "Overloaded",
+    "PipelineService",
+    "ServiceClosed",
+    "default_buckets",
+    "serve",
+    "serve_http",
+]

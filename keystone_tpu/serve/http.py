@@ -1,0 +1,209 @@
+"""Stdlib-only HTTP front end for a :class:`PipelineService`.
+
+Endpoints (JSON unless noted):
+
+- ``POST /predict`` — body ``{"instances": [<datum>, ...]}`` (or
+  ``{"instance": <datum>}``), optional ``"deadline_ms"``.  Replies
+  ``{"predictions": [...]}``.  Status codes carry the admission/deadline
+  contract: **429** when admission control rejects (``Overloaded``,
+  with a ``Retry-After`` hint), **504** when the request was shed past
+  its deadline (``DeadlineExceeded``; a request that COMPLETES late
+  still answers 200 — the ``serve.deadline_miss`` counter records it),
+  **400** on malformed bodies, **503** on service shutdown.
+- ``GET /healthz`` — liveness + queue depth.
+- ``GET /metrics`` — the process metrics registry in Prometheus text
+  exposition format (``obs.metrics.to_prometheus_text``): queue depth,
+  batch occupancy, latency histograms, shed/rejected counters — the
+  whole registry, so serving metrics land next to everything else.
+
+``ThreadingHTTPServer`` (one thread per in-flight request) is the right
+shape here: handler threads block on their futures while the single
+batcher thread does the device work, which is exactly the micro-batching
+contract.  Bind ``port=0`` to get an ephemeral port (tests).
+
+Usage::
+
+    front = serve_http(svc, port=8000)   # started, background thread
+    ...
+    front.stop(); svc.close()
+
+or foreground (the CLI does this)::
+
+    HttpFrontend(svc, port=8000).serve_forever()
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.obs import metrics
+from keystone_tpu.serve.service import Overloaded, PipelineService, ServiceClosed
+from keystone_tpu.utils import guard
+
+logger = logging.getLogger(__name__)
+
+#: per-request result wait: generous — the service's own deadline/shed
+#: machinery is the real latency bound; this only stops a handler thread
+#: leaking forever if the service is killed under it
+_RESULT_TIMEOUT_S = 120.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # route access logs to logging (debug), not stderr
+    def log_message(self, fmt, *args):
+        logger.debug("http: " + fmt, *args)
+
+    @property
+    def service(self) -> PipelineService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def _send(self, code: int, payload, content_type="application/json", headers=()):
+        body = (
+            payload
+            if isinstance(payload, bytes)
+            else json.dumps(payload).encode("utf-8")
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            svc = self.service
+            self._send(
+                200,
+                {
+                    "status": "closed" if svc.closed else "ok",
+                    "queue_depth": svc.queue_depth,
+                    "queue_bound": svc.queue_bound,
+                    "max_batch": svc.max_batch,
+                    "buckets": list(svc.buckets),
+                },
+            )
+        elif self.path == "/metrics":
+            self._send(
+                200,
+                metrics.REGISTRY.to_prometheus_text().encode("utf-8"),
+                content_type="text/plain; version=0.0.4",
+            )
+        else:
+            self._send(404, {"error": f"no such path {self.path!r}"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._send(404, {"error": f"no such path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if "instances" in body:
+                instances = body["instances"]
+            elif "instance" in body:
+                instances = [body["instance"]]
+            else:
+                raise ValueError('body needs "instances" or "instance"')
+            arr = np.asarray(instances, dtype=np.float32)
+            deadline_ms = body.get("deadline_ms")
+            deadline = None if deadline_ms is None else float(deadline_ms) / 1000.0
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            futs = self.service.submit_many(arr, deadline=deadline)
+        except Overloaded as e:
+            self._send(429, {"error": str(e)}, headers=(("Retry-After", "1"),))
+            return
+        except ServiceClosed as e:
+            self._send(503, {"error": str(e)})
+            return
+        except TypeError as e:  # shape mismatch: the CLIENT's fault
+            self._send(400, {"error": f"bad request: {e}"})
+            return
+        except Exception as e:  # e.g. injected fault
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        try:
+            preds = [
+                np.asarray(f.result(timeout=_RESULT_TIMEOUT_S)).tolist()
+                for f in futs
+            ]
+        except guard.DeadlineExceeded as e:
+            self._send(504, {"error": str(e)})
+            return
+        except Exception as e:
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, {"predictions": preds})
+
+
+class HttpFrontend:
+    """A :class:`ThreadingHTTPServer` bound to a service.  ``start()``
+    runs it on a background thread (tests, embedding); ``serve_forever``
+    runs it on the caller's thread (the CLI).  ``port=0`` binds an
+    ephemeral port, readable from :attr:`port` after construction."""
+
+    def __init__(
+        self,
+        service: PipelineService,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+    ):
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self.server.service = service  # type: ignore[attr-defined]
+        self.server.daemon_threads = True
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> "HttpFrontend":
+        self._started = True
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._started = True
+        self.server.serve_forever()
+
+    def stop(self) -> None:
+        # shutdown() blocks on an event only serve_forever sets — on a
+        # never-started frontend it would wait forever; just close the
+        # socket in that case
+        if self._started:
+            self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def __enter__(self) -> "HttpFrontend":
+        if not self._started:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve_http(
+    service: PipelineService, host: str = "127.0.0.1", port: int = 8000
+) -> HttpFrontend:
+    """Stand up (and start) the HTTP front end for ``service`` on a
+    background thread; returns the :class:`HttpFrontend` (``.port`` for
+    ephemeral binds, ``.stop()`` to shut down)."""
+    return HttpFrontend(service, host=host, port=port).start()

@@ -1,0 +1,507 @@
+"""Online inference service: dynamic micro-batching over a frozen
+pipeline, with admission control and deadline-aware shedding.
+
+KeystoneML pipelines are trained once and then applied to a stream of
+requests; the reference served that stream through Velox/Spark batch
+jobs, and Clipper-style systems (Crankshaw et al., NSDI 2017) showed the
+serving win is a thin layer over the frozen model: micro-batch requests
+to saturate the accelerator, bound the queue so tail latency stays
+bounded, and shed work that cannot meet its deadline.  This module is
+that layer for ``keystone_tpu``:
+
+- **Frozen apply** — :class:`~keystone_tpu.workflow.FrozenApplier` runs
+  the whole-pipeline optimizer once at service construction; each flush
+  binds one padded batch to the pre-optimized graph.
+- **Padding buckets** — every flush is padded UP to a fixed bucket size
+  (``iter_row_chunks``, the same pad discipline as chunked offline
+  applies), so the set of compiled program shapes is finite and
+  cache-hot: a single-datum request rides the smallest bucket's batch
+  program instead of tracing a per-datum one.
+- **Dynamic micro-batching** — a background worker drains the bounded
+  FIFO queue, flushing when ``max_batch`` requests are waiting or the
+  oldest has waited ``max_wait_ms``, whichever first.
+- **Admission control** — ``submit`` past ``queue_bound`` raises
+  :class:`Overloaded` (backpressure to the caller); requests whose
+  :class:`~keystone_tpu.utils.guard.Deadline` would expire before the
+  batch completes (EWMA-predicted) are shed with
+  :class:`~keystone_tpu.utils.guard.DeadlineExceeded` instead of
+  wasting device time on an answer nobody is waiting for.
+- **Degradation** — when every rider carries a deadline, the batch's
+  LOOSEST one plumbs into the
+  :class:`~keystone_tpu.workflow.GraphExecutor`, so ``optional`` /
+  ``with_fallback`` stages degrade on the serve path exactly as they do
+  in fits (loosest, not tightest: one near-expiry straggler must never
+  deadline-fail a flush its co-riders could comfortably complete).
+
+Observability (``keystone_tpu.obs``): ``serve.queue_depth`` gauge,
+``serve.batch_rows``/``serve.batch_seconds``/``serve.latency_seconds``
+histograms, ``serve.submitted``/``completed``/``shed``/``rejected``/
+``batch_errors``/``deadline_miss`` counters, and one ``serve.batch``
+ledger span per flush.  Fault injection (``keystone_tpu.faults``):
+sites ``serve.enqueue`` (admission path) and ``serve.batch`` (worker
+flush) — chaos plans exercise overload and hang scenarios.
+
+Usage::
+
+    svc = serve(fitted, max_batch=32, max_wait_ms=5, queue_bound=256,
+                deadline_ms=100, example=x0)
+    fut = svc.submit(x)            # concurrent.futures.Future
+    y = fut.result()
+    svc.close()                    # drains in-flight requests
+
+The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
+``python -m keystone_tpu.cli serve``; the load generator is
+``tools/serve_bench.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from keystone_tpu.faults import fault_point
+from keystone_tpu.obs import ledger, metrics
+from keystone_tpu.utils import guard
+
+logger = logging.getLogger(__name__)
+
+#: EWMA smoothing for the per-batch latency predictor the shed decision
+#: uses: new = (1-ALPHA)*old + ALPHA*sample.  0.3 tracks load shifts
+#: within a few batches without letting one outlier batch (a compile, a
+#: GC pause) shed everything behind it.
+_EWMA_ALPHA = 0.3
+
+
+class Overloaded(RuntimeError):
+    """Admission control refused the request: the queue is at its bound.
+    Backpressure is the caller's signal to retry later or route away —
+    deliberately NOT an ``OSError``, so generic transient-I/O retry
+    loops don't hammer an already-overloaded service."""
+
+
+class ServiceClosed(RuntimeError):
+    """The service is shut down (or shutting down) and accepts no new
+    requests."""
+
+
+def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
+    """Power-of-two padding buckets up to (and including) ``max_batch``.
+    The smallest bucket bounds single-datum padding waste; the largest
+    equals ``max_batch`` so a full flush pads nothing."""
+    max_batch = max(1, int(max_batch))
+    b = min(int(min_bucket), max_batch)
+    out = []
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(sorted(set(out)))
+
+
+class _Request:
+    __slots__ = ("x", "deadline", "future", "t_submit")
+
+    def __init__(self, x, deadline: Optional[guard.Deadline]):
+        self.x = x
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class PipelineService:
+    """A frozen fitted pipeline behind a micro-batching request queue.
+
+    Construct via :func:`serve`.  ``submit``/``submit_many`` return
+    ``concurrent.futures.Future`` objects resolved by the background
+    batcher thread; ``close`` drains in-flight work.  Thread-safe: any
+    number of client threads may submit concurrently (the HTTP front
+    end's handler threads do)."""
+
+    def __init__(
+        self,
+        pipeline,
+        max_batch: int = 32,
+        max_wait_ms: float = 5.0,
+        queue_bound: int = 128,
+        buckets: Optional[Sequence[int]] = None,
+        deadline_ms: Optional[float] = None,
+        example=None,
+        degrade: bool = True,
+        name: str = "serve",
+    ):
+        from keystone_tpu.workflow.pipeline import FrozenApplier
+
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_bound < 1:
+            raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        self._applier = (
+            pipeline if isinstance(pipeline, FrozenApplier) else FrozenApplier(pipeline)
+        )
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
+        self.queue_bound = int(queue_bound)
+        self.buckets = (
+            tuple(sorted({int(b) for b in buckets}))
+            if buckets
+            else default_buckets(self.max_batch)
+        )
+        if self.buckets[-1] < self.max_batch:
+            # a flush larger than every bucket would have nowhere to pad
+            self.buckets = self.buckets + (self.max_batch,)
+        self.default_deadline_s = (
+            None if not deadline_ms else float(deadline_ms) / 1000.0
+        )
+        self._degrade = bool(degrade)
+        self.name = name
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closing = False
+        self._closed = False
+        self._ewma_batch_s = 0.0
+        #: admission-time shape/dtype contract, learned from ``example``
+        #: (or the first request): a mismatched request fails ITS submit,
+        #: never the whole batch it would have ridden in
+        self._item_shape: Optional[tuple] = None
+        self._dtype = None
+        if example is not None:
+            ex = np.asarray(example)
+            self._item_shape = tuple(ex.shape)
+            self._dtype = ex.dtype
+            self.prime()
+        self._worker = threading.Thread(
+            target=self._loop, daemon=True, name=f"{name}-batcher"
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------ priming
+    def prime(self) -> None:
+        """Compile (or cache-load) the apply programs at every bucket
+        shape NOW, so no request ever pays a trace+compile against its
+        deadline.  Requires the item shape (an ``example`` at
+        construction, or a first request already served)."""
+        if self._item_shape is None:
+            raise ValueError(
+                "prime() needs the request item shape; construct the "
+                "service with example=<one datum> (or serve a request first)"
+            )
+        for bucket in self.buckets:
+            zeros = np.zeros((bucket,) + self._item_shape, self._dtype)
+            self._apply_rows(zeros, deadline=None)
+
+    # ------------------------------------------------------------- submit
+    def submit(self, x, deadline=None) -> Future:
+        """Enqueue one datum; returns a Future resolving to its result
+        row (numpy).  ``deadline``: seconds or a ``guard.Deadline``
+        (default: the service's ``deadline_ms``).  Raises
+        :class:`Overloaded` when the queue is at bound and
+        :class:`ServiceClosed` after shutdown began."""
+        return self._submit_all([x], deadline)[0]
+
+    def submit_many(self, xs, deadline=None) -> list:
+        """Enqueue a sequence of datums; returns their Futures in order.
+        One shared deadline resolution (all requests of the call carry
+        the same absolute expiry) and ATOMIC admission: either every
+        datum is enqueued or none is — a partial enqueue would leave
+        orphaned requests executing for a caller that saw the error."""
+        return self._submit_all(list(xs), deadline)
+
+    def _submit_all(self, xs, deadline) -> list:
+        if not xs:
+            return []
+        if self._closing:
+            raise ServiceClosed(f"service {self.name!r} is closed")
+        dl = guard.as_deadline(
+            deadline if deadline is not None else self.default_deadline_s
+        )
+        for _ in xs:
+            fault_point("serve.enqueue")
+        arrs = [np.asarray(x) for x in xs]
+        with self._cond:
+            if self._closing:
+                raise ServiceClosed(f"service {self.name!r} is closed")
+            # the shape/dtype contract is learned and checked UNDER the
+            # lock: concurrent first requests must agree on one item
+            # shape, and a mismatched request must fail ITS OWN submit
+            # (before anything is enqueued), never the batch it would
+            # have ridden in.  Staged, committed only after admission:
+            # a rejected (or internally-inconsistent) call must not fix
+            # the contract for requests that were never served
+            item_shape, dtype = self._item_shape, self._dtype
+            for arr in arrs:
+                if item_shape is None:
+                    item_shape, dtype = tuple(arr.shape), arr.dtype
+                elif tuple(arr.shape) != item_shape:
+                    raise TypeError(
+                        f"request shape {tuple(arr.shape)} != service item "
+                        f"shape {item_shape}"
+                    )
+            if len(self._q) + len(arrs) > self.queue_bound:
+                metrics.inc("serve.rejected", len(arrs))
+                raise Overloaded(
+                    f"service {self.name!r} queue at bound "
+                    f"({self.queue_bound}); retry later"
+                )
+            self._item_shape, self._dtype = item_shape, dtype
+            reqs = [
+                _Request(
+                    a if a.dtype == dtype else a.astype(dtype), dl
+                )
+                for a in arrs
+            ]
+            self._q.extend(reqs)
+            # gauge set under the lock: written outside it, a stale
+            # pre-flush depth could overwrite the batcher's newer value
+            # and report a full queue on an idle service
+            metrics.set_gauge("serve.queue_depth", len(self._q))
+            self._cond.notify_all()
+        metrics.inc("serve.submitted", len(reqs))
+        return [r.future for r in reqs]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ----------------------------------------------------------- shutdown
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting requests and shut the batcher down.  With
+        ``drain=True`` (default) every already-queued request is flushed
+        and resolved before the worker exits; with ``drain=False``
+        queued requests fail with :class:`ServiceClosed`."""
+        with self._cond:
+            self._closing = True
+            if not drain:
+                while self._q:
+                    req = self._q.popleft()
+                    self._fail(
+                        req, ServiceClosed("service closed before execution")
+                    )
+                metrics.set_gauge("serve.queue_depth", 0)
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            logger.warning(
+                "service %r batcher did not exit within %.1fs", self.name, timeout
+            )
+            # the batcher is wedged (e.g. a hung apply with no deadline
+            # configured): it will never drain the queue, so fail the
+            # still-queued futures rather than leave their callers
+            # blocked forever
+            with self._cond:
+                while self._q:
+                    self._fail(
+                        self._q.popleft(),
+                        ServiceClosed(
+                            "service closed with the batcher wedged; "
+                            "request never executed"
+                        ),
+                    )
+                metrics.set_gauge("serve.queue_depth", 0)
+        self._closed = True
+
+    def __enter__(self) -> "PipelineService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- worker
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    def _next_batch(self):
+        """Block until a flush is due; pop and return it (None = shut
+        down with an empty queue).  Flush condition: ``max_batch``
+        requests waiting, the OLDEST has waited ``max_wait_s``, or the
+        service is closing (drain)."""
+        with self._cond:
+            while not self._q:
+                if self._closing:
+                    return None
+                # untimed: every producer path (submit, close) notifies
+                # under this condition, so an idle service costs zero
+                # wakeups
+                self._cond.wait()
+            flush_at = self._q[0].t_submit + self.max_wait_s
+            while len(self._q) < self.max_batch and not self._closing:
+                timeout = flush_at - time.monotonic()
+                if timeout <= 0:
+                    break
+                self._cond.wait(timeout)
+            k = min(len(self._q), self.max_batch)
+            batch = [self._q.popleft() for _ in range(k)]
+            metrics.set_gauge("serve.queue_depth", len(self._q))
+            return batch
+
+    @staticmethod
+    def _fail(req, exc) -> None:
+        """Deliver an exception to a request, tolerating a caller that
+        already cancelled its future — an InvalidStateError here would
+        kill the batcher thread and brick the whole service."""
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    def _run_batch(self, batch) -> None:
+        # shed what cannot make it: a request whose deadline expires
+        # before the batch's predicted completion would occupy a padded
+        # row and return an answer its caller already abandoned
+        predicted = self._ewma_batch_s
+        live = []
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                # the caller cancelled while the request was queued:
+                # don't spend a padded row on it (and, marked RUNNING,
+                # a surviving request can no longer be cancelled out
+                # from under the set_result below)
+                metrics.inc("serve.cancelled")
+                continue
+            if req.deadline is not None and req.deadline.remaining() <= predicted:
+                metrics.inc("serve.shed")
+                self._fail(
+                    req,
+                    guard.DeadlineExceeded(
+                        "serve.shed", time.monotonic() - req.t_submit
+                    ),
+                )
+            else:
+                live.append(req)
+        if not live:
+            # nothing executed, so no new latency sample — DECAY the
+            # predictor instead of leaving it frozen: one outlier batch
+            # (a cold compile on an unprimed service) would otherwise
+            # pin the EWMA above every deadline and shed 100% of
+            # traffic forever.  Decay-and-retry converges: predicted
+            # drops geometrically until a batch runs and real samples
+            # resume.
+            self._ewma_batch_s *= 1.0 - _EWMA_ALPHA
+            return
+        k = len(live)
+        t0 = time.monotonic()
+        try:
+            with ledger.span(
+                "serve.batch", rows=k, bucket=self._bucket_for(k)
+            ):
+                fault_point("serve.batch")
+                stacked = np.stack([req.x for req in live])
+                batch_deadline = None
+                if self._degrade:
+                    # the LOOSEST rider's deadline (and only when every
+                    # rider carries one): the executor budget exists to
+                    # stop stages NOBODY is still waiting on and to
+                    # trigger declared degradation under pressure —
+                    # keyed to min() instead, one near-expiry straggler
+                    # that escaped the shed predictor would
+                    # DeadlineExceeded the whole flush and fail
+                    # co-batched requests holding comfortable budgets
+                    dls = [r.deadline for r in live if r.deadline is not None]
+                    if dls and len(dls) == len(live):
+                        batch_deadline = max(dls, key=lambda d: d.at)
+                out = self._apply_rows(stacked, deadline=batch_deadline)
+        except BaseException as e:  # one bad batch must not kill the worker
+            metrics.inc("serve.batch_errors")
+            logger.warning(
+                "serve batch of %d failed: %s: %s", k, type(e).__name__, e
+            )
+            for req in live:
+                self._fail(req, e)
+            return
+        dt = time.monotonic() - t0
+        self._ewma_batch_s = (
+            dt
+            if not self._ewma_batch_s
+            else (1.0 - _EWMA_ALPHA) * self._ewma_batch_s + _EWMA_ALPHA * dt
+        )
+        metrics.inc("serve.batches")
+        metrics.observe("serve.batch_seconds", dt)
+        metrics.observe("serve.batch_rows", k)
+        done_t = time.monotonic()
+        for i, req in enumerate(live):
+            metrics.observe("serve.latency_seconds", done_t - req.t_submit)
+            if req.deadline is not None and req.deadline.expired():
+                # completed, but late: the shed predictor under-estimated
+                # (e.g. the first batch after a stall) — count it so the
+                # bench's "completed beat their deadlines" claim is honest
+                metrics.inc("serve.deadline_miss")
+            metrics.inc("serve.completed")
+            req.future.set_result(out[i])
+
+    # -------------------------------------------------------------- apply
+    def _bucket_for(self, k: int) -> int:
+        for b in self.buckets:
+            if b >= k:
+                return b
+        return self.buckets[-1]
+
+    def _apply_rows(self, stacked: np.ndarray, deadline=None) -> np.ndarray:
+        """Pad ``(k, ...)`` rows up to the smallest bucket >= k (the
+        ``iter_row_chunks`` pad discipline — zero pad rows, outputs
+        sliced back to k), apply the frozen graph, return host rows."""
+        from keystone_tpu.workflow.dataset import Dataset
+        from keystone_tpu.workflow.transformer import iter_row_chunks
+
+        k = stacked.shape[0]
+        bucket = self._bucket_for(k)
+        padded, _mask, _start = next(iter(iter_row_chunks(stacked, None, bucket)))
+        ds = Dataset(padded, n=k)
+        out = self._applier(ds, deadline=deadline)
+        return np.asarray(out.array)[:k]
+
+
+def serve(
+    pipeline,
+    *,
+    max_batch: int = 32,
+    max_wait_ms: float = 5.0,
+    queue_bound: int = 128,
+    buckets: Optional[Sequence[int]] = None,
+    deadline_ms: Optional[float] = None,
+    example=None,
+    degrade: bool = True,
+    name: str = "serve",
+) -> PipelineService:
+    """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
+
+    - ``max_batch`` / ``max_wait_ms`` — flush the micro-batch when either
+      bound is hit (count, or oldest-request age).
+    - ``queue_bound`` — admission control: ``submit`` past this depth
+      raises :class:`Overloaded`.
+    - ``buckets`` — padding-bucket batch sizes (default: powers of two
+      from 8 up to ``max_batch``); every flush pads to the smallest
+      bucket that fits, so compiled program shapes are finite.
+    - ``deadline_ms`` — default per-request deadline; requests predicted
+      to miss it are shed instead of executed.
+    - ``example`` — one datum, used to prime every bucket's compiled
+      program at construction (strongly recommended: without it the
+      first request per bucket pays the trace+compile).
+    - ``degrade`` — plumb the batch's loosest request deadline into the
+      executor so ``optional``/``with_fallback`` stages degrade on the
+      serve path (loosest so a single tight straggler cannot fail its
+      co-batched requests; applied only when every rider has one).
+    """
+    return PipelineService(
+        pipeline,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_bound=queue_bound,
+        buckets=buckets,
+        deadline_ms=deadline_ms,
+        example=example,
+        degrade=degrade,
+        name=name,
+    )

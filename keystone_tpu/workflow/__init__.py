@@ -49,6 +49,7 @@ from keystone_tpu.workflow.optimizer import (  # noqa: F401
 )
 from keystone_tpu.workflow.pipeline import (  # noqa: F401
     FittedPipeline,
+    FrozenApplier,
     Pipeline,
     PipelineDataset,
     PipelineDatum,

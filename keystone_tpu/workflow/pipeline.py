@@ -301,6 +301,15 @@ class Pipeline(Chainable):
         g = StageFusionRule().apply(g)
         return FittedPipeline(g, self.source, self.sink)
 
+    def freeze(self) -> "FrozenApplier":
+        """Freeze this pipeline for repeated online application: run the
+        whole-pipeline optimizer ONCE now, and return a
+        :class:`FrozenApplier` that binds each incoming batch to the
+        pre-optimized graph — the serving entry point
+        (``keystone_tpu.serve`` builds its micro-batching service on
+        this).  Requires an estimator-free pipeline (``fit()`` first)."""
+        return FrozenApplier(self)
+
     def to_dot(self, name: str = "pipeline", timings=None, retries=None) -> str:
         """Graphviz DOT of this pipeline's DAG (Pipeline.toDOT analogue).
         ``timings``/``retries`` overlay measured per-node seconds and
@@ -460,6 +469,62 @@ class FittedPipeline(Pipeline):
             with open(path, "wb") as f:
                 pickle.dump({"config": config, "pipeline": fitted}, f)
         return fitted, False
+
+
+class FrozenApplier:
+    """A fitted pipeline optimized once and applied many times — the
+    online-serving apply path (``keystone_tpu.serve``).
+
+    ``Pipeline(...)``/``PipelineDataset.get()`` re-run the whole-pipeline
+    optimizer on every application, which is the right trade for one
+    big offline batch and the wrong one for a stream of small requests:
+    the optimizer walk is pure host-side overhead once the graph is
+    fitted and frozen.  Freezing runs the optimizer ONCE over the
+    unbound graph; each call then binds the batch to the pre-optimized
+    graph (persistent graphs make the bind a cheap copy) and runs a
+    fresh :class:`GraphExecutor` walk over it.
+
+    Compiled-program reuse: the per-transformer jitted apply caches
+    (``workflow/transformer.py``) key on the SAME transformer instances
+    on every call, so as long as callers keep the input shape set finite
+    — the serve batcher's padding-bucket discipline
+    (:func:`~keystone_tpu.workflow.transformer.iter_row_chunks` pads
+    every flush up to a fixed bucket size) — every request after the
+    first per bucket runs entirely from cache-hot programs.
+
+    ``deadline`` per call plumbs into the executor exactly like
+    ``Pipeline.fit(deadline=…)``: stages run under apportioned
+    watchdogs, and ``optional``/``with_fallback`` nodes degrade instead
+    of failing the batch — graceful degradation applies on the serve
+    path too."""
+
+    def __init__(self, pipeline: "Pipeline"):
+        for op in pipeline.graph.operators.values():
+            if isinstance(op, G.EstimatorOperator):
+                raise TypeError(
+                    f"cannot freeze a pipeline with unfitted estimator "
+                    f"{op.label()!r}; call fit() first"
+                )
+        opt = PipelineEnv.get_optimizer()
+        self.graph = opt.execute(pipeline.graph)
+        self.source = pipeline.source
+        self.sink = pipeline.sink
+
+    def __call__(self, data, deadline=None) -> Dataset:
+        """Apply the frozen graph to one batch (a Dataset or batch-like
+        array); returns the result Dataset.  ``deadline``: wall-clock
+        budget for this batch, apportioned per stage by the executor."""
+        ds = as_dataset(data)
+        g, _ = self.graph.replace_source_with_node(
+            self.source, G.DatasetOperator(ds)
+        )
+        ex = GraphExecutor(g, deadline=deadline)
+        expr = ex.execute(g.sink_dependencies[self.sink])
+        if not isinstance(expr, DatasetExpr):
+            raise TypeError(
+                f"frozen apply produced {type(expr).__name__}, expected dataset"
+            )
+        return expr.dataset
 
 
 class PreflightOOMError(RuntimeError):

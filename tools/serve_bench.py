@@ -1,0 +1,282 @@
+"""Open-loop load generator for the online serving subsystem.
+
+Drives a :class:`keystone_tpu.serve.PipelineService` with a fixed
+arrival schedule — requests are submitted at the target QPS whether or
+not earlier ones completed (open loop: the honest way to measure a
+service, since closed-loop generators self-throttle and hide queueing
+collapse) — and reports latency percentiles, achieved throughput, mean
+batch occupancy, and the shed/rejected breakdown.
+
+Usage (CPU-safe; any laptop)::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py \
+        --qps 2000 --duration 3 --max-batch 32 --max-wait-ms 2 \
+        --deadline-ms 250 --queue-bound 128
+
+    # burst mode: arrivals in groups of N at the same mean rate
+    ... --burst 16
+
+    # emulate a heavier model: stall every flush via the serve.batch
+    # fault site (the chaos machinery doubles as a load shaper)
+    ... --batch-delay-ms 10
+
+    # serve a saved model instead of the synthetic default
+    ... --model fitted.pkl --dim 512
+
+The default workload is a small synthetic two-stage pipeline
+(NormalizeRows → LinearMapper) so the tool measures the serving layer
+itself; ``--model`` swaps in a real fitted pipeline whose input is a
+``--dim``-vector.  Exit code 0; the report is one JSON object on
+stdout.  ``bench.py --leg-serve`` embeds this report (overload config)
+in the round artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import wait as futures_wait
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_service(
+    dim: int = 64,
+    classes: int = 16,
+    max_batch: int = 32,
+    max_wait_ms: float = 2.0,
+    queue_bound: int = 128,
+    deadline_ms: float = 250.0,
+    model: str | None = None,
+    seed: int = 0,
+):
+    """A primed service over the synthetic two-stage pipeline (or a
+    saved fitted model); returns ``(service, item_shape)``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.serve import serve
+
+    if model:
+        from keystone_tpu.workflow import FittedPipeline
+
+        pipe = FittedPipeline.load(model)
+    else:
+        from keystone_tpu.models.linear import LinearMapper
+        from keystone_tpu.ops.stats import NormalizeRows
+        from keystone_tpu.workflow import Pipeline
+
+        rng = np.random.default_rng(seed)
+        w = jnp.asarray(rng.normal(size=(dim, classes)).astype(np.float32))
+        pipe = Pipeline.of(NormalizeRows()) | LinearMapper(w)
+    item_shape = (int(dim),)
+    svc = serve(
+        pipe,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_bound=queue_bound,
+        deadline_ms=deadline_ms,
+        example=np.zeros(item_shape, np.float32),
+        name="serve_bench",
+    )
+    return svc, item_shape
+
+
+def _hist_delta(before: dict, after: dict, name: str) -> tuple:
+    b = before.get(name) or {"count": 0, "sum": 0.0}
+    a = after.get(name) or {"count": 0, "sum": 0.0}
+    return a["count"] - b["count"], a["sum"] - b["sum"]
+
+
+def run_bench(
+    svc,
+    item_shape,
+    qps: float,
+    duration: float,
+    burst: int = 1,
+    deadline_ms: float | None = None,
+    batch_delay_ms: float = 0.0,
+) -> dict:
+    """Offer ``qps`` requests/sec for ``duration`` seconds (groups of
+    ``burst`` arrivals at the same mean rate), wait for the tail to
+    drain, and report.  ``batch_delay_ms`` > 0 stalls every flush via a
+    ``serve.batch:delay=…`` fault plan (emulating a heavier model, so a
+    laptop can exercise overload deterministically)."""
+    import contextlib
+
+    import numpy as np
+
+    from keystone_tpu import faults
+    from keystone_tpu.obs import metrics
+    from keystone_tpu.serve import Overloaded
+    from keystone_tpu.utils import guard
+
+    burst = max(1, int(burst))
+    deadline_s = None if not deadline_ms else float(deadline_ms) / 1000.0
+    snap0 = metrics.snapshot()
+    c0 = dict(snap0.get("counters") or {})
+
+    lock = threading.Lock()
+    latencies: list = []
+    outcomes = {"completed": 0, "shed": 0, "rejected": 0, "errors": 0}
+
+    def record(fut, t_submit):
+        t_done = time.monotonic()
+        exc = fut.exception()
+        with lock:
+            if exc is None:
+                outcomes["completed"] += 1
+                latencies.append(t_done - t_submit)
+            elif isinstance(exc, guard.DeadlineExceeded):
+                outcomes["shed"] += 1
+            else:
+                outcomes["errors"] += 1
+
+    rng = np.random.default_rng(1)
+    payload = rng.normal(size=(burst,) + tuple(item_shape)).astype(np.float32)
+    n_arrivals = max(1, int(round(qps * duration)))
+    interval = burst / qps
+    futs = []
+
+    plan = (
+        faults.inject(f"serve.batch:delay={batch_delay_ms / 1000.0}")
+        if batch_delay_ms > 0
+        else contextlib.nullcontext()
+    )
+    t_start = time.monotonic()
+    with plan:
+        next_t = t_start
+        sent = 0
+        while sent < n_arrivals:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.002))
+                continue
+            for b in range(burst):
+                if sent >= n_arrivals:
+                    break
+                t_submit = time.monotonic()
+                try:
+                    fut = svc.submit(payload[b], deadline=deadline_s)
+                except Overloaded:
+                    with lock:
+                        outcomes["rejected"] += 1
+                else:
+                    fut.add_done_callback(
+                        lambda f, t0=t_submit: record(f, t0)
+                    )
+                    futs.append(fut)
+                sent += 1
+            next_t += interval
+        # throughput denominator = the OFFER window: including the
+        # post-offer tail-drain below would bias achieved_qps low by
+        # queue_bound × batch-time per run, making round-over-round
+        # movement track drain length instead of serving capacity
+        offer_elapsed = time.monotonic() - t_start
+        # drain the tail: everything admitted resolves (completed or
+        # shed) — the report must account for every offered request
+        futures_wait(futs, timeout=duration + 30.0)
+    wall_elapsed = time.monotonic() - t_start
+
+    snap1 = metrics.snapshot()
+    c1 = dict(snap1.get("counters") or {})
+    rows_n, rows_sum = _hist_delta(
+        snap0.get("histograms") or {}, snap1.get("histograms") or {}, "serve.batch_rows"
+    )
+    lat_ms = sorted(x * 1000.0 for x in latencies)
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return round(float(np.percentile(lat_ms, p)), 2)
+
+    completed = outcomes["completed"]
+    report = {
+        "offered_qps": qps,
+        "duration_s": duration,
+        "burst": burst,
+        "deadline_ms": deadline_ms,
+        "batch_delay_ms": batch_delay_ms,
+        "n_requests": n_arrivals,
+        "completed": completed,
+        "shed": outcomes["shed"],
+        "rejected": outcomes["rejected"],
+        "errors": outcomes["errors"],
+        "achieved_qps": (
+            round(completed / offer_elapsed, 1) if offer_elapsed > 0 else None
+        ),
+        "drain_s": round(wall_elapsed - offer_elapsed, 3),
+        "p50_ms": pct(50),
+        "p95_ms": pct(95),
+        "p99_ms": pct(99),
+        "max_ms": round(lat_ms[-1], 2) if lat_ms else None,
+        "batches": rows_n,
+        "mean_batch_occupancy": round(rows_sum / rows_n, 2) if rows_n else None,
+        "shed_rate": round(
+            (outcomes["shed"] + outcomes["rejected"]) / n_arrivals, 4
+        ),
+        "deadline_miss": int(
+            c1.get("serve.deadline_miss", 0.0) - c0.get("serve.deadline_miss", 0.0)
+        ),
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop load generator for keystone_tpu.serve"
+    )
+    ap.add_argument("--qps", type=float, default=500.0, help="offered load")
+    ap.add_argument("--duration", type=float, default=3.0, help="seconds")
+    ap.add_argument(
+        "--burst", type=int, default=1, help="arrivals per group (same mean rate)"
+    )
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--queue-bound", type=int, default=128)
+    ap.add_argument("--deadline-ms", type=float, default=250.0)
+    ap.add_argument(
+        "--batch-delay-ms",
+        type=float,
+        default=0.0,
+        help="stall every flush this long via the serve.batch fault site "
+        "(emulates a heavier model; makes overload reproducible anywhere)",
+    )
+    ap.add_argument("--dim", type=int, default=64, help="request vector length")
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument(
+        "--model", default=None, help="serve this saved FittedPipeline instead"
+    )
+    args = ap.parse_args(argv)
+
+    svc, item_shape = build_service(
+        dim=args.dim,
+        classes=args.classes,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_bound=args.queue_bound,
+        deadline_ms=args.deadline_ms,
+        model=args.model,
+    )
+    try:
+        report = run_bench(
+            svc,
+            item_shape,
+            qps=args.qps,
+            duration=args.duration,
+            burst=args.burst,
+            deadline_ms=args.deadline_ms,
+            batch_delay_ms=args.batch_delay_ms,
+        )
+    finally:
+        svc.close()
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
