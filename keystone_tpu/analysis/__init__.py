@@ -1,0 +1,36 @@
+"""Pre-flight static analysis for workflow pipelines.
+
+The reference's headline property — statically type-safe pipelines —
+rebuilt for the jax port as an ahead-of-fit analyzer: abstract
+shape/dtype interpretation over the workflow graph (``jax.eval_shape``,
+no device work), a precision-policy lint over solver jaxprs, a
+robustness-configuration lint (fault plans, breakers, deadlines), and a
+CSE/cache-signature collision audit.  Typed findings; wired into
+``Pipeline.fit(validate=)`` / ``KEYSTONE_VALIDATE=1``,
+``Pipeline.freeze()``, and ``python -m keystone_tpu.cli check``.
+
+The repo-invariant AST linter (fault-site registration, metric naming,
+monotonic clocks under guard supervision, obs-hook gating) lives in
+``tools/lint.py`` and is enforced as a tier-1 test.
+"""
+
+from keystone_tpu.analysis.analyzer import (  # noqa: F401
+    ALL_PASSES,
+    DEFAULT_PASSES,
+    ENV_VALIDATE,
+    analyze,
+    validate_fit,
+    validate_freeze,
+    validation_enabled,
+)
+from keystone_tpu.analysis.bundled import BUNDLED, build_bundled  # noqa: F401
+from keystone_tpu.analysis.findings import (  # noqa: F401
+    AnalysisReport,
+    Finding,
+    PipelineValidationError,
+)
+from keystone_tpu.analysis.precision import (  # noqa: F401
+    MODES,
+    SOLVER_ENTRIES,
+    check_fn,
+)

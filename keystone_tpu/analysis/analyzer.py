@@ -1,0 +1,145 @@
+"""Pre-flight pipeline analyzer: orchestration and wiring helpers.
+
+:func:`analyze` walks a pipeline's graph with the requested passes and
+returns an :class:`~keystone_tpu.analysis.findings.AnalysisReport`:
+
+- ``shapes``     — abstract shape/dtype interpretation (pass a);
+- ``robustness`` — fault-plan / breaker / deadline configuration (c);
+- ``signatures`` — CSE / cache-signature collision audit (d);
+- ``precision``  — solver-jaxpr precision lint (b; graph-independent
+  and the only pass that traces solver code, so it is NOT in the
+  default set — ``cli.py check`` adds it).
+
+Entry points used by the framework wiring:
+
+- ``Pipeline.fit(validate=…)`` / ``KEYSTONE_VALIDATE=1`` →
+  :func:`validate_fit` (cheap default passes; raises
+  :class:`PipelineValidationError` on errors, logs warnings);
+- ``Pipeline.freeze(validate=…)`` → :func:`validate_freeze`
+  (``mode="apply"``: unfitted estimators are errors);
+- ``python -m keystone_tpu.cli check`` → :func:`analyze` with every
+  pass plus DOT overlay output.
+
+With validation off (the default) none of this module is imported by
+the fit/freeze paths — the inert-path guarantee the solver byte-identity
+pins rely on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional, Sequence
+
+from keystone_tpu.analysis import robustness as _robustness
+from keystone_tpu.analysis import shapes as _shapes
+from keystone_tpu.analysis import signatures as _signatures
+from keystone_tpu.analysis.findings import (
+    AnalysisReport,
+    PipelineValidationError,
+)
+from keystone_tpu.workflow import graph as G
+
+logger = logging.getLogger(__name__)
+
+ENV_VALIDATE = "KEYSTONE_VALIDATE"
+
+#: the cheap pre-flight set (no solver tracing, no device work beyond
+#: an optional stream peek / deadline cost estimate)
+DEFAULT_PASSES = ("shapes", "robustness", "signatures")
+ALL_PASSES = DEFAULT_PASSES + ("precision",)
+
+
+def validation_enabled(explicit: Optional[bool] = None) -> bool:
+    """Resolve a ``validate=`` parameter: explicit wins; None reads
+    ``KEYSTONE_VALIDATE`` (\"1\" = on).  One env lookup when off."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(ENV_VALIDATE, "0") == "1"
+
+
+def _as_graph_and_sources(pipeline, example):
+    """(graph, {SourceId: abstract}) from a Pipeline or raw Graph."""
+    if isinstance(pipeline, G.Graph):
+        graph = pipeline
+        srcs: Dict = {}
+        if example is not None and graph.sources:
+            srcs[graph.sources[0]] = _shapes.source_abstract(example)
+        return graph, srcs
+    graph = pipeline.graph
+    srcs = {}
+    if example is not None:
+        src = getattr(pipeline, "source", None)
+        if src is not None:
+            srcs[src] = _shapes.source_abstract(example)
+    return graph, srcs
+
+
+def analyze(
+    pipeline,
+    example=None,
+    deadline=None,
+    passes: Sequence[str] = DEFAULT_PASSES,
+    mode: str = "fit",
+    plan_text=_robustness._UNSET,
+    breaker_threshold=_robustness._UNSET,
+) -> AnalysisReport:
+    """Run the requested analyzer passes over ``pipeline`` (a Pipeline,
+    PipelineDataset-like graph holder, or raw Graph).
+
+    ``example`` seeds the open source for shape propagation: a Dataset,
+    a batch array, a ``jax.ShapeDtypeStruct``, or a per-item shape
+    tuple.  ``deadline`` (seconds or ``guard.Deadline``) enables the
+    deadline-feasibility estimate.  ``mode="apply"`` marks remaining
+    estimators as errors (the freeze/serve contract)."""
+    graph, sources = _as_graph_and_sources(pipeline, example)
+    report = AnalysisReport()
+    for p in passes:
+        if p == "shapes":
+            report.extend(_shapes.run(graph, sources, mode=mode))
+        elif p == "robustness":
+            report.extend(
+                _robustness.run(
+                    graph,
+                    deadline=deadline,
+                    plan_text=plan_text,
+                    breaker_threshold=breaker_threshold,
+                )
+            )
+        elif p == "signatures":
+            report.extend(_signatures.run(graph))
+        elif p == "precision":
+            from keystone_tpu.analysis import precision as _precision
+
+            report.extend(_precision.run())
+        else:
+            raise ValueError(f"unknown analyzer pass {p!r}; known: {ALL_PASSES}")
+    return report
+
+
+def _log_warnings(report: AnalysisReport, what: str) -> None:
+    for f in report.warnings:
+        logger.warning("pre-flight %s: %s", what, f.render())
+
+
+def validate_fit(pipeline, deadline=None, example=None) -> AnalysisReport:
+    """The ``Pipeline.fit(validate=…)`` pre-flight: default passes,
+    errors raise :class:`PipelineValidationError`, warnings log."""
+    report = analyze(
+        pipeline, example=example, deadline=deadline, passes=DEFAULT_PASSES
+    )
+    _log_warnings(report, "fit")
+    report.raise_for_errors()
+    return report
+
+
+def validate_freeze(pipeline, example=None) -> AnalysisReport:
+    """The ``Pipeline.freeze(validate=…)`` pre-flight: apply-mode
+    analysis (unfitted estimators are errors) before the serve path
+    primes any bucket program."""
+    report = analyze(
+        pipeline, example=example, passes=DEFAULT_PASSES, mode="apply"
+    )
+    _log_warnings(report, "freeze")
+    report.raise_for_errors()
+    return report

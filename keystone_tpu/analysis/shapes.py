@@ -1,0 +1,492 @@
+"""Pass (a): abstract shape/dtype interpretation of the workflow graph.
+
+The reference rejects a mis-wired ``Transformer`` chain at compile time;
+here the same walk runs ahead of fit with *abstract* values: every
+dataset literal (and the open source, when the caller supplies an
+example) becomes a ``jax.ShapeDtypeStruct``, and each device transformer
+is pushed through ``jax.eval_shape`` over its ``apply_batch`` — the
+exact callable the runtime jits — so stage-to-stage incompatibilities
+surface as findings *before any device work*, not minutes into an
+expensive fit.
+
+Abstract value lattice (per graph id):
+
+- :class:`ArrayVal` — a device batch: ShapeDtypeStruct (+ optional
+  ragged mask aval), mirroring ``Dataset.array`` / ``Dataset.mask``;
+- :class:`HostVal`  — a host payload (text, term dicts); ``stream=True``
+  marks a host StreamDataset, whose device-transformer consumers raise
+  at runtime (``Transformer.apply_dataset``) and error here;
+- :class:`FittedVal` — the output of an estimator node (opaque: the
+  fitted transformer's output shape is a property of training);
+- :data:`UNKNOWN`  — propagation gave up (host maps, opaque fitted
+  applies); nothing downstream of an UNKNOWN is reported, so giving up
+  is silent, never a false positive.
+
+Findings:
+
+- ``shape-mismatch`` (error): ``eval_shape`` failed with a shape/dtype/
+  rank complaint — the stage cannot accept what its predecessor emits;
+- ``not-unary`` / ``bad-delegate`` / ``missing-labels`` /
+  ``unfitted-estimator`` / ``gather-host`` / ``gather-mismatch``
+  (errors): structural mis-wirings the executor would only hit at run
+  time;
+- ``dtype-downcast`` (warning): a literal/source carries f64 (or i64)
+  data that jax silently narrows under the default x64-disabled config;
+- ``stage-downcast`` (warning): a stage emits a lower-precision float
+  than it consumes (f64→f32, f32→bf16) — the silent-coercion class the
+  PR-2 byte-identity pins only covered on two paths;
+- ``gather-promotion`` (warning): gather branches disagree on dtype, so
+  the concat silently promotes.
+
+Untraceable stages (host-side numpy, data-dependent Python — the same
+population ``_apply_batch_jitted`` memoizes as untraceable at runtime)
+degrade to UNKNOWN with a debug log, not a finding: the analyzer's
+false-positive gate (zero findings over every bundled pipeline) is part
+of its contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from keystone_tpu.analysis.findings import PASS_SHAPES, Finding
+from keystone_tpu.workflow import graph as G
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------- abstract values
+class _Abstract:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayVal(_Abstract):
+    aval: object  # jax.ShapeDtypeStruct
+    mask: Optional[object] = None  # ShapeDtypeStruct of the ragged mask
+
+
+@dataclasses.dataclass(frozen=True)
+class HostVal(_Abstract):
+    stream: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FittedVal(_Abstract):
+    label: str = ""
+
+
+class _Unknown(_Abstract):
+    def __repr__(self):
+        return "UNKNOWN"
+
+
+UNKNOWN = _Unknown()
+
+#: substrings classifying an eval_shape failure as a genuine wiring
+#: error rather than mere untraceability (jax shape errors are
+#: TypeError/ValueError mentioning one of these)
+_SHAPE_ERROR_MARKERS = (
+    "shape",
+    "dimension",
+    "rank",
+    "dtype",
+    "incompatible",
+    "broadcast",
+    "concatenate",
+    "dot_general",
+    "size",
+    "ndim",
+)
+
+_WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+def source_abstract(example) -> _Abstract:
+    """Abstract value for the pipeline's open source from a caller
+    example: a Dataset, a batch-like array, a ``jax.ShapeDtypeStruct``,
+    or a per-item shape tuple (a synthetic f32 batch is assumed)."""
+    import jax
+    import numpy as np
+
+    from keystone_tpu.workflow.dataset import Dataset
+
+    if example is None:
+        return UNKNOWN
+    if isinstance(example, _Abstract):
+        return example
+    if isinstance(example, Dataset):
+        return _dataset_abstract(example, [])
+    if isinstance(example, jax.ShapeDtypeStruct):
+        return ArrayVal(example)
+    if isinstance(example, tuple) and all(isinstance(d, int) for d in example):
+        return ArrayVal(jax.ShapeDtypeStruct((4,) + example, np.float32))
+    if hasattr(example, "shape") and hasattr(example, "dtype"):
+        return ArrayVal(
+            jax.ShapeDtypeStruct(tuple(example.shape), example.dtype)
+        )
+    if isinstance(example, (list,)):  # host payload example (texts)
+        return HostVal()
+    return UNKNOWN
+
+
+def _dataset_abstract(ds, findings: List[Finding], node=None, label=None):
+    """Abstract value of a bound dataset literal.  Streams are peeked
+    (one batch of host work — the price of validating an out-of-core
+    pipeline); failures degrade to UNKNOWN."""
+    import jax
+
+    from keystone_tpu.workflow.dataset import StreamDataset
+
+    if isinstance(ds, StreamDataset):
+        if ds.is_host:
+            return HostVal(stream=True)
+        try:
+            for arr, mask in ds.device_batches():
+                aval = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
+                mval = (
+                    None
+                    if mask is None
+                    else jax.ShapeDtypeStruct(tuple(mask.shape), mask.dtype)
+                )
+                _check_wide(aval, findings, node, label)
+                return ArrayVal(aval, mval)
+            return UNKNOWN  # empty stream: nothing to propagate
+        except Exception as e:
+            logger.debug("stream peek failed for %s: %s", label, e)
+            return UNKNOWN
+    if ds.is_host:
+        return HostVal()
+    arr = ds.array
+    aval = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
+    mval = (
+        None
+        if ds.mask is None
+        else jax.ShapeDtypeStruct(tuple(ds.mask.shape), ds.mask.dtype)
+    )
+    _check_wide(aval, findings, node, label)
+    return ArrayVal(aval, mval)
+
+
+def _check_wide(aval, findings: List[Finding], node, label) -> None:
+    if str(aval.dtype) in _WIDE_DTYPES:
+        findings.append(
+            Finding(
+                "warning",
+                PASS_SHAPES,
+                "dtype-downcast",
+                f"input carries {aval.dtype} data; jax (x64 disabled) "
+                "silently narrows it to 32-bit on device — cast "
+                "explicitly if the narrowing is intended",
+                node=None if node is None else node.id,
+                label=label,
+            )
+        )
+
+
+def _is_float(dtype) -> bool:
+    import numpy as np
+
+    return np.issubdtype(np.dtype(str(dtype)), np.floating) or "bfloat16" in str(
+        dtype
+    )
+
+
+_FLOAT_ORDER = {"bfloat16": 16, "float16": 16, "float32": 32, "float64": 64}
+
+
+def _apply_transformer_abstract(
+    t, val: _Abstract, node, findings: List[Finding]
+) -> _Abstract:
+    """Push one transformer over an abstract input, mirroring
+    ``Transformer.apply_dataset``'s dispatch."""
+    import jax
+
+    from keystone_tpu.workflow.transformer import Cacher
+
+    label = t.label
+    if isinstance(t, Cacher):  # materialization barrier: identity
+        return val
+    if isinstance(val, _Unknown):
+        return UNKNOWN
+    if isinstance(val, FittedVal):
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "bad-wiring",
+                f"{label} is applied to a fitted-transformer value; "
+                "transformers consume datasets",
+                node=node.id,
+                label=label,
+            )
+        )
+        return UNKNOWN
+    if t.is_host:
+        # host transformer: maps apply_one over items; output shape is a
+        # property of the host code — propagate an opaque host value
+        return HostVal(stream=isinstance(val, HostVal) and val.stream)
+    if isinstance(val, HostVal):
+        if val.stream:
+            # Transformer.apply_dataset raises exactly this at runtime
+            findings.append(
+                Finding(
+                    "error",
+                    PASS_SHAPES,
+                    "host-stream-device-stage",
+                    f"{label} is a device transformer but its input is a "
+                    "host-payload stream; featurize to arrays first",
+                    node=node.id,
+                    label=label,
+                )
+            )
+            return UNKNOWN
+        return UNKNOWN  # in-memory host items: applied per item, shape opaque
+    # device transformer over a device batch: the real eval_shape walk
+    assert isinstance(val, ArrayVal)
+    try:
+        if val.mask is not None:
+            out = jax.eval_shape(
+                lambda a, m: t.apply_batch(a, mask=m), val.aval, val.mask
+            )
+        else:
+            out = jax.eval_shape(lambda a: t.apply_batch(a), val.aval)
+    except Exception as e:
+        msg = str(e)
+        low = msg.lower()
+        # tracer/concretization errors (data-dependent Python, host
+        # numpy on tracers) are UNTRACEABILITY, not wiring errors — the
+        # runtime executes those stages on the unjitted fallback, and
+        # their messages mention tracer shapes, so they must be
+        # excluded BEFORE the marker heuristic or a working pipeline
+        # gets refused (zero-false-positive contract)
+        if isinstance(e, jax.errors.JAXTypeError):
+            logger.debug("stage %s is unanalyzable (tracer): %s", label, e)
+            return UNKNOWN
+        if isinstance(e, (TypeError, ValueError)) and any(
+            k in low for k in _SHAPE_ERROR_MARKERS
+        ):
+            findings.append(
+                Finding(
+                    "error",
+                    PASS_SHAPES,
+                    "shape-mismatch",
+                    f"{label} cannot accept input "
+                    f"{tuple(val.aval.shape)}:{val.aval.dtype}: "
+                    + msg.splitlines()[0][:300],
+                    node=node.id,
+                    label=label,
+                )
+            )
+        else:
+            # untraceable (host numpy, data-dependent python) — the same
+            # population the runtime jit wrapper falls back on; not a
+            # wiring error, so not a finding
+            logger.debug("stage %s is unanalyzable: %s", label, e)
+        return UNKNOWN
+    if isinstance(out, tuple) and len(out) == 2:
+        out_arr, out_mask = out
+        result = ArrayVal(out_arr, out_mask)
+    else:
+        out_arr = out
+        result = ArrayVal(out_arr)  # with_array drops the mask
+    in_dt, out_dt = str(val.aval.dtype), str(out_arr.dtype)
+    if (
+        _is_float(in_dt)
+        and _is_float(out_dt)
+        and _FLOAT_ORDER.get(out_dt, 32) < _FLOAT_ORDER.get(in_dt, 32)
+    ):
+        findings.append(
+            Finding(
+                "warning",
+                PASS_SHAPES,
+                "stage-downcast",
+                f"{label} narrows {in_dt} input to {out_dt} output — "
+                "silent precision loss unless the stage is under an "
+                "explicit precision policy",
+                node=node.id,
+                label=label,
+            )
+        )
+    return result
+
+
+def _gather_abstract(vals, node, findings: List[Finding]) -> _Abstract:
+    import jax
+    import numpy as np
+
+    if any(isinstance(v, _Unknown) for v in vals):
+        return UNKNOWN
+    if any(isinstance(v, (HostVal, FittedVal)) for v in vals):
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "gather-host",
+                "gather requires device-array branches; a branch "
+                "produces a host (or fitted-transformer) payload",
+                node=node.id,
+                label="Gather",
+            )
+        )
+        return UNKNOWN
+    shapes = [tuple(v.aval.shape) for v in vals]
+    ranks = {len(s) for s in shapes}
+    leads = {s[:-1] for s in shapes}
+    if len(ranks) > 1 or len(leads) > 1:
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "gather-mismatch",
+                f"gather branches disagree on shape outside the feature "
+                f"axis: {sorted(set(shapes))}",
+                node=node.id,
+                label="Gather",
+            )
+        )
+        return UNKNOWN
+    dtypes = {str(v.aval.dtype) for v in vals}
+    if len(dtypes) > 1:
+        findings.append(
+            Finding(
+                "warning",
+                PASS_SHAPES,
+                "gather-promotion",
+                f"gather branches disagree on dtype {sorted(dtypes)}; "
+                "the concat silently promotes",
+                node=node.id,
+                label="Gather",
+            )
+        )
+    shape = shapes[0][:-1] + (sum(s[-1] for s in shapes),)
+    dt = np.result_type(*[np.dtype(d) if d != "bfloat16" else np.float32 for d in dtypes])
+    return ArrayVal(jax.ShapeDtypeStruct(shape, dt))
+
+
+def run(
+    graph: G.Graph,
+    sources: Optional[Dict[G.SourceId, _Abstract]] = None,
+    mode: str = "fit",
+) -> List[Finding]:
+    """Walk ``graph`` with abstract values.  ``sources`` seeds open
+    sources (unseeded sources propagate UNKNOWN).  ``mode="apply"``
+    additionally errors on any remaining EstimatorOperator — the freeze/
+    serve contract (an unfitted pipeline cannot be applied)."""
+    from keystone_tpu.workflow.dataset import as_dataset
+    from keystone_tpu.workflow.estimator import LabelEstimator
+
+    findings: List[Finding] = []
+    values: Dict[object, _Abstract] = {}
+    for s in graph.sources:
+        v = (sources or {}).get(s, UNKNOWN)
+        values[s] = v
+        if isinstance(v, ArrayVal):
+            _check_wide(v.aval, findings, None, f"source {s.id}")
+
+    for n in graph.topological_nodes():
+        op = graph.operators[n]
+        deps = graph.dependencies[n]
+        dvals = [values.get(d, UNKNOWN) for d in deps]
+        out: _Abstract = UNKNOWN
+        if isinstance(op, G.DatasetOperator):
+            try:
+                ds = as_dataset(op.dataset)
+                out = _dataset_abstract(ds, findings, node=n, label=op.label())
+            except Exception as e:
+                logger.debug("dataset literal unanalyzable at %s: %s", n, e)
+        elif isinstance(op, G.DatumOperator):
+            datum = op.datum
+            if hasattr(datum, "shape") and hasattr(datum, "dtype"):
+                import jax
+
+                aval = jax.ShapeDtypeStruct(
+                    (1,) + tuple(datum.shape), datum.dtype
+                )
+                _check_wide(aval, findings, n, op.label())
+                out = ArrayVal(aval)
+            else:
+                out = HostVal()
+        elif isinstance(op, G.TransformerOperator):
+            if len(deps) != 1:
+                findings.append(
+                    Finding(
+                        "error",
+                        PASS_SHAPES,
+                        "not-unary",
+                        f"{op.label()} has {len(deps)} dependencies; "
+                        "transformers are unary",
+                        node=n.id,
+                        label=op.label(),
+                    )
+                )
+            else:
+                out = _apply_transformer_abstract(
+                    op.transformer, dvals[0], n, findings
+                )
+        elif isinstance(op, G.EstimatorOperator):
+            if mode == "apply":
+                findings.append(
+                    Finding(
+                        "error",
+                        PASS_SHAPES,
+                        "unfitted-estimator",
+                        f"{op.label()} is unfitted; fit() the pipeline "
+                        "before freezing/applying it",
+                        node=n.id,
+                        label=op.label(),
+                    )
+                )
+            if isinstance(op.estimator, LabelEstimator) and len(deps) < 2:
+                findings.append(
+                    Finding(
+                        "error",
+                        PASS_SHAPES,
+                        "missing-labels",
+                        f"{op.label()} is a LabelEstimator but its node "
+                        "has no labels dependency",
+                        node=n.id,
+                        label=op.label(),
+                    )
+                )
+            if dvals and isinstance(dvals[0], FittedVal):
+                findings.append(
+                    Finding(
+                        "error",
+                        PASS_SHAPES,
+                        "bad-wiring",
+                        f"{op.label()} consumes a fitted-transformer "
+                        "value; estimators fit on datasets",
+                        node=n.id,
+                        label=op.label(),
+                    )
+                )
+            out = FittedVal(label=op.label())
+        elif isinstance(op, G.DelegatingOperator):
+            if not dvals or not isinstance(dvals[0], FittedVal):
+                # dep 0 must be the estimator's output — anything else is
+                # the unfitted-estimator-reference class (the executor
+                # raises TypeError at run time, possibly hours in)
+                if dvals and isinstance(dvals[0], _Unknown):
+                    out = UNKNOWN
+                else:
+                    findings.append(
+                        Finding(
+                            "error",
+                            PASS_SHAPES,
+                            "bad-delegate",
+                            "delegating apply expects a fitted transformer "
+                            "as dependency 0 (unfitted-estimator "
+                            "reference?)",
+                            node=n.id,
+                            label=op.label(),
+                        )
+                    )
+            else:
+                out = UNKNOWN  # fitted output shape is a training property
+        elif isinstance(op, G.GatherOperator):
+            out = _gather_abstract(dvals, n, findings)
+        values[n] = out
+    return findings

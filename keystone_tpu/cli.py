@@ -2,6 +2,8 @@
 
     python -m keystone_tpu.cli <PipelineName> [pipeline flags...]
     python -m keystone_tpu.cli serve --model model.pkl [serve flags...]
+    python -m keystone_tpu.cli check <PipelineName> [check flags...]
+    python -m keystone_tpu.cli check --model model.pkl [check flags...]
     python -m keystone_tpu.cli --list
 """
 
@@ -121,16 +123,121 @@ def _serve_main(argv) -> int:
     return 0
 
 
+def _check_main(argv) -> int:
+    """``check`` subcommand: run the pre-flight static analyzer
+    (``keystone_tpu.analysis``) over a bundled pipeline (assembled on
+    tiny synthetic data) or a saved fitted model, print findings with
+    graph locations, and exit non-zero when any error-severity finding
+    is present — the cheap gate to run before committing a long fit or
+    bringing up a serve fleet."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.cli check",
+        description="static pre-flight analysis: shape/dtype propagation, "
+        "solver precision lint, robustness-config lint, signature audit",
+    )
+    ap.add_argument(
+        "pipeline",
+        nargs="?",
+        help="bundled pipeline name (see --list); mutually exclusive "
+        "with --model",
+    )
+    ap.add_argument(
+        "--model",
+        help="path to a FittedPipeline saved via save()/fit_or_load(); "
+        "analyzed in apply mode (the freeze/serve contract)",
+    )
+    ap.add_argument(
+        "--example-shape",
+        default=None,
+        metavar="D0[,D1,...]",
+        help="per-datum input shape seeding shape propagation from the "
+        "open source (with --model; bundled pipelines derive it from "
+        "their synthetic training data)",
+    )
+    ap.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="intended fit/apply deadline (seconds): enables the "
+        "deadline-feasibility estimate against profiled stage costs",
+    )
+    ap.add_argument(
+        "--dot",
+        metavar="OUT",
+        default=None,
+        help="write a Graphviz DOT of the graph with findings overlaid "
+        "(red = error, yellow = warning)",
+    )
+    ap.add_argument(
+        "--no-solver-lint",
+        action="store_true",
+        help="skip the precision pass (solver jaxpr tracing)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = ap.parse_args(argv)
+    if bool(args.pipeline) == bool(args.model):
+        ap.error("pass exactly one of <PipelineName> or --model")
+
+    from keystone_tpu.analysis import ALL_PASSES, DEFAULT_PASSES, analyze
+
+    mode = "fit"
+    if args.model:
+        from keystone_tpu.workflow import FittedPipeline
+
+        pipe = FittedPipeline.load(args.model)
+        example = None
+        if args.example_shape:
+            example = tuple(int(d) for d in args.example_shape.split(","))
+        mode = "apply"
+    else:
+        from keystone_tpu.analysis.bundled import build_bundled
+
+        try:
+            pipe, example = build_bundled(args.pipeline)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    passes = DEFAULT_PASSES if args.no_solver_lint else ALL_PASSES
+    report = analyze(
+        pipe,
+        example=example,
+        deadline=args.deadline,
+        passes=passes,
+        mode=mode,
+    )
+    if args.json:
+        import json
+
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render())
+    if args.dot:
+        from keystone_tpu.workflow.viz import to_dot
+
+        with open(args.dot, "w") as f:
+            f.write(to_dot(pipe.graph, findings=report.findings))
+        print(f"wrote findings overlay to {args.dot}")
+    return 0 if report.ok else 1
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
         print("       python -m keystone_tpu.cli serve --model model.pkl [flags]")
+        print("       python -m keystone_tpu.cli check <PipelineName>|--model model.pkl [flags]")
         print("pipelines:")
         for name in _PIPELINE_MODULES:
             print(f"  {name}")
         return 0
     name, rest = argv[0], argv[1:]
+    if name == "check":
+        _apply_platform_env()
+        return _check_main(rest)
     if name == "serve":
         _apply_platform_env()
         from keystone_tpu.utils.compile_cache import enable_compilation_cache

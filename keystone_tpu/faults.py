@@ -103,6 +103,34 @@ class FaultPlanError(ValueError):
     """A malformed ``KEYSTONE_FAULTS`` / ``inject`` plan string."""
 
 
+class UnknownFaultSiteError(FaultPlanError):
+    """A plan names a site that matches no registered site — a typo'd
+    site would never fire and report nothing outside ``tools/chaos.py``'s
+    exit-2 path, so it is rejected up front (parse time for plan
+    strings, :func:`inject` time for hand-built :class:`FaultPlan`
+    objects).  Carries the offending names and the registered set."""
+
+    def __init__(self, unknown, known=None):
+        self.unknown = sorted(unknown)
+        self.known = sorted(known if known is not None else SITES)
+        names = ", ".join(repr(s) for s in self.unknown)
+        super().__init__(
+            f"unknown fault site(s) {names}; registered sites: {self.known}"
+        )
+
+
+def validate_plan(plan: "FaultPlan") -> "FaultPlan":
+    """Check every spec's site against the registered-site set; raises
+    :class:`UnknownFaultSiteError` listing the offenders.  Plan strings
+    are validated at parse time already — this covers plans built
+    directly from :class:`SiteSpec` objects (and is what the pre-flight
+    analyzer's robustness pass calls)."""
+    unknown = {s.site for s in plan.specs if s.site not in SITES}
+    if unknown:
+        raise UnknownFaultSiteError(unknown)
+    return plan
+
+
 class SiteSpec:
     """One parsed ``site:tokens`` clause plus its firing state."""
 
@@ -195,9 +223,7 @@ def parse_plan(text: str) -> FaultPlan:
         tokens = [t.strip() for t in clause.split(":")]
         site = tokens[0]
         if site not in SITES:
-            raise FaultPlanError(
-                f"unknown fault site {site!r}; known sites: {sorted(SITES)}"
-            )
+            raise UnknownFaultSiteError({site})
         kwargs: Dict = {}
         for tok in tokens[1:]:
             if not tok:
@@ -274,6 +300,9 @@ def inject(plan):
     plan string or a :class:`FaultPlan`; trigger counters start fresh on
     entry so the block is a deterministic replay unit."""
     p = parse_plan(plan) if isinstance(plan, str) else plan
+    # hand-built FaultPlan objects bypass parse_plan's site check;
+    # validate here so a typo'd site fails loudly instead of never firing
+    validate_plan(p)
     p.reset()
     with _LOCK:
         _STACK.append(p)

@@ -59,6 +59,22 @@ def enabled() -> bool:
     return os.environ.get(ENV_DISABLE, "1") != "0"
 
 
+class MetricKindError(TypeError):
+    """One metric name registered as two different instrument kinds
+    (counter vs gauge vs histogram).  Before this check the second
+    registration silently shadowed the first in :meth:`snapshot` —
+    dashboards read whichever family exported last.  Raised at record
+    time, naming both kinds."""
+
+    def __init__(self, name: str, existing: str, requested: str):
+        self.name = name
+        super().__init__(
+            f"metric {name!r} is already registered as a {existing}; "
+            f"cannot also record it as a {requested} — instrument kinds "
+            "are exclusive per name"
+        )
+
+
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
 
@@ -106,6 +122,20 @@ class MetricsRegistry:
         self._counters: Dict[_Key, float] = {}
         self._gauges: Dict[_Key, float] = {}
         self._hists: Dict[_Key, _Histogram] = {}
+        #: name -> instrument kind; one name is one kind forever (until
+        #: reset) — a second registration under a different kind used to
+        #: silently shadow the first in the snapshot
+        self._kinds: Dict[str, str] = {}
+
+    def _check_kind(self, name: str, kind: str) -> None:
+        """Must hold self._lock.  Raises :class:`MetricKindError` when
+        ``name`` is already a different instrument kind — one dict
+        lookup on the hot path."""
+        prev = self._kinds.get(name)
+        if prev is None:
+            self._kinds[name] = kind
+        elif prev != kind:
+            raise MetricKindError(name, prev, kind)
 
     # ----------------------------------------------------------- record
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
@@ -114,6 +144,7 @@ class MetricsRegistry:
             return
         k = _key(name, labels)
         with self._lock:
+            self._check_kind(name, "counter")
             self._counters[k] = self._counters.get(k, 0.0) + float(value)
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
@@ -121,6 +152,7 @@ class MetricsRegistry:
         if not enabled():
             return
         with self._lock:
+            self._check_kind(name, "gauge")
             self._gauges[_key(name, labels)] = float(value)
 
     def gauge_max(self, name: str, value: float, **labels) -> None:
@@ -130,6 +162,7 @@ class MetricsRegistry:
             return
         k = _key(name, labels)
         with self._lock:
+            self._check_kind(name, "gauge")
             prev = self._gauges.get(k)
             if prev is None or value > prev:
                 self._gauges[k] = float(value)
@@ -140,6 +173,7 @@ class MetricsRegistry:
             return
         k = _key(name, labels)
         with self._lock:
+            self._check_kind(name, "histogram")
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = _Histogram()
@@ -228,6 +262,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._kinds.clear()
 
 
 #: the process-wide registry every subsystem reports to

@@ -118,6 +118,17 @@ class PipelineEnv:
         return cls.optimizer
 
 
+def _validate_requested(validate) -> bool:
+    """The ONE pre-flight gate shared by ``fit`` and ``freeze``:
+    explicit flag wins, ``None`` reads ``KEYSTONE_VALIDATE``.  Kept
+    module-local (not ``analysis.validation_enabled``) so the off path
+    costs one env lookup and never imports the analysis package — the
+    inert-path guarantee the solver byte-identity pins ride on."""
+    if validate is not None:
+        return bool(validate)
+    return os.environ.get("KEYSTONE_VALIDATE", "0") == "1"
+
+
 class Pipeline(Chainable):
     """A DAG with one open source and one sink."""
 
@@ -229,11 +240,22 @@ class Pipeline(Chainable):
         return PipelineDatum(g, self.sink)
 
     # --------------------------------------------------------------- fit
-    def fit(self, deadline=None) -> "FittedPipeline":
+    def fit(self, deadline=None, validate=None) -> "FittedPipeline":
         """Optimize, execute every estimator fit, and return a pure
         transformer pipeline (the reference's ``Pipeline.fit():
         PipelineModel``).  Fits are memoized via the executor, so shared
         prefixes run once.
+
+        ``validate``: run the pre-flight static analyzer
+        (``keystone_tpu.analysis``) before any device work — abstract
+        shape/dtype propagation over the bound estimator subgraphs,
+        fault-plan/breaker/deadline configuration lint, and the
+        CSE/cache-signature audit.  Error findings raise
+        ``PipelineValidationError`` (the fit never starts); warnings
+        log.  Default ``None`` reads ``KEYSTONE_VALIDATE`` (\"1\" = on);
+        off, the cost is one env lookup and ``keystone_tpu.analysis``
+        is never imported — the solver byte-identity pins ride on this
+        inert path.
 
         ``deadline``: a wall-clock budget for the whole fit — seconds or
         a ``utils.guard.Deadline``.  The executor apportions it over the
@@ -251,6 +273,10 @@ class Pipeline(Chainable):
         ledger, and a metrics snapshot is flushed at fit end so
         ``tools/obs_report.py`` can summarize a run even if the process
         later dies.  Unset, all hooks are inert."""
+        if _validate_requested(validate):
+            from keystone_tpu.analysis import validate_fit
+
+            validate_fit(self, deadline=deadline)
         from keystone_tpu.obs import ledger as _ledger
 
         with _ledger.span("pipeline.fit"):
@@ -301,23 +327,39 @@ class Pipeline(Chainable):
         g = StageFusionRule().apply(g)
         return FittedPipeline(g, self.source, self.sink)
 
-    def freeze(self) -> "FrozenApplier":
+    def freeze(self, validate=None, example=None) -> "FrozenApplier":
         """Freeze this pipeline for repeated online application: run the
         whole-pipeline optimizer ONCE now, and return a
         :class:`FrozenApplier` that binds each incoming batch to the
         pre-optimized graph — the serving entry point
         (``keystone_tpu.serve`` builds its micro-batching service on
-        this).  Requires an estimator-free pipeline (``fit()`` first)."""
-        return FrozenApplier(self)
+        this).  Requires an estimator-free pipeline (``fit()`` first).
 
-    def to_dot(self, name: str = "pipeline", timings=None, retries=None) -> str:
+        ``validate`` runs the pre-flight analyzer in apply mode before
+        the serve path primes any bucket program: a statically-broken
+        pipeline (mis-shaped stage given ``example``, signature
+        collision, bad fault plan) is rejected with
+        ``PipelineValidationError`` instead of failing request-by-
+        request.  ``example`` (a per-item shape tuple, batch array, or
+        Dataset) seeds shape propagation from the open source.  Default
+        ``None`` reads ``KEYSTONE_VALIDATE``; off, the path is inert."""
+        return FrozenApplier(self, validate=validate, example=example)
+
+    def to_dot(
+        self, name: str = "pipeline", timings=None, retries=None, findings=None
+    ) -> str:
         """Graphviz DOT of this pipeline's DAG (Pipeline.toDOT analogue).
         ``timings``/``retries`` overlay measured per-node seconds and
         retry counts (see ``workflow/viz.py`` — ``ledger_overlay`` folds
-        them out of a run ledger)."""
+        them out of a run ledger); ``findings`` overlays analyzer
+        findings (red = error, yellow = warning — ``cli.py check
+        --dot``)."""
         from keystone_tpu.workflow.viz import to_dot
 
-        return to_dot(self.graph, name, timings=timings, retries=retries)
+        return to_dot(
+            self.graph, name, timings=timings, retries=retries,
+            findings=findings,
+        )
 
     def __repr__(self):
         return f"Pipeline({self.graph!r})"
@@ -328,7 +370,7 @@ class FittedPipeline(Pipeline):
     (the analogue of the reference's serialized PipelineModel +
     workflow/SavedStateLoadRule.scala)."""
 
-    def fit(self, deadline=None) -> "FittedPipeline":
+    def fit(self, deadline=None, validate=None) -> "FittedPipeline":
         return self
 
     def _walk_fitted(self, visit=None) -> None:
@@ -498,13 +540,17 @@ class FrozenApplier:
     of failing the batch — graceful degradation applies on the serve
     path too."""
 
-    def __init__(self, pipeline: "Pipeline"):
+    def __init__(self, pipeline: "Pipeline", validate=None, example=None):
         for op in pipeline.graph.operators.values():
             if isinstance(op, G.EstimatorOperator):
                 raise TypeError(
                     f"cannot freeze a pipeline with unfitted estimator "
                     f"{op.label()!r}; call fit() first"
                 )
+        if _validate_requested(validate):
+            from keystone_tpu.analysis import validate_freeze
+
+            validate_freeze(pipeline, example=example)
         opt = PipelineEnv.get_optimizer()
         self.graph = opt.execute(pipeline.graph)
         self.source = pipeline.source

@@ -13,6 +13,11 @@ label, shaded by share of total time::
 
     timings, retries = ledger_overlay("/tmp/obs/run_abc.jsonl")
     dot = to_dot(pipe.graph, timings=timings, retries=retries)
+
+Analyzer overlay: pass ``findings`` (``keystone_tpu.analysis`` Finding
+records) and offending nodes fill red (error) / yellow (warning) with
+their finding codes — ``python -m keystone_tpu.cli check --dot OUT``
+writes exactly this.
 """
 
 from __future__ import annotations
@@ -56,12 +61,33 @@ def _lookup(overlay: Optional[dict], n, label: str):
     return overlay.get(f"{n.id}:{label}")
 
 
+#: analyzer-overlay fills: worst severity per node wins, and a finding
+#: fill beats the timing shade (a broken node matters more than a slow
+#: one)
+_SEVERITY_FILL = {"error": "#ff9999", "warning": "#ffe680"}
+
+
+def _findings_by_node(findings) -> Dict[int, list]:
+    by_node: Dict[int, list] = {}
+    for f in findings or ():
+        if getattr(f, "node", None) is not None:
+            by_node.setdefault(f.node, []).append(f)
+    return by_node
+
+
 def to_dot(
     graph: G.Graph,
     name: str = "pipeline",
     timings: Optional[Dict[str, float]] = None,
     retries: Optional[Dict[str, int]] = None,
+    findings=None,
 ) -> str:
+    """``findings``: analyzer Finding records (or an AnalysisReport) —
+    offending nodes fill red (error) / yellow (warning) with their
+    finding codes under the label, and graph-level findings render as a
+    standalone note node.  ``cli.py check --dot`` writes this overlay."""
+    findings = list(findings) if findings is not None else []
+    by_node = _findings_by_node(findings)
     lines = [f"digraph {name} {{", "  rankdir=LR;", "  node [shape=box, fontsize=10];"]
     total = sum(timings.values()) if timings else 0.0
     for s in graph.sources:
@@ -90,11 +116,34 @@ def to_dot(
             extra = (
                 ', style=filled, fillcolor="0.08 %0.2f 1.0"' % (0.1 + 0.8 * share)
             )
+        node_findings = by_node.get(n.id)
+        if node_findings:
+            worst = (
+                "error"
+                if any(f.severity == "error" for f in node_findings)
+                else "warning"
+            )
+            codes = sorted({f.code for f in node_findings})
+            label = label + "\\n" + " ".join(codes[:3])
+            extra = f', style=filled, fillcolor="{_SEVERITY_FILL[worst]}"'
         lines.append(f'  "{n!r}" [shape={shape}, label="{label}"{extra}];')
         for d in graph.dependencies[n]:
             lines.append(f'  "{d!r}" -> "{n!r}";')
     for k, d in graph.sink_dependencies.items():
         lines.append(f'  "{k!r}" [shape=ellipse, label="sink {k.id}"];')
         lines.append(f'  "{d!r}" -> "{k!r}";')
+    graph_level = [f for f in findings if getattr(f, "node", None) is None]
+    if graph_level:
+        worst = (
+            "error"
+            if any(f.severity == "error" for f in graph_level)
+            else "warning"
+        )
+        codes = sorted({f.code for f in graph_level})
+        note = "analysis: " + " ".join(codes[:4])
+        lines.append(
+            f'  "analysis_findings" [shape=note, label="{note}", '
+            f'style=filled, fillcolor="{_SEVERITY_FILL[worst]}"];'
+        )
     lines.append("}")
     return "\n".join(lines)

@@ -1,0 +1,142 @@
+"""tools/lint.py — the repo-invariant AST linter, enforced in tier-1.
+
+Two halves: (1) the whole ``keystone_tpu/`` tree lints clean (the CI
+gate — a new unregistered fault site, misnamed metric, wall-clock call
+in supervised code, or ungated obs hook fails the suite the commit it
+appears); (2) a seeded-violation corpus proving every rule actually
+fires, so the gate can't rot into a vacuous pass."""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import lint  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def sites():
+    return lint.load_registered_sites()
+
+
+def _run(src, sites, supervised=False, metric_kinds=None):
+    return lint.lint_source(
+        "seeded.py",
+        src,
+        sites,
+        metric_kinds if metric_kinds is not None else {},
+        supervised=supervised,
+    )
+
+
+# ------------------------------------------------------------- the gate
+def test_repo_lints_clean():
+    """The tier-1 invariant: the whole package passes the linter."""
+    violations = lint.lint_paths([os.path.join(REPO_ROOT, "keystone_tpu")])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_sites_registry_parsed_without_import(sites):
+    # parsed from the AST (no package import) and matches the live set
+    from keystone_tpu import faults
+
+    assert sites == frozenset(faults.SITES)
+    assert "executor.stage" in sites
+
+
+# ------------------------------------------------- seeded: fault-site
+def test_fault_site_rule_fires(sites):
+    v = _run('fault_point("bogus.site")', sites)
+    assert [x.rule for x in v] == ["fault-site"]
+    v = _run('faults.fault_point("executor.stage")', sites)
+    assert not v
+    v = _run('SiteSpec("another.bogus", action="raise")', sites)
+    assert [x.rule for x in v] == ["fault-site"]
+
+
+# ------------------------------------------------- seeded: metric rules
+def test_metric_name_rule_fires(sites):
+    assert [x.rule for x in _run('metrics.inc("BadName")', sites)] == [
+        "metric-name"
+    ]
+    assert [x.rule for x in _run('metrics.observe("nodots", 1.0)', sites)] == [
+        "metric-name"
+    ]
+    assert not _run('metrics.inc("executor.stage_retries")', sites)
+    assert not _run('metrics.set_gauge("serve.queue_depth", 3)', sites)
+
+
+def test_metric_kind_rule_fires_across_files(sites):
+    mk = {}
+    assert not _run('metrics.inc("x.y")', sites, metric_kinds=mk)
+    v = _run('metrics.set_gauge("x.y", 1.0)', sites, metric_kinds=mk)
+    assert [x.rule for x in v] == ["metric-kind"]
+    # same kind from two files is fine
+    assert not _run('metrics.inc("x.y", 2.0)', sites, metric_kinds=mk)
+
+
+# ------------------------------------------------- seeded: wall-clock
+def test_wall_clock_rule_scoped_to_supervised(sites):
+    src = "import time\nt0 = time.time()\n"
+    assert [x.rule for x in _run(src, sites, supervised=True)] == [
+        "wall-clock"
+    ]
+    # outside the supervised set the same code is fine (app-level wall
+    # timing is legitimate)
+    assert not _run(src, sites, supervised=False)
+    # monotonic clocks pass; the annotated escape hatch passes visibly
+    assert not _run(
+        "import time\nt0 = time.monotonic()", sites, supervised=True
+    )
+    assert not _run(
+        "import time\nts = time.time()  # lint: allow-wall-clock",
+        sites,
+        supervised=True,
+    )
+
+
+def test_supervised_prefixes_cover_guard_layer():
+    assert lint._is_supervised("keystone_tpu/utils/guard.py")
+    assert lint._is_supervised("keystone_tpu/serve/service.py")
+    assert not lint._is_supervised("keystone_tpu/pipelines/timit.py")
+
+
+# ------------------------------------------------- seeded: obs-gating
+def test_obs_gating_rule_fires(sites):
+    bad = 'def f():\n    led = ledger.active()\n    led.event("x")\n'
+    assert [x.rule for x in _run(bad, sites)] == ["obs-gating"]
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        # guarded suite
+        'def f():\n    led = ledger.active()\n    if led is not None:\n'
+        '        led.event("x")\n',
+        # early-exit guard
+        "def f():\n    led = ledger.active()\n    if led is None:\n"
+        '        return\n    led.event("x")\n',
+        # pure None-comparison (the inert check itself)
+        "def f():\n    obs = ledger.active() is not None\n    return obs\n",
+        # conditional expression guard
+        "def f():\n    led = ledger.active()\n"
+        '    return led.path if led is not None else None\n',
+    ],
+)
+def test_obs_gating_accepts_guarded_forms(src, sites):
+    assert not _run(src, sites)
+
+
+# ------------------------------------------------------- CLI behavior
+def test_main_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint.main([str(clean)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text('fault_point("typo.site")\n')
+    assert lint.main([str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "fault-site" in out

@@ -334,9 +334,19 @@ def test_optional_stage_degrades_on_serve_path():
 def _total_apply_programs() -> int:
     """Compiled apply-program count across every jit cache an apply can
     ride: the fused-chain shared cache, the traced-params shared cache,
-    and the per-instance wrappers."""
+    and the per-instance wrappers.
+
+    Collect first: the per-instance cache is a WeakKeyDictionary over
+    transformer objects, and earlier tests' dead pipelines linger as
+    cyclic garbage until a generational GC pass — one landing BETWEEN
+    two counts silently shrinks the second and fails an equality pin
+    that no new compile violated.  Forcing collection before every
+    count makes both sides see post-GC state; a genuinely new program
+    still raises the count."""
+    import gc
     import importlib
 
+    gc.collect()
     T = importlib.import_module("keystone_tpu.workflow.transformer")
     O = importlib.import_module("keystone_tpu.workflow.optimizer")
     n = 0

@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Repo-invariant AST linter (stdlib-only; enforced as a tier-1 test).
+
+The codebase carries cross-cutting contracts no unit test sees locally:
+fault sites must be registered or they never fire, metric names must
+follow the registry convention or dashboards fragment, guard-supervised
+code must use monotonic clocks or watchdog math breaks under wall-clock
+steps, and obs hooks must stay inert (one ``None`` check) when no
+ledger is attached.  This linter pins them at the AST level, so a
+violation fails CI the commit it appears.
+
+Rules:
+
+- ``fault-site``   — every string-literal site passed to
+  ``fault_point(...)`` / ``SiteSpec(...)`` appears in
+  ``keystone_tpu/faults.py``'s ``SITES`` registry (parsed from the
+  AST, so the linter never imports the package);
+- ``metric-name``  — string-literal names in
+  ``metrics.inc/observe/set_gauge/gauge_max/remove_gauge(...)`` (and
+  ``REGISTRY.<same>``) match ``subsystem.metric_name`` — lowercase,
+  dot-separated, underscore words;
+- ``metric-kind``  — one metric name is used as one instrument kind
+  across the whole tree (the static twin of
+  ``obs.metrics.MetricKindError``);
+- ``wall-clock``   — no bare ``time.time()`` inside guard-supervised
+  modules (executor, guard, durable, blockstore, stream loaders, serve,
+  recovery, multihost): intervals there feed deadline/watchdog/retry
+  math and must use ``time.monotonic()``/``perf_counter()``.  Wall
+  timestamps that are genuinely wanted take a trailing
+  ``# lint: allow-wall-clock`` comment;
+- ``obs-gating``   — a variable bound from ``ledger.active()`` is only
+  dereferenced under an ``is not None`` guard (the inert-hook
+  contract: one ``None`` check when obs is off).
+
+Escape hatch: a trailing ``# lint: allow-<rule>`` comment allowlists
+one line, visibly.
+
+Usage::
+
+    python tools/lint.py [paths...]     # default: keystone_tpu/
+
+Exit status 0 = clean, 1 = violations (printed one per line), 2 = usage.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "keystone_tpu")
+FAULTS_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "faults.py")
+
+#: registry-convention metric names: subsystem.name[.more], lowercase
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+#: metrics-registry write methods → instrument kind
+_METRIC_KINDS = {
+    "inc": "counter",
+    "observe": "histogram",
+    "set_gauge": "gauge",
+    "gauge_max": "gauge",
+    "remove_gauge": "gauge",
+}
+
+#: modules whose timing feeds deadline/watchdog/retry/backoff math —
+#: wall clock steps (NTP, suspend) must not corrupt them.  Paths are
+#: repo-root-relative prefixes.
+SUPERVISED_PREFIXES = (
+    "keystone_tpu/workflow/executor.py",
+    "keystone_tpu/workflow/recovery.py",
+    "keystone_tpu/workflow/blockstore.py",
+    "keystone_tpu/utils/guard.py",
+    "keystone_tpu/utils/durable.py",
+    "keystone_tpu/loaders/stream.py",
+    "keystone_tpu/parallel/multihost.py",
+    "keystone_tpu/serve/",
+)
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow-([a-z-]+)")
+
+
+class Violation:
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def load_registered_sites(faults_path: str = FAULTS_PATH) -> frozenset:
+    """Parse ``SITES = {...}`` out of faults.py WITHOUT importing it —
+    the linter must run in any environment, including ones where the
+    package's dependencies are absent."""
+    with open(faults_path) as f:
+        tree = ast.parse(f.read(), filename=faults_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SITES":
+                    if isinstance(node.value, ast.Set):
+                        return frozenset(
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+    raise RuntimeError(f"could not locate SITES registry in {faults_path}")
+
+
+def _allowed(lines: List[str], lineno: int, rule: str) -> bool:
+    if 1 <= lineno <= len(lines):
+        m = _ALLOW_RE.search(lines[lineno - 1])
+        if m and m.group(1) == rule:
+            return True
+    return False
+
+
+def _receiver_name(func: ast.AST) -> Optional[Tuple[str, str]]:
+    """('metrics'|'REGISTRY', method) for metrics-registry write calls."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    v = func.value
+    if isinstance(v, ast.Name) and v.id in ("metrics", "REGISTRY"):
+        return v.id, attr
+    # metrics.REGISTRY.remove_gauge(...) — attribute chain ending REGISTRY
+    if isinstance(v, ast.Attribute) and v.attr == "REGISTRY":
+        return "REGISTRY", attr
+    return None
+
+
+def _str_arg0(call: ast.Call) -> Optional[Tuple[str, int]]:
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value, call.args[0].lineno
+    return None
+
+
+def _is_supervised(rel_path: str) -> bool:
+    rel = rel_path.replace(os.sep, "/")
+    return any(rel.startswith(p) or rel == p.rstrip("/") for p in SUPERVISED_PREFIXES)
+
+
+# ------------------------------------------------------------ obs gating
+
+
+def _guarded_uses(func_body: List[ast.stmt], var: str) -> List[int]:
+    """Line numbers of UNGUARDED dereferences of ``var`` (attribute
+    access / call / subscript on it) within ``func_body``, where a
+    guard is any enclosing ``if var is not None`` (use in body),
+    ``if var is None`` (use in orelse), a conditional expression with
+    the same test, or a preceding early exit ``if var is None:
+    return/raise/continue/break`` in the same suite."""
+
+    def test_is(node: ast.AST, op_type) -> bool:
+        return (
+            isinstance(node, ast.Compare)
+            and isinstance(node.left, ast.Name)
+            and node.left.id == var
+            and len(node.ops) == 1
+            and isinstance(node.ops[0], op_type)
+            and len(node.comparators) == 1
+            and isinstance(node.comparators[0], ast.Constant)
+            and node.comparators[0].value is None
+        )
+
+    def test_guards(node: ast.AST) -> bool:
+        # `var is not None`, or conjunctions containing it
+        if test_is(node, ast.IsNot):
+            return True
+        if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.And):
+            return any(test_guards(v) for v in node.values)
+        return False
+
+    bad: List[int] = []
+
+    def deref_lines(node: ast.AST) -> List[int]:
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and isinstance(
+                sub.value, ast.Name
+            ) and sub.value.id == var:
+                out.append(sub.lineno)
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                sub.value, ast.Name
+            ) and sub.value.id == var:
+                out.append(sub.lineno)
+        return out
+
+    def walk_suite(suite: List[ast.stmt], guarded: bool) -> None:
+        g = guarded
+        for stmt in suite:
+            walk_stmt(stmt, g)
+            # early exit establishes the guard for the REST of the suite
+            if (
+                isinstance(stmt, ast.If)
+                and test_is(stmt.test, ast.Is)
+                and stmt.body
+                and all(
+                    isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+                    for s in stmt.body[-1:]
+                )
+            ):
+                g = True
+
+    def walk_stmt(stmt: ast.stmt, guarded: bool) -> None:
+        if isinstance(stmt, ast.If):
+            if test_guards(stmt.test):
+                walk_suite(stmt.body, True)
+                walk_suite(stmt.orelse, guarded)
+                return
+            if test_is(stmt.test, ast.Is):
+                walk_suite(stmt.body, guarded)
+                walk_suite(stmt.orelse, True)
+                return
+            walk_suite(stmt.body, guarded)
+            walk_suite(stmt.orelse, guarded)
+            for line in deref_lines(stmt.test):
+                if not guarded:
+                    bad.append(line)
+            return
+        if isinstance(stmt, (ast.For, ast.While, ast.With, ast.Try)):
+            for line in _stmt_header_derefs(stmt):
+                if not guarded:
+                    bad.append(line)
+            for suite in _stmt_suites(stmt):
+                walk_suite(suite, guarded)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_suite(stmt.body, guarded)  # nested fn: same discipline
+            return
+        if not guarded:
+            # IfExp guards inline: `x.f() if x is not None else y`
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.IfExp) and test_guards(sub.test):
+                    for line in deref_lines(sub.orelse):
+                        bad.append(line)
+                    break
+            else:
+                bad.extend(deref_lines(stmt))
+
+    def _stmt_header_derefs(stmt) -> List[int]:
+        headers = []
+        if isinstance(stmt, ast.For):
+            headers = deref_lines(stmt.iter)
+        elif isinstance(stmt, ast.While):
+            headers = deref_lines(stmt.test)
+        elif isinstance(stmt, ast.With):
+            headers = [ln for item in stmt.items for ln in deref_lines(item)]
+        return headers
+
+    def _stmt_suites(stmt) -> List[List[ast.stmt]]:
+        suites = [getattr(stmt, "body", [])]
+        suites.append(getattr(stmt, "orelse", []))
+        suites.append(getattr(stmt, "finalbody", []))
+        for h in getattr(stmt, "handlers", []):
+            suites.append(h.body)
+        return [s for s in suites if s]
+
+    walk_suite(func_body, False)
+    return sorted(set(bad))
+
+
+# -------------------------------------------------------------- lint core
+
+
+def lint_source(
+    rel_path: str,
+    source: str,
+    sites: frozenset,
+    metric_kinds: Dict[str, Tuple[str, str, int]],
+    supervised: Optional[bool] = None,
+) -> List[Violation]:
+    """Lint one file's source.  ``metric_kinds`` accumulates
+    name → (kind, path, line) across files for the metric-kind rule.
+    ``supervised`` overrides the path-based wall-clock scoping (tests)."""
+    out: List[Violation] = []
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as e:
+        return [Violation(rel_path, e.lineno or 0, "syntax", str(e))]
+    if supervised is None:
+        supervised = _is_supervised(rel_path)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # ---- fault-site: fault_point("site", ...) / SiteSpec("site", ...)
+        callee = (
+            func.id
+            if isinstance(func, ast.Name)
+            else func.attr
+            if isinstance(func, ast.Attribute)
+            else None
+        )
+        if callee in ("fault_point", "SiteSpec"):
+            arg = _str_arg0(node)
+            if arg is not None:
+                site, lineno = arg
+                if site not in sites and not _allowed(
+                    lines, lineno, "fault-site"
+                ):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "fault-site",
+                            f"site {site!r} is not in the faults.SITES "
+                            "registry — it would never fire",
+                        )
+                    )
+        # ---- metric-name / metric-kind
+        recv = _receiver_name(func)
+        if recv is not None and recv[1] in _METRIC_KINDS:
+            arg = _str_arg0(node)
+            if arg is not None:
+                mname, lineno = arg
+                if not METRIC_NAME_RE.match(mname) and not _allowed(
+                    lines, lineno, "metric-name"
+                ):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "metric-name",
+                            f"metric {mname!r} does not match the "
+                            "registry convention "
+                            "(lowercase dotted: subsystem.metric_name)",
+                        )
+                    )
+                kind = _METRIC_KINDS[recv[1]]
+                prev = metric_kinds.get(mname)
+                if prev is None:
+                    metric_kinds[mname] = (kind, rel_path, lineno)
+                elif prev[0] != kind and not _allowed(
+                    lines, lineno, "metric-kind"
+                ):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "metric-kind",
+                            f"metric {mname!r} used as a {kind} here but "
+                            f"as a {prev[0]} at {prev[1]}:{prev[2]} — "
+                            "instrument kinds are exclusive per name",
+                        )
+                    )
+        # ---- wall-clock: time.time() in supervised modules
+        if (
+            supervised
+            and isinstance(func, ast.Attribute)
+            and func.attr == "time"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+            and not _allowed(lines, node.lineno, "wall-clock")
+        ):
+            out.append(
+                Violation(
+                    rel_path,
+                    node.lineno,
+                    "wall-clock",
+                    "bare time.time() in guard-supervised code; use "
+                    "time.monotonic()/perf_counter() (or annotate "
+                    "'# lint: allow-wall-clock' for a true timestamp)",
+                )
+            )
+
+    # ---- obs-gating: per function scope
+    scopes: List[Tuple[List[ast.stmt], ast.AST]] = [(tree.body, tree)]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append((node.body, node))
+    for body, _scope in scopes:
+        # variables bound from *.active() in THIS scope's direct body
+        for stmt in body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "active"
+            ):
+                var = stmt.targets[0].id
+                for lineno in _guarded_uses(body, var):
+                    if lineno <= stmt.lineno:
+                        continue  # a different binding earlier in the suite
+                    if not _allowed(lines, lineno, "obs-gating"):
+                        out.append(
+                            Violation(
+                                rel_path,
+                                lineno,
+                                "obs-gating",
+                                f"{var!r} (bound from ledger.active()) is "
+                                "dereferenced without an 'is not None' "
+                                "guard — obs hooks must stay inert when "
+                                "no ledger is attached",
+                            )
+                        )
+    return out
+
+
+def lint_paths(paths: List[str], sites: Optional[frozenset] = None) -> List[Violation]:
+    if sites is None:
+        sites = load_registered_sites()
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                files.extend(
+                    os.path.join(root, n) for n in names if n.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            files.append(p)
+    violations: List[Violation] = []
+    metric_kinds: Dict[str, Tuple[str, str, int]] = {}
+    for path in sorted(files):
+        rel = os.path.relpath(path, REPO_ROOT)
+        with open(path) as f:
+            source = f.read()
+        violations.extend(lint_source(rel, source, sites, metric_kinds))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    paths = argv or [DEFAULT_TARGET]
+    violations = lint_paths(paths)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"lint: {len(violations)} violation(s)")
+        return 1
+    print("lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
