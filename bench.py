@@ -385,6 +385,7 @@ def measure_fit(n: int = FIT_N) -> dict:
         ledger_path = led.path if led is not None else None
         obs_ledger.stop_run()
     obs_summary = None
+    dataflow = {}
     if ledger_path is not None:
         try:
             from tools.obs_report import summarize
@@ -402,13 +403,25 @@ def measure_fit(n: int = FIT_N) -> dict:
                     if isinstance(v, (int, float)) and v
                 },
             }
+            dataflow = s.get("dataflow") or {}
         except Exception as e:  # the summary must never fail the leg
             obs_summary = {"error": repr(e)[:200]}
-    return {
+    out = {
         "fit_seconds": dt,
         "fit_images_per_sec": n / dt,
         "obs": obs_summary,
     }
+    # first-class dataflow accounts (ISSUE 7): seconds the host spent
+    # blocked on device results / on host→device staging during the
+    # fit, and the busy share of the FIT wall clock (the obs summary's
+    # own fraction is over ledger wall time, which includes the report
+    # tail — the fit-relative number is the round-over-round metric)
+    busy = dataflow.get("device_busy_seconds")
+    if busy is not None:
+        out["device_busy_seconds"] = busy
+        out["transfer_seconds"] = dataflow.get("transfer_seconds", 0.0)
+        out["device_busy_fraction"] = busy / dt if dt > 0 else None
+    return out
 
 
 def solver_flops(n: int, d: int, k: int, bs: int, epochs: int) -> float:
@@ -664,6 +677,24 @@ def main():
             "n_legs": len(vals),
         }
 
+    def dataflow_fields(legs) -> dict:
+        """Median device-busy / transfer accounts over a fit leg set —
+        the first-class fields the tentpole's success metric tracks
+        (device_busy_fraction must RISE round over round as the feed
+        stops starving the device)."""
+        out = {}
+        for key, digits in (
+            ("device_busy_seconds", 3),
+            ("transfer_seconds", 3),
+            ("device_busy_fraction", 4),
+        ):
+            vals = [
+                float(lg[key]) for lg in legs if lg.get(key) is not None
+            ]
+            if vals:
+                out[key] = round(float(np.median(vals)), digits)
+        return out
+
     samples = [measure_ips(BATCH)]
     for _ in range(max(0, N_LEGS - 1)):
         leg = subprocess_leg("--leg")
@@ -791,6 +822,7 @@ def main():
                 "solver_block": FIT_SOLVER_BLOCK,
             },
         }
+        out["fit"].update(dataflow_fields(fit_legs))
         # operational context of the fit (stage top-k, retry totals,
         # memory watermarks) from the first leg's run ledger, so the
         # perf trajectory in BENCH_rNN.json explains itself
@@ -847,6 +879,7 @@ def main():
                 "classes": FIT_CLASSES, "epochs": FIT_EPOCHS,
             },
         }
+        out["fit_at_scale"].update(dataflow_fields(fit_scale_legs))
     print(json.dumps(out))
 
 

@@ -278,13 +278,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             xc, yc = x, y
         from keystone_tpu.obs import ledger
 
-        weights = _bcd_fit(
-            blockify(xc, self.block_size),
-            yc,
-            nf,
-            self.lam,
-            self.num_iter,
-            obs=ledger.solver_obs(),
+        # device_wait: obs-gated sync charging the solve to the ledger's
+        # device-busy account (inert — not even a block — without a run)
+        weights = ledger.device_wait(
+            _bcd_fit(
+                blockify(xc, self.block_size),
+                yc,
+                nf,
+                self.lam,
+                self.num_iter,
+                obs=ledger.solver_obs(),
+            )
         )
         return finish_block_model(
             weights, xm, ym, x.shape[1], self.block_size, self.fit_intercept
@@ -352,7 +356,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             h = hashlib.sha256()
             for a in arrays:
                 shards = getattr(a, "addressable_shards", None)
-                loc = np.asarray(shards[0].data) if shards else np.asarray(a)
+                # one-off pre-fit fingerprint read, not sweep-path
+                loc = np.asarray(shards[0].data) if shards else np.asarray(a)  # lint: allow-host-sync
                 h.update(loc[0].tobytes())
                 h.update(loc[-1].tobytes())
             return int.from_bytes(h.digest()[:8], "little")
@@ -443,8 +448,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
             maybe_health_barrier("bcd.checkpointed.epoch")
             t_epoch = _time.perf_counter()
+            # donated carry: the old (w, p) buffers are consumed by the
+            # epoch program and rebound to its outputs here
             w, p = _bcd_epoch(xb, yc, nf, self.lam, w, p)
-            jax.block_until_ready(w)
+            # required sync (the gathers below read w); metered as
+            # device-busy either way
+            ledger.device_wait(w, force=True)
             # the gathers are COLLECTIVES: every process must run them
             w_host = gather_to_host(w)
             p_host = gather_to_host(p)
@@ -460,10 +469,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 durable.save_npz(
                     path,
                     {
-                        "epoch": np.asarray(e),
+                        # host scalars: savez coerces — no device read
+                        "epoch": e,
                         "w": w_host,
                         "p": p_host,
-                        "problem": np.asarray(problem),
+                        "problem": problem,
                     },
                     keep=2,
                 )
@@ -473,7 +483,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 ledger.solver_epoch(
                     "bcd.checkpointed",
                     epoch=e,
-                    objective=float(np.asarray(_bcd_objective(yc, p, nf))),
+                    objective=float(np.asarray(_bcd_objective(yc, p, nf))),  # lint: allow-host-sync
                     epoch_seconds=_time.perf_counter() - t_epoch,
                     checkpoint_save_seconds=save_seconds,
                 )
@@ -530,10 +540,27 @@ def _bcd_objective(yc, p, n):
     return 0.5 * jnp.vdot(r, r) / n
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(5, 6))
 def _oc_block_step(a_raw, xm_b, yc, sa, row_ok, p, wb, lam_n):
     """One out-of-core BCD block update (compiled once, reused for every
-    (epoch, block) step — all blocks share one shape by construction)."""
+    (epoch, block) step — all blocks share one shape by construction).
+
+    The carried state ``p``/``wb`` is DONATED (aliased onto the step's
+    ``p_new``/``wb_new`` outputs): step N's residual and weights land in
+    step N−1's HBM instead of allocating fresh — in the out-of-core
+    regime HBM headroom is what bounds the block size, and without
+    donation each step transiently holds two (n × k) residuals.  The
+    staged block is NOT donated (no same-shape output to alias; its
+    buffer frees by refcount when the loop drops it).  Callers must not
+    touch a donated input after the call.
+
+    The third output is a (1, 1) ``tick`` slice of the new weights:
+    both real outputs are donated into LATER steps (p next step, wb next
+    epoch), so neither can be waited on for flow control — the tick is
+    never donated and gives the sweep a compute-completion handle to
+    ``block_until_ready`` two steps behind, bounding how far the async
+    dispatch queue (and the staged blocks its pending executions pin in
+    HBM) can run ahead of the device."""
     a0 = (a_raw - xm_b) * row_ok[:, None]  # centered, padding re-zeroed
     a0 = constrain(a0, DATA_AXIS, None)
     a = a0 * sa[:, None]
@@ -542,7 +569,14 @@ def _oc_block_step(a_raw, xm_b, yc, sa, row_ok, p, wb, lam_n):
     atr = sharded_matmul(a, target, out_spec=P(None, MODEL_AXIS))
     wb_new = solve_spd(ata, atr, reg=lam_n)
     p_new = constrain(p + a0 @ (wb_new - wb), DATA_AXIS, MODEL_AXIS)
-    return wb_new, p_new
+    return wb_new, p_new, wb_new[:1, :1]
+
+
+#: upper bound on the env-supplied read-ahead depth.  Each slot pins one
+#: (n × block_size) host block, so an absurd depth (a stray
+#: KEYSTONE_OC_PREFETCH=100000 in a job template) is an OOM sentence,
+#: not a tuning choice — reject it up front.
+_OC_PREFETCH_MAX = 64
 
 
 def _oc_prefetch(explicit=None) -> int:
@@ -551,12 +585,40 @@ def _oc_prefetch(explicit=None) -> int:
     override, else 2 (the measured default — one block transferring
     while one computes).  Deeper prefetch buys overlap on slow disks at
     the cost of pinned host memory: each slot holds an (n × block_size)
-    f32/bf16 host block."""
-    from keystone_tpu.utils.durable import _env_int
+    f32/bf16 host block.
+
+    The value is VALIDATED, not best-effort-coerced — on BOTH entry
+    points (the same ``[1, _OC_PREFETCH_MAX]`` bound applies to the
+    ``prefetch=`` fit argument and the env var): a non-integer or
+    out-of-range depth raises ``ValueError`` naming its source — a
+    silently-ignored typo ("KEYSTONE_OC_PREFETCH=eight") used to run
+    the whole fit at the default depth while the operator believed the
+    tuning was in effect."""
+    import os
 
     if explicit is not None:
-        return max(1, int(explicit))
-    return max(1, _env_int("KEYSTONE_OC_PREFETCH", 2))
+        return _check_prefetch_depth(int(explicit), "prefetch")
+    raw = os.environ.get("KEYSTONE_OC_PREFETCH")
+    if raw is None or raw == "":
+        return 2
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_OC_PREFETCH={raw!r} is not an integer; expected a "
+            f"block read-ahead depth in [1, {_OC_PREFETCH_MAX}]"
+        ) from None
+    return _check_prefetch_depth(depth, "KEYSTONE_OC_PREFETCH")
+
+
+def _check_prefetch_depth(depth: int, source: str) -> int:
+    if not 1 <= depth <= _OC_PREFETCH_MAX:
+        raise ValueError(
+            f"{source}={depth} is outside [1, {_OC_PREFETCH_MAX}]: each "
+            "prefetch slot pins one (n × block_size) host block, so the "
+            "depth must be a small positive integer"
+        )
+    return depth
 
 
 def _check_store_rows(store, labels) -> None:
@@ -641,12 +703,32 @@ def _oc_bcd_fit(
             a = a.astype(jnp.float32)
         return a
 
+    import time as _time
+
+    from keystone_tpu.obs import ledger, metrics
+
+    def _ready(x):
+        # compute backpressure: block until a step output from two
+        # iterations back is READY (no device read, no host copy) so the
+        # dispatch queue — and the staged blocks its pending executions
+        # pin in HBM — never runs more than 2 steps ahead.  The staging
+        # window only bounds in-flight TRANSFERS; transfers are not
+        # ordered behind compute, so without this the Python loop races
+        # the whole sweep into the queue.  The wait is device-busy time.
+        ledger.device_wait(x, force=True)
+
     if fit_intercept:
+        # double-buffered device feed: block b+1's host→device transfer
+        # overlaps block b's weighted-mean reduction, and the bounded
+        # staging window replaces the per-block real device read this
+        # loop used to carry as backpressure
         xm_rows = []
-        for _, blk in store.iter_blocks(range(nb), prefetch=prefetch):
-            m = _oc_wmean(alpha, stage(blk), wsum)
-            np.asarray(m[:1])  # real sync: bound in-flight staged blocks
-            xm_rows.append(m)
+        for _, a in store.iter_device_blocks(
+            range(nb), prefetch=prefetch, stage=stage
+        ):
+            xm_rows.append(_oc_wmean(alpha, a, wsum))
+            if len(xm_rows) > 2:
+                _ready(xm_rows[-3])
         xm = jnp.stack(xm_rows)  # (nb, bs)
         ym = _oc_wmean(alpha, y, wsum)
     else:
@@ -748,27 +830,29 @@ def _oc_bcd_fit(
     lam_n = jnp.float32(lam * n)
     order = [b for _ in range(start, num_iter) for b in range(nb)]
     epoch = start
-    # Backpressure: a REAL device read (4 bytes) of the weights from TWO
-    # steps back before dispatching the next.  Async dispatch has no
-    # flow control (and block_until_ready does not drain the stream on
-    # every backend), so without this the Python loop races ahead and
-    # every staged block's host buffer stays pinned — at 4×-HBM scale
-    # that OOM-killed the host.  The 2-deep window keeps block b+1's H2D
-    # overlapping block b's compute while bounding in-flight staging.
+    # Dataflow: iter_device_blocks dispatches block b+1's host→device
+    # transfer while block b computes, waiting (block_until_ready, no
+    # device READ) on the transfer of the block two behind before
+    # yielding — so staged HOST buffers stay bounded.  The step donates
+    # only the carried p and w[b] (epoch N's state reuses epoch N−1's
+    # HBM; the staged block itself is NOT donated — it frees by
+    # refcount).  Compute flow control is separate: a ready-wait on the
+    # step's non-donated tick output from two steps back (see _ready),
+    # replacing the real 4-byte device read the loop used to carry.
     from collections import deque
-
-    import time as _time
-
-    from keystone_tpu.obs import ledger, metrics
 
     observe = ledger.solver_obs()
     t_epoch = _time.perf_counter()
     pending: deque = deque()
-    for i, (b, blk) in enumerate(store.iter_blocks(order, prefetch=prefetch)):
-        if len(pending) >= 2:
-            np.asarray(pending.popleft()[:1, :1])
-        w[b], p = _oc_block_step(stage(blk), xm[b], yc, sa, row_ok, p, w[b], lam_n)
-        pending.append(w[b])
+    for i, (b, a) in enumerate(
+        store.iter_device_blocks(order, prefetch=prefetch, stage=stage)
+    ):
+        w[b], p, tick = _oc_block_step(
+            a, xm[b], yc, sa, row_ok, p, w[b], lam_n
+        )
+        pending.append(tick)
+        if len(pending) > 2:
+            _ready(pending.popleft())
         if (i + 1) % nb == 0:
             # epoch boundary: abort collectively if a peer host went
             # sick mid-sweep (see fit_checkpointed's barrier) — the
@@ -777,7 +861,9 @@ def _oc_bcd_fit(
             _mh.maybe_health_barrier("oc_bcd.epoch")
             save_seconds = None
             if ckpt_path is not None:
-                jax.block_until_ready(p)
+                # required sync (the gathers below read p); metered as
+                # device-busy either way
+                ledger.device_wait(p, force=True)
                 # collectives first (every process participates) …
                 w_host = np.stack([_mh.gather_to_host(x) for x in w])
                 p_host = _mh.gather_to_host(p)
@@ -792,26 +878,35 @@ def _oc_bcd_fit(
                     durable.save_npz(
                         ckpt_path,
                         {
-                            "epoch": np.asarray(epoch),
+                            # host scalars: savez coerces — no device read
+                            "epoch": epoch,
                             "w": w_host,
                             "p": p_host,
-                            "problem": np.asarray(problem),
+                            "problem": problem,
                         },
                         keep=2,
                     )
                 save_seconds = _time.perf_counter() - t_save
                 metrics.observe("solver.checkpoint_save_seconds", save_seconds)
             if observe:
+                # per-epoch objective is a real device read — charge the
+                # wait to the device-busy account (obs-gated: the inert
+                # sweep carries no sync at all)
+                t_dev = _time.perf_counter()
+                obj = float(np.asarray(_bcd_objective(yc, p, n)))  # lint: allow-host-sync
+                metrics.observe(
+                    "device.busy_seconds", _time.perf_counter() - t_dev
+                )
                 ledger.solver_epoch(
                     "bcd.out_of_core",
                     epoch=epoch,
-                    objective=float(np.asarray(_bcd_objective(yc, p, n))),
+                    objective=obj,
                     epoch_seconds=_time.perf_counter() - t_epoch,
                     checkpoint_save_seconds=save_seconds,
                 )
             t_epoch = _time.perf_counter()
             epoch += 1
-    weights = jnp.stack(w)
+    weights = ledger.device_wait(jnp.stack(w))
     return weights, xm.reshape(-1), ym
 
 
@@ -849,9 +944,14 @@ def _bcd_epoch_body(xb, y, n, lam, carry):
     return lax.fori_loop(0, nb, block_step, carry)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(4, 5))
 def _bcd_epoch(xb, y, n, lam, w, p):
-    """Single checkpointable epoch (used by fit_checkpointed's host loop)."""
+    """Single checkpointable epoch (used by fit_checkpointed's host
+    loop).  The carried ``(w, p)`` is DONATED: epoch N's state lands in
+    epoch N−1's HBM instead of doubling the live weight+residual
+    footprint across every epoch boundary.  The caller's old bindings
+    are invalid after the call (they are rebound to the outputs, and the
+    checkpoint gathers read the NEW state)."""
     xb = constrain(xb, None, DATA_AXIS, None)
     y = constrain(y, DATA_AXIS, MODEL_AXIS)
     return _bcd_epoch_body(xb, y, n, lam, (w, p))

@@ -132,6 +132,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def fit_store(
         self, store, labels, checkpoint_dir=None, prefetch=None
     ) -> BlockLinearMapper:
+        """Weighted out-of-core fit.  Rides block_ls._oc_bcd_fit, so the
+        async double-buffered device feed (blockstore.iter_device_blocks)
+        and the donated per-block carry (_oc_block_step donates p and
+        w_b; the staged block frees by refcount) apply to the weighted
+        sweep too."""
         from keystone_tpu.models.block_ls import (
             _check_store_rows,
             _oc_bcd_fit,
@@ -173,6 +178,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             x, y, alpha, nf, self.lam, self.num_iter, self.block_size,
             self.fit_intercept, obs=ledger.solver_obs(),
         )
+        # obs-gated sync: charge the solve's wall wait to the ledger's
+        # device-busy account (inert without an active run)
+        weights = ledger.device_wait(weights)
         from keystone_tpu.models.block_ls import finish_block_model
 
         return finish_block_model(

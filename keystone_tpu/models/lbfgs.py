@@ -244,6 +244,12 @@ def lbfgs_minimize_resumable(
         vag_of_data, shape, history, tol, max_line_search
     )
 
+    # the scan carry is DONATED: chunk N's optimizer state (iterate,
+    # gradient, 2·m weight-sized history buffers) lands in chunk N−1's
+    # HBM instead of transiently doubling the (2m+2)·d·k footprint at
+    # every chunk boundary — at text scale that doubling is GBs.  The
+    # caller rebinds `carry` to the output immediately, and save_cb only
+    # ever sees the NEW carry.
     @partial(jax.jit, static_argnames=("iters",), donate_argnums=(1,))
     def chunk(data, carry, iters):
         return lax.scan(
@@ -282,7 +288,7 @@ def lbfgs_minimize_resumable(
             # the DEVICE carry is handed over: at d·k·(2m+2) scale the
             # host copy is GBs, and non-writer processes must not pay it
             # (save_cb converts after its process-index check)
-            jax.block_until_ready(carry)
+            ledger.device_wait(carry, force=True)
             t_save = _time.perf_counter()
             save_cb(it, carry)
             save_seconds = _time.perf_counter() - t_save
@@ -295,8 +301,8 @@ def lbfgs_minimize_resumable(
             ledger.solver_epoch(
                 "lbfgs.chunk",
                 it=int(it),
-                objective=float(np.asarray(f)),
-                grad_norm=float(np.asarray(gnorm)),
+                objective=float(np.asarray(f)),  # lint: allow-host-sync
+                grad_norm=float(np.asarray(gnorm)),  # lint: allow-host-sync
                 chunk_seconds=_time.perf_counter() - t_chunk,
                 checkpoint_save_seconds=save_seconds,
             )
@@ -453,15 +459,17 @@ class DenseLBFGSwithL2(LabelEstimator):
     def _fit(self, x, y, n):
         from keystone_tpu.obs import ledger
 
-        w, b = _lbfgs_least_squares(
-            jnp.asarray(x, jnp.float32),
-            jnp.asarray(y, jnp.float32),
-            jnp.float32(n),
-            self.lam,
-            self.num_iterations,
-            self.history,
-            self.fit_intercept,
-            obs=ledger.solver_obs(),
+        w, b = ledger.device_wait(
+            _lbfgs_least_squares(
+                jnp.asarray(x, jnp.float32),
+                jnp.asarray(y, jnp.float32),
+                jnp.float32(n),
+                self.lam,
+                self.num_iterations,
+                self.history,
+                self.fit_intercept,
+                obs=ledger.solver_obs(),
+            )
         )
         return LinearMapper(w, b if self.fit_intercept else None)
 

@@ -342,6 +342,34 @@ def restore_context(token) -> None:
     led._tls.stack = list(stack)
 
 
+def device_wait(x, account: str = "device.busy_seconds", force: bool = False):
+    """Block until ``x`` (any pytree of device values) is ready and
+    charge the wait to the device-busy account — ONLY when a ledger is
+    active.  Inert otherwise: no sync, no timing, the dispatch stream is
+    untouched — so programs and async pipelining are byte-for-byte the
+    pre-obs ones when observability is off.  Returns ``x``.
+
+    ``force=True`` syncs (and meters) unconditionally — for call sites
+    where the wait is REQUIRED regardless of observability (checkpoint
+    gathers, dispatch-queue flow control) and the metering rides along.
+
+    The account is a host-side measure: seconds the host spent BLOCKED
+    on device results at natural drain points (solver finishes, epoch
+    boundaries).  Together with ``blockstore.stage_wait_seconds`` (time
+    blocked on host→device staging) it decomposes a fit's wall clock
+    into device-busy vs transfer vs host overhead —
+    ``tools/obs_report.py`` folds both into the ``dataflow`` summary the
+    bench artifact embeds."""
+    if not force and active() is None:
+        return x
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(x)
+    metrics.observe(account, time.perf_counter() - t0)
+    return x
+
+
 def solver_obs() -> bool:
     """Should solvers trace per-epoch telemetry?  Resolved at trace time
     and threaded as a STATIC jit argument, so the compiled program is

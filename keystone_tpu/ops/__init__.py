@@ -42,6 +42,7 @@ from keystone_tpu.ops.lcs import LCSExtractor  # noqa: F401
 from keystone_tpu.ops.daisy import DaisyExtractor  # noqa: F401
 from keystone_tpu.ops.fisher import (  # noqa: F401
     FisherVector,
+    FusedPcaFisherVector,
     GMMFisherVectorEstimator,
 )
 from keystone_tpu.ops.nlp import (  # noqa: F401

@@ -115,6 +115,117 @@ class FisherVector(Transformer):
         return self.apply_batch(x[None].reshape(1, *jnp.asarray(x).shape))[0]
 
 
+class FusedPcaFisherVector(Transformer):
+    """PCA projection + Fisher-vector encode as ONE kernel dispatch —
+    the fused forward megakernel (ops/fisher_pallas.fused_forward_pallas).
+
+    With ``sift_normalize=True`` it also absorbs SIFT's final
+    L2→clamp→re-L2 tail, so a RAW-descriptor SIFT feed runs
+    sift-normalize → PCA → FV in one program.  Built by the optimizer's
+    ``PallasFvFusionRule`` from an adjacent single-consumer
+    ``PCATransformer → FisherVector`` pair on Pallas-capable devices;
+    off-TPU (or ``use_pallas=False``) it applies the IDENTICAL math as
+    the per-stage XLA chain, so the transformer stays portable and
+    parity-testable on CPU meshes.
+
+    Not ``fusable``: like FisherVector it reduces a ragged (desc, mask)
+    pair to a dense row — the generic chain fuser has no mask story.
+    """
+
+    fusable = False
+
+    # fitted arrays ride as traced jit arguments (shared compiled
+    # programs across refits; nothing read back at lowering time)
+    traced_attrs = ("components", "mean", "gmm")
+
+    def __init__(
+        self,
+        pca,
+        gmm: GaussianMixtureModel,
+        sift_normalize: bool = False,
+        use_pallas: Optional[bool] = None,
+    ):
+        self.components = pca.components  # (d_in, d)
+        self.mean = pca.mean  # (d_in,) or None
+        self.gmm = gmm
+        self.sift_normalize = bool(sift_normalize)
+        self.use_pallas = use_pallas
+
+    @property
+    def label(self):
+        tail = "SiftNorm > PCA > FV" if self.sift_normalize else "PCA > FV"
+        return f"FusedFV[{tail}]"
+
+    def jit_static(self):
+        return (self.use_pallas, self.sift_normalize, self.mean is None)
+
+    def params(self):
+        from keystone_tpu.utils.hashing import cached_fingerprint
+
+        arrays = [self.components]
+        if self.mean is not None:
+            arrays.append(self.mean)
+        arrays += [self.gmm.weights, self.gmm.means, self.gmm.variances]
+        fp = cached_fingerprint(self, "_fp", *arrays)
+        return (fp, self.sift_normalize, self.use_pallas, self.mean is None)
+
+    def apply_batch(self, xs, mask=None):
+        if xs.ndim == 2:
+            xs = xs[None]
+            squeeze = True
+        else:
+            squeeze = False
+        if mask is None:
+            mask = jnp.ones(xs.shape[:2], jnp.float32)
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            from keystone_tpu.ops.fisher_pallas import pallas_supported
+
+            gamma_elems = xs.shape[1] * self.gmm.means.shape[0]
+            use_pallas = (
+                gamma_elems >= FisherVector._PALLAS_GAMMA_THRESHOLD
+                and pallas_supported(xs)
+            )
+        if use_pallas:
+            from keystone_tpu.ops.fisher_pallas import fused_forward_pallas
+
+            out = fused_forward_pallas(
+                xs,
+                mask,
+                self.components,
+                self.mean,
+                self.gmm.weights,
+                self.gmm.means,
+                self.gmm.variances,
+                mxu=precision.matmul_mode(),
+                normalize=self.sift_normalize,
+            )
+        else:
+            # per-stage XLA fallback: bit-for-bit the unfused chain
+            # (sift normalize → PCATransformer's matmul → _fisher_encode)
+            z = xs
+            if self.sift_normalize:
+                from keystone_tpu.ops.sift import _sift_normalize
+
+                z = _sift_normalize(z)
+            if self.mean is not None:
+                z = z - self.mean
+            z_c, comp_c = precision.fcast(z, self.components)
+            z = jnp.matmul(z_c, comp_c, preferred_element_type=jnp.float32)
+            out = _fisher_encode(
+                z,
+                mask,
+                self.gmm.weights,
+                self.gmm.means,
+                self.gmm.variances,
+                mxu=precision.apply_mode(),
+            )
+        return out[0] if squeeze else out
+
+    def apply_one(self, x):
+        return self.apply_batch(jnp.asarray(x)[None])[0]
+
+
 class GMMFisherVectorEstimator(Estimator):
     """Fits the GMM vocabulary on (sampled) descriptors and returns the
     FisherVector transformer (nodes/images/external/GMMFisherVectorEstimator)."""

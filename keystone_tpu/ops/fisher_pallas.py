@@ -39,6 +39,16 @@ def _precision():
 
     return precision
 
+
+def _compiler_params(**kw):
+    """``pltpu.CompilerParams`` across jax versions (older releases ship
+    it as ``TPUCompilerParams``) — the kernels must import-and-run on
+    both the TPU fleet's jax and the CPU test container's."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    if cp is None:
+        cp = pltpu.TPUCompilerParams
+    return cp(**kw)
+
 # Max descriptors per VMEM tile when the GMM shape is unknown.  Measured
 # on v5 lite (T=784, K=256, d=64): one whole-image tile runs the kernel
 # at ~42 TF/s vs ~14 TF/s with 128-row tiles — per-program overhead
@@ -83,8 +93,13 @@ def _tile_t(t: int, k: int | None = None, d: int | None = None) -> int:
         tiles += 1
 
 
-def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
-               out_ref, s0_ref, s1_ref, s2_ref, cnt_ref):
+def _fv_tile_body(x, m, logw_ref, mu_ref, inv_ref, lognorm_ref,
+                  out_ref, s0_ref, s1_ref, s2_ref, cnt_ref):
+    """Shared FV accumulation over one descriptor tile: posterior gemms
+    → masked softmax → sufficient-statistic accumulators → Φ¹/Φ² on the
+    last tile.  ``x`` (TILE_T, d) f32 in VMEM; ``m`` (TILE_T, 1) mask.
+    Both the plain FV kernel and the fused sift-normalize→PCA→FV
+    megakernel end here, so their math cannot drift apart."""
     t = pl.program_id(1)
     nt = pl.num_programs(1)
 
@@ -95,14 +110,6 @@ def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
         s2_ref[:] = jnp.zeros_like(s2_ref)
         cnt_ref[0] = 0.0
 
-    # descriptors may arrive bf16 (halved HBM traffic — the kernel is
-    # bandwidth bound); compute stays f32 in VMEM
-    x = x_ref[0].astype(jnp.float32)  # (TILE_T, d)
-    # mask arrives (1, 1, TILE_T) with T on the LANE dim: a (n, T, 1)
-    # input would be lane-padded to 128 by TPU tiling — 128× the HBM
-    # traffic for the same bits.  The (1,T)→(T,1) relayout is per-tile
-    # VPU work on ~10³ elements, noise next to the saved DMA.
-    m = mask_ref[0].T  # (TILE_T, 1)
     mu_inv = mu_ref[:] * inv_ref[:]  # (K, d)
 
     # log N(x; μ_k, σ²_k) via the gemm expansion (all on the MXU)
@@ -144,6 +151,55 @@ def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
         out_ref[0, k:, :] = phi2
 
 
+def _fv_kernel(x_ref, mask_ref, logw_ref, mu_ref, inv_ref, lognorm_ref,
+               out_ref, s0_ref, s1_ref, s2_ref, cnt_ref):
+    # descriptors may arrive bf16 (halved HBM traffic — the kernel is
+    # bandwidth bound); compute stays f32 in VMEM
+    x = x_ref[0].astype(jnp.float32)  # (TILE_T, d)
+    # mask arrives (1, 1, TILE_T) with T on the LANE dim: a (n, T, 1)
+    # input would be lane-padded to 128 by TPU tiling — 128× the HBM
+    # traffic for the same bits.  The (1,T)→(T,1) relayout is per-tile
+    # VPU work on ~10³ elements, noise next to the saved DMA.
+    m = mask_ref[0].T  # (TILE_T, 1)
+    _fv_tile_body(x, m, logw_ref, mu_ref, inv_ref, lognorm_ref,
+                  out_ref, s0_ref, s1_ref, s2_ref, cnt_ref)
+
+
+def _fv_fused_kernel(x_ref, mask_ref, comp_ref, mean_ref, logw_ref, mu_ref,
+                     inv_ref, lognorm_ref, out_ref, s0_ref, s1_ref, s2_ref,
+                     cnt_ref, *, normalize: bool):
+    """Fused forward tile: [SIFT normalize →] PCA project → FV
+    accumulate, one VMEM pass per descriptor tile.
+
+    The unfused chain writes the normalized (T, d_in) descriptors AND
+    the projected (T, d) descriptors back to HBM between stages (and on
+    the un-jitted serve path pays a program launch per stage); here raw
+    descriptors stream from HBM exactly once and only the FV leaves.
+    ``normalize`` is a Python-static flag (functools.partial at
+    pallas_call time): True when the feed is RAW windowed SIFT output
+    (the extractor's normalize tail absorbed in-kernel), False when the
+    producer already normalized."""
+    x = x_ref[0].astype(jnp.float32)  # (TILE_T, d_in) descriptor tile
+    if normalize:
+        # SIFT normalize: L2 → clamp 0.2 → re-L2 (VPU; same form and
+        # epsilons as ops/sift._sift_normalize, the parity reference)
+        nrm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+        x = x / jnp.maximum(nrm, 1e-8)
+        x = jnp.minimum(x, 0.2)
+        nrm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+        x = x / jnp.maximum(nrm, 1e-8)
+    # PCA projection on the MXU: (TILE_T, d_in) × (d_in, d), f32
+    # accumulation.  Tile padding rows project to (−μ)·C ≠ 0, but the
+    # mask zeroes their γ so they contribute nothing downstream.
+    z = jnp.dot(
+        x - mean_ref[0][None, :], comp_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    m = mask_ref[0].T  # (TILE_T, 1) — see _fv_kernel on the lane layout
+    _fv_tile_body(z, m, logw_ref, mu_ref, inv_ref, lognorm_ref,
+                  out_ref, s0_ref, s1_ref, s2_ref, cnt_ref)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "mxu"))
 def fisher_encode_pallas(
     xs, mask, w, mu, var, interpret: bool = False, mxu: str = "f32"
@@ -172,7 +228,7 @@ def fisher_encode_pallas(
     out = pl.pallas_call(
         _fv_kernel,
         grid=grid,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary")
         ),
         in_specs=[
@@ -195,6 +251,95 @@ def fisher_encode_pallas(
     )(
         xs.astype(_precision().fdtype(mxu)),
         mask.astype(jnp.float32)[:, None, :],
+        logw.astype(jnp.float32),
+        mu.astype(jnp.float32),
+        inv.astype(jnp.float32),
+        lognorm.astype(jnp.float32),
+    )
+    return out.reshape(n, 2 * k * d)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "mxu", "normalize")
+)
+def fused_forward_pallas(
+    desc,
+    mask,
+    components,
+    mean,
+    w,
+    mu,
+    var,
+    interpret: bool = False,
+    mxu: str = "f32",
+    normalize: bool = True,
+):
+    """[SIFT-normalize →] PCA-project → FV-encode as ONE Pallas kernel.
+
+    ``desc``: (n, T, d_in) descriptors — RAW (pre-normalize) windowed
+    SIFT output with ``normalize=True``, already-normalized descriptors
+    with ``normalize=False``; ``mask``: (n, T); ``components``:
+    (d_in, d) PCA projection; ``mean``: (d_in,) or None; GMM
+    ``(w (K,), mu/var (K, d))`` → (n, 2·K·D).
+
+    Matches the per-stage chain ``ops/sift._sift_normalize →
+    models/pca.PCATransformer → ops/fisher._fisher_encode`` to f32
+    rounding.  HBM traffic collapses from three round trips (normalized
+    descriptors out+in, projected descriptors out+in, FV out) to one
+    descriptor read and one FV write; on the un-jitted serve path the
+    three program launches become one.  Under ``mxu='bf16'`` /
+    ``'bf16_apply'`` the descriptor stream crosses HBM at half width;
+    all VMEM compute stays f32."""
+    n, t, d_in = desc.shape
+    k, d = mu.shape
+    # VMEM budget must hold BOTH descriptor widths per tile (raw d_in
+    # and projected d) on top of the γ/logp copies
+    tile_t = _tile_t(t, k, d_in + d)
+    tiles = -(-t // tile_t)
+    if tiles * tile_t != t:
+        pad = tiles * tile_t - t
+        desc = jnp.pad(desc, ((0, 0), (0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    inv = 1.0 / var
+    logw = jnp.log(w).reshape(1, k)
+    lognorm = (-0.5 * (jnp.sum(jnp.log(var), axis=1) + d * _LOG2PI)).reshape(1, k)
+    mean_row = (
+        jnp.zeros((1, d_in), jnp.float32)
+        if mean is None
+        else jnp.asarray(mean, jnp.float32).reshape(1, d_in)
+    )
+
+    grid = (n, tiles)
+    out = pl.pallas_call(
+        functools.partial(_fv_fused_kernel, normalize=bool(normalize)),
+        grid=grid,
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        in_specs=[
+            pl.BlockSpec((1, tile_t, d_in), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, 1, tile_t), lambda i, t: (i, 0, t)),
+            pl.BlockSpec((d_in, d), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, d_in), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, k), lambda i, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda i, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, k), lambda i, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 2 * k, d), lambda i, t: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 2 * k, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((1, k), jnp.float32),
+            pltpu.VMEM((k, d), jnp.float32),
+            pltpu.VMEM((k, d), jnp.float32),
+            pltpu.SMEM((1,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        desc.astype(_precision().fdtype(mxu)),
+        mask.astype(jnp.float32)[:, None, :],
+        components.astype(jnp.float32),
+        mean_row,
         logw.astype(jnp.float32),
         mu.astype(jnp.float32),
         inv.astype(jnp.float32),

@@ -64,6 +64,8 @@ class SIFTExtractor(Transformer):
     smoothing_magnif = 0.0
     # pre-windowing pickles ran the conv path
     windowing = "conv"
+    # pre-fused-forward pickles always normalized
+    normalize = True
 
     def __init__(
         self,
@@ -71,6 +73,7 @@ class SIFTExtractor(Transformer):
         bin_sizes: Sequence[int] = (4,),
         smoothing_magnif: float = 6.0,
         windowing: str = "matmul",
+        normalize: bool = True,
     ):
         if windowing not in ("conv", "matmul"):
             raise ValueError(f"unknown SIFT windowing {windowing!r}")
@@ -90,9 +93,22 @@ class SIFTExtractor(Transformer):
         #: layout-copy stage from the graph and is exactly parity-tested.
         #: "conv" keeps the r2 path.
         self.windowing = windowing
+        #: False emits RAW windowed descriptors (the L2→clamp→re-L2 tail
+        #: skipped) — set by the optimizer's PallasFvFusionRule when the
+        #: downstream fused forward megakernel absorbs the normalize
+        #: in-VMEM (ops/fisher_pallas.fused_forward_pallas).  Raw
+        #: descriptors are NOT scale-invariant; only a consumer that
+        #: normalizes should ever see them.
+        self.normalize = bool(normalize)
 
     def params(self):
-        return (self.step, self.bin_sizes, self.smoothing_magnif, self.windowing)
+        return (
+            self.step,
+            self.bin_sizes,
+            self.smoothing_magnif,
+            self.windowing,
+            self.normalize,
+        )
 
     def _sigma(self, bin_size: int) -> float:
         if self.smoothing_magnif <= 0:
@@ -114,6 +130,7 @@ class SIFTExtractor(Transformer):
                     mxu=precision.matmul_mode(),
                     sigma=self._sigma(b),
                     windowing=self.windowing,
+                    normalize=self.normalize,
                 )
             )
         out = jnp.concatenate(descs, axis=1)
@@ -206,7 +223,10 @@ def _gradient_orientation_map(imgs):
 
 
 @partial(
-    jax.jit, static_argnames=("step", "bin_size", "mxu", "sigma", "windowing")
+    jax.jit,
+    static_argnames=(
+        "step", "bin_size", "mxu", "sigma", "windowing", "normalize"
+    ),
 )
 def _dsift(
     imgs,
@@ -215,6 +235,7 @@ def _dsift(
     mxu: str = "f32",
     sigma: float = 0.0,
     windowing: str = "matmul",
+    normalize: bool = True,
 ):
     from keystone_tpu.ops.filters import separable_gaussian_blur
 
@@ -263,7 +284,7 @@ def _dsift(
         desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(
             n, ky * kx, _GRID * _GRID * o
         )
-        return _sift_normalize(desc)
+        return _sift_normalize(desc) if normalize else desc
 
     # --- spatial triangular windowing: separable depthwise conv ---
     k1 = jnp.asarray(_triangular_kernel(bin_size))
@@ -318,7 +339,7 @@ def _dsift(
     g = bin_slices(smoothed, ys, 1)  # (n, ky, 4, w, 8)
     g = bin_slices(g, xs_, 3)  # (n, ky, 4, kx, 4, 8)
     desc = jnp.transpose(g, (0, 1, 3, 2, 4, 5)).reshape(n, ky * kx, _GRID * _GRID * o)
-    return _sift_normalize(desc)
+    return _sift_normalize(desc) if normalize else desc
 
 
 def _sift_normalize(desc):

@@ -42,6 +42,7 @@ from keystone_tpu.workflow.optimizer import (  # noqa: F401
     NodeChoiceRule,
     Once,
     Optimizer,
+    PallasFvFusionRule,
     Rule,
     RuleBatch,
     StageFusionRule,

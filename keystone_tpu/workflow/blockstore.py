@@ -322,11 +322,39 @@ class FeatureBlockStore:
             return False
 
         def produce():
+            b_cur: Optional[int] = None
             try:
                 for b in order:
+                    b_cur = b
                     if stop.is_set() or not put((b, self.read_block(b))):
                         return
             except BaseException as e:
+                # Tag the failing block index onto the error IN PLACE
+                # (type preserved: retry_if / except-clauses downstream
+                # dispatch on the exception class, so wrapping would
+                # silently defeat them).  Without the tag, a sweep of
+                # hundreds of blocks reports "checksum mismatch" with no
+                # way to know WHICH block file to inspect.
+                if b_cur is not None:
+                    tag = f"block {b_cur}: "
+                    if (
+                        isinstance(e, OSError)
+                        and e.errno is not None
+                        and isinstance(e.strerror, str)
+                    ):
+                        # str(OSError) renders from errno/strerror, not
+                        # args — and args must stay (errno, strerror)
+                        # shaped for cross-process reconstruction, so
+                        # the tag goes on the strerror field
+                        e.strerror = tag + e.strerror
+                    elif e.args and isinstance(e.args[0], str):
+                        e.args = (tag + e.args[0],) + e.args[1:]
+                    else:
+                        # exotic arg shapes (fixed-arity/structured
+                        # constructors): args mutation would break
+                        # type(e)(*e.args) reconstruction — attach the
+                        # index as an attribute only
+                        e.block_index = b_cur
                 err.append(e)
             finally:
                 put(sentinel)
@@ -362,6 +390,93 @@ class FeatureBlockStore:
                     q.get_nowait()
             except queue.Empty:
                 pass
+
+    def iter_device_blocks(
+        self,
+        order: Sequence[int],
+        prefetch: int = 2,
+        stage=None,
+        window: int = 2,
+    ) -> Iterator[Tuple[int, object]]:
+        """Double-buffered device feed: yield ``(b, staged_block)`` with
+        the host→device transfer of the NEXT block(s) already dispatched
+        while the consumer computes on the current one.
+
+        Three overlapped tiers: disk→host read-ahead rides
+        :meth:`iter_blocks`'s producer thread (``prefetch`` deep);
+        host→device staging is dispatched ``window`` blocks ahead of the
+        consumer, so block *b+1*'s transfer overlaps block *b*'s
+        compute; and the consumer's own device step is async-dispatched
+        as usual.  ``stage(host_block) -> device value`` performs the
+        put (default: ``jax.device_put`` + on-device f32 cast for bf16
+        stores); a pytree return (tuple/list of arrays) is dispatched as
+        ONE batched ``jax.device_put``-style transfer — callers staging
+        multiple arrays per block should return them together rather
+        than staging serially.
+
+        Flow control WITHOUT host round-trips: before a block is
+        yielded, ``jax.block_until_ready`` confirms its transfer landed
+        (by then it was dispatched ``window`` iterations earlier, so the
+        wait is usually zero).  That bounds in-flight staged host
+        buffers to ``window`` blocks and guarantees every yielded block
+        is safe for the consumer to DONATE to its compute step (a
+        donated buffer cannot be waited on afterwards).  It bounds
+        TRANSFERS only: transfers are not ordered behind compute, so a
+        consumer whose per-block step is slower than the wire must also
+        bound its own dispatch lead with a ready-wait on a recent step
+        output (as ``_oc_bcd_fit`` does on the step's tick two behind) —
+        otherwise yielded blocks pile up in HBM pinned by the queued
+        executions that consume them.
+        Time spent blocked in staging is recorded as the
+        ``blockstore.stage_wait_seconds`` histogram — the obs ledger's
+        ``transfer_seconds`` account.
+        """
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        from collections import deque
+
+        if stage is None:
+
+            def stage(blk):
+                a = jax.device_put(blk)
+                if a.dtype != jnp.float32:
+                    a = a.astype(jnp.float32)
+                return a
+
+        window = max(1, int(window))
+        staged: deque = deque()  # (b, value): transfer dispatched, not yielded
+
+        def land(item):
+            b, dev = item
+            t0 = time.perf_counter()
+            dev = jax.block_until_ready(dev)
+            metrics.observe(
+                "blockstore.stage_wait_seconds", time.perf_counter() - t0
+            )
+            return b, dev
+
+        it = self.iter_blocks(order, prefetch=prefetch)
+        try:
+            for b, blk in it:
+                t0 = time.perf_counter()
+                dev = stage(blk)
+                # the dispatch itself does real host work (layout copy +
+                # DMA enqueue; on tunneled backends the RPC) — charge it
+                # to the same transfer account as the landing wait
+                metrics.observe(
+                    "blockstore.stage_wait_seconds",
+                    time.perf_counter() - t0,
+                )
+                staged.append((b, dev))
+                if len(staged) > window:
+                    yield land(staged.popleft())
+            while staged:
+                yield land(staged.popleft())
+        finally:
+            it.close()
+            staged.clear()
 
     def nbytes(self) -> int:
         itemsize = 2 if self.dtype == "bfloat16" else 4

@@ -501,6 +501,117 @@ def _stages(op) -> list:
     return list(t.stages) if isinstance(t, FusedTransformer) else [t]
 
 
+class PallasFvFusionRule(Rule):
+    """Collapse the FV hot path's per-stage dispatch chain into the
+    fused Pallas forward megakernel.
+
+    An adjacent single-consumer ``PCATransformer → FisherVector`` pair
+    becomes ONE ``FusedPcaFisherVector`` node
+    (ops/fisher_pallas.fused_forward_pallas): descriptors stream from
+    HBM once instead of round-tripping between the stages, and the
+    per-stage program launches become one.  When the upstream
+    ``SIFTExtractor`` feeds the PCA exclusively, its L2→clamp→re-L2
+    normalize tail is absorbed into the kernel too (the extractor is
+    swapped for a raw-descriptor copy), making the fused node a true
+    sift-normalize → PCA-project → FV-encode forward.
+
+    Fires only when the computation targets a Pallas-capable device
+    (``pallas_supported()``); CPU meshes and dryruns keep the pre-rule
+    graph, so compile-count and byte-identity pins are untouched.
+    ``KEYSTONE_FUSED_FV=0`` disables the rule outright (the operator's
+    escape hatch, mirroring the transformer's ``use_pallas=False``)."""
+
+    name = "PallasFvFusion"
+
+    def apply(self, graph: G.Graph) -> G.Graph:
+        import os
+
+        if os.environ.get("KEYSTONE_FUSED_FV", "1") == "0":
+            return graph
+        from keystone_tpu.ops.fisher_pallas import pallas_supported
+
+        if not pallas_supported():
+            return graph
+        import copy
+
+        from keystone_tpu.models.pca import PCATransformer
+        from keystone_tpu.ops.fisher import FisherVector, FusedPcaFisherVector
+        from keystone_tpu.ops.sift import SIFTExtractor
+
+        def _plain(op) -> bool:
+            # degradation-declaring stages must stay standalone nodes
+            # (same contract as _fusable): the executor degrades THEM,
+            # not a fused stranger
+            return (
+                isinstance(op, G.TransformerOperator)
+                and not getattr(op.transformer, "optional", False)
+                and getattr(op.transformer, "fallback", None) is None
+            )
+
+        changed = True
+        while changed:
+            changed = False
+            for n in graph.topological_nodes():
+                op = graph.operators.get(n)
+                if not _plain(op) or not isinstance(
+                    op.transformer, PCATransformer
+                ):
+                    continue
+                deps_on_n = graph.dependents(n)
+                if len(deps_on_n) != 1 or isinstance(deps_on_n[0], G.SinkId):
+                    continue
+                m = deps_on_n[0]
+                mop = graph.operators.get(m)
+                if (
+                    not _plain(mop)
+                    or not isinstance(mop.transformer, FisherVector)
+                    or graph.dependencies[m] != (n,)
+                ):
+                    continue
+                fv = mop.transformer
+                if fv.use_pallas is False:
+                    continue  # an explicit opt-out covers the fused form too
+                # absorb the SIFT normalize tail when the extractor's
+                # output feeds ONLY this PCA (a shared extractor must
+                # keep emitting normalized descriptors for its other
+                # consumers — vocabulary samplers in the fit graph)
+                sift_normalize = False
+                pca_deps = graph.dependencies[n]
+                if len(pca_deps) == 1:
+                    s = pca_deps[0]
+                    sop = graph.operators.get(s)
+                    if (
+                        _plain(sop)
+                        and isinstance(sop.transformer, SIFTExtractor)
+                        and sop.transformer.normalize
+                        and tuple(graph.dependents(s)) == (n,)
+                    ):
+                        raw_sift = copy.copy(sop.transformer)
+                        raw_sift.normalize = False
+                        graph = graph.set_operator(
+                            s, G.TransformerOperator(raw_sift)
+                        )
+                        sift_normalize = True
+                fused_op = G.TransformerOperator(
+                    FusedPcaFisherVector(
+                        op.transformer,
+                        fv.gmm,
+                        sift_normalize=sift_normalize,
+                        use_pallas=fv.use_pallas,
+                    )
+                )
+                # the fused node's output is m's output — carry the
+                # cache rule's over-budget flag (see StageFusionRule)
+                if getattr(mop, "no_memoize", False):
+                    fused_op.no_memoize = True
+                graph = graph.set_operator(m, fused_op)
+                graph = graph.set_dependencies(m, graph.dependencies[n])
+                graph = graph.remove_node(n)
+                changed = True
+                break
+        return graph
+
+
 # ------------------------------------------------------------------ default
 class ProfiledMaterializeRule(Rule):
     """Default materialization pass (r2): the HBM-budgeted
@@ -554,6 +665,11 @@ def default_optimizer(
                 Once(),
                 [ProfiledMaterializeRule(materialize_sample_size)],
             ),
-            RuleBatch("fusion", Once(), [StageFusionRule()]),
+            # Pallas FV fusion first: it targets the (non-fusable)
+            # PCA→FV pair specifically, before the generic chain fuser
+            # sweeps the remaining linear runs
+            RuleBatch(
+                "fusion", Once(), [PallasFvFusionRule(), StageFusionRule()]
+            ),
         ]
     )

@@ -22,13 +22,14 @@ def sites():
     return lint.load_registered_sites()
 
 
-def _run(src, sites, supervised=False, metric_kinds=None):
+def _run(src, sites, supervised=False, metric_kinds=None, solver_scoped=False):
     return lint.lint_source(
         "seeded.py",
         src,
         sites,
         metric_kinds if metric_kinds is not None else {},
         supervised=supervised,
+        solver_scoped=solver_scoped,
     )
 
 
@@ -102,6 +103,49 @@ def test_supervised_prefixes_cover_guard_layer():
     assert lint._is_supervised("keystone_tpu/utils/guard.py")
     assert lint._is_supervised("keystone_tpu/serve/service.py")
     assert not lint._is_supervised("keystone_tpu/pipelines/timit.py")
+
+
+# ------------------------------------------------- seeded: host-sync
+def test_host_sync_rule_fires_in_solver_loops(sites):
+    src = "for b in order:\n    bound = np.asarray(w[:1, :1])\n"
+    assert [x.rule for x in _run(src, sites, solver_scoped=True)] == [
+        "host-sync"
+    ]
+    # same code outside the solver sweep modules is not the rule's business
+    assert not _run(src, sites)
+    # .tolist() in a while loop is the same stall
+    v = _run(
+        "while not done:\n    vals = p.tolist()\n", sites, solver_scoped=True
+    )
+    assert [x.rule for x in v] == ["host-sync"]
+
+
+def test_host_sync_rule_scoping_and_escape(sites):
+    # outside a loop: checkpoint restores legitimately np.asarray host data
+    assert not _run("w = np.asarray(z['w'])\n", sites, solver_scoped=True)
+    # the visible escape hatch for deliberate, obs-gated reads
+    assert not _run(
+        "for e in range(n):\n"
+        "    obj = np.asarray(objective)  # lint: allow-host-sync\n",
+        sites,
+        solver_scoped=True,
+    )
+    # nested loops must not double-report one call
+    v = _run(
+        "for e in range(n):\n"
+        "    for b in range(nb):\n"
+        "        x = np.asarray(w)\n",
+        sites,
+        solver_scoped=True,
+    )
+    assert [x.rule for x in v] == ["host-sync"]
+
+
+def test_solver_sync_prefixes_cover_solver_modules():
+    assert lint._is_solver_sweep("keystone_tpu/models/block_ls.py")
+    assert lint._is_solver_sweep("keystone_tpu/models/block_weighted_ls.py")
+    assert lint._is_solver_sweep("keystone_tpu/models/lbfgs.py")
+    assert not lint._is_solver_sweep("keystone_tpu/workflow/executor.py")
 
 
 # ------------------------------------------------- seeded: obs-gating

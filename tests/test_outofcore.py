@@ -354,8 +354,6 @@ def test_oc_prefetch_env_override(tmp_path, monkeypatch):
     monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "5")
     assert _oc_prefetch() == 5
     assert _oc_prefetch(3) == 3
-    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "junk")
-    assert _oc_prefetch() == 2  # malformed env falls back, with a warning
 
     monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "4")
     seen = _prefetch_spy(monkeypatch)
@@ -366,6 +364,40 @@ def test_oc_prefetch_env_override(tmp_path, monkeypatch):
     store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
     est.fit_store(store, Dataset(y, n=y.shape[0]))
     assert seen and all(p == 4 for p in seen), seen
+
+
+@pytest.mark.parametrize("bad", ["junk", "eight", "0", "-3", "100000", "2.5"])
+def test_oc_prefetch_rejects_garbage_env(monkeypatch, bad):
+    """Garbage KEYSTONE_OC_PREFETCH values used to be silently coerced
+    to the default — the operator believed the tuning was in effect
+    while the sweep ran at depth 2 (or, for a huge depth, pinned
+    n×block_size host blocks until the OOM killer fired).  Now they
+    raise a ValueError naming the variable."""
+    from keystone_tpu.models.block_ls import _oc_prefetch
+
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", bad)
+    with pytest.raises(ValueError, match="KEYSTONE_OC_PREFETCH"):
+        _oc_prefetch()
+    # an explicit caller value is still authoritative over a bad env
+    assert _oc_prefetch(3) == 3
+
+
+def test_oc_prefetch_defaults_and_bounds(monkeypatch):
+    from keystone_tpu.models.block_ls import _OC_PREFETCH_MAX, _oc_prefetch
+
+    monkeypatch.delenv("KEYSTONE_OC_PREFETCH", raising=False)
+    assert _oc_prefetch() == 2  # unset → the measured default
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "")
+    assert _oc_prefetch() == 2  # empty string counts as unset
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", str(_OC_PREFETCH_MAX))
+    assert _oc_prefetch() == _OC_PREFETCH_MAX  # inclusive upper bound
+    # the explicit fit argument rides the SAME bound as the env var —
+    # fit_store(prefetch=100000) is the identical OOM footgun
+    monkeypatch.delenv("KEYSTONE_OC_PREFETCH", raising=False)
+    with pytest.raises(ValueError, match="prefetch=100000"):
+        _oc_prefetch(100000)
+    with pytest.raises(ValueError, match="prefetch=0"):
+        _oc_prefetch(0)
 
 
 def test_oc_row_mismatch_raises_before_sweep(tmp_path):
@@ -388,6 +420,249 @@ def test_oc_row_mismatch_raises_before_sweep(tmp_path):
             1,
             False,
         )
+
+
+# ------------------------------------------- async device feed + donation
+
+
+def test_iter_device_blocks_order_and_values(tmp_path):
+    """The staged feed yields the same (index, block) sequence as the
+    host iterator, cast to f32 on device (bf16 stores included)."""
+    import ml_dtypes
+
+    x = np.random.default_rng(21).normal(size=(12, 20)).astype(np.float32)
+    for dtype in ("float32", "bfloat16"):
+        store = FeatureBlockStore.from_array(
+            str(tmp_path / dtype), x, block_size=8, dtype=dtype
+        )
+        order = [0, 2, 1, 0]
+        seen = list(store.iter_device_blocks(order, prefetch=2))
+        assert [b for b, _ in seen] == order
+        for b, dev in seen:
+            assert dev.dtype == jnp.float32
+            want = np.asarray(store.read_block(b), np.float32)
+            if dtype == "bfloat16":
+                want = x[:, b * 8 : (b + 1) * 8].astype(
+                    ml_dtypes.bfloat16
+                ).astype(np.float32)
+                want = np.pad(want, ((0, 0), (0, 8 - want.shape[1])))
+            np.testing.assert_allclose(np.asarray(dev), want)
+
+
+def test_iter_device_blocks_keeps_blocks_in_flight(tmp_path):
+    """The overlap pin: when the consumer takes block b, the feed has
+    already DISPATCHED the staging of at least one later block — the
+    double-buffering that lets transfer b+1 overlap compute b."""
+    x = np.random.default_rng(22).normal(size=(8, 40)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=8)
+    staged_at_yield = []
+    staged = []
+
+    def spy_stage(blk):
+        staged.append(len(staged))
+        return jnp.asarray(blk)
+
+    gen = store.iter_device_blocks(range(5), prefetch=2, stage=spy_stage)
+    for i, (b, dev) in enumerate(gen):
+        staged_at_yield.append(len(staged))
+    # at the first yield, ≥ 2 blocks were already staged (the in-flight
+    # window); every later yield keeps ≥ 1 block ahead until the tail
+    assert staged_at_yield[0] >= 2, staged_at_yield
+    assert all(
+        s > i + 1 for i, s in enumerate(staged_at_yield[:-2])
+    ), staged_at_yield
+
+
+def test_iter_device_blocks_bounds_inflight_window(tmp_path):
+    """Backpressure: the feed never runs more than `window` staged
+    blocks ahead of the consumer (pinned host buffers stay bounded)."""
+    x = np.random.default_rng(23).normal(size=(8, 80)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=8)
+    staged = []
+
+    def spy_stage(blk):
+        staged.append(1)
+        return jnp.asarray(blk)
+
+    consumed = 0
+    for b, dev in store.iter_device_blocks(
+        range(10), prefetch=2, stage=spy_stage, window=2
+    ):
+        consumed += 1
+        assert len(staged) - consumed <= 2, (len(staged), consumed)
+
+
+def test_iter_blocks_error_carries_block_index(tmp_path, monkeypatch):
+    """A failing read mid-sweep must say WHICH block died — and keep its
+    exception type (retry/except dispatch downstream keys on it)."""
+    from keystone_tpu.utils.durable import CorruptStateError
+
+    x = np.random.default_rng(24).normal(size=(8, 24)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=8)
+    orig = FeatureBlockStore.read_block
+
+    def failing(self, b):
+        if b == 2:
+            raise CorruptStateError("checksum mismatch")
+        return orig(self, b)
+
+    monkeypatch.setattr(FeatureBlockStore, "read_block", failing)
+    with pytest.raises(CorruptStateError, match="block 2") as ei:
+        list(store.iter_blocks([0, 1, 2]))
+    assert "checksum mismatch" in str(ei.value)
+
+
+def test_iter_blocks_oserror_carries_block_index(tmp_path, monkeypatch):
+    """OSError is the primary disk-failure class and renders str() from
+    errno/strerror, not args — the block tag must land on strerror (so
+    the operator sees it) while args stay (errno, strerror) shaped (so
+    cross-process reconstruction is not corrupted)."""
+    import errno
+
+    x = np.random.default_rng(24).normal(size=(8, 24)).astype(np.float32)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=8)
+    orig = FeatureBlockStore.read_block
+
+    def failing(self, b):
+        if b == 1:
+            raise FileNotFoundError(
+                errno.ENOENT, "No such file or directory", "blk_00001.bin"
+            )
+        return orig(self, b)
+
+    monkeypatch.setattr(FeatureBlockStore, "read_block", failing)
+    with pytest.raises(FileNotFoundError, match="block 1") as ei:
+        list(store.iter_blocks([0, 1, 2]))
+    e = ei.value
+    assert "No such file" in str(e)
+    assert e.errno == errno.ENOENT  # reconstruction fields intact
+    assert e.args[0] == errno.ENOENT
+    assert e.filename == "blk_00001.bin"
+
+
+def test_oc_block_step_donates_carry(tmp_path):
+    """The donation pin: the carried (p, w_b) buffers are CONSUMED by
+    the step (is_deleted under live references — refcount alone could
+    never do that), so the epoch loop cannot grow live device state."""
+    import jax
+
+    from keystone_tpu.models.block_ls import _oc_block_step
+
+    n, bs, k = 16, 8, 3
+    rng = np.random.default_rng(25)
+    a = jnp.asarray(rng.normal(size=(n, bs)).astype(np.float32))
+    xm_b = jnp.zeros((bs,), jnp.float32)
+    yc = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+    sa = jnp.ones((n,), jnp.float32)
+    row_ok = jnp.ones((n,), jnp.float32)
+    p = jnp.zeros((n, k), jnp.float32)
+    wb = jnp.zeros((bs, k), jnp.float32)
+    wb2, p2, tick = _oc_block_step(
+        a, xm_b, yc, sa, row_ok, p, wb, jnp.float32(0.1)
+    )
+    jax.block_until_ready(p2)
+    assert p.is_deleted() and wb.is_deleted()
+    assert not yc.is_deleted() and not a.is_deleted()
+    # the tick (the sweep's flow-control handle) is NOT donated: it must
+    # stay waitable after later steps consume the real outputs
+    wb3, p3, _ = _oc_block_step(
+        a, xm_b, yc, sa, row_ok, p2, wb2, jnp.float32(0.1)
+    )
+    assert not tick.is_deleted()
+    jax.block_until_ready(tick)
+
+    # the live-buffer pin: repeated steps do not accumulate device arrays
+    import gc
+
+    gc.collect()
+    baseline = len(jax.live_arrays())
+    for _ in range(4):
+        wb3, p3, tick = _oc_block_step(
+            a, xm_b, yc, sa, row_ok, p3, wb3, jnp.float32(0.1)
+        )
+    jax.block_until_ready(p3)
+    del tick
+    gc.collect()
+    assert len(jax.live_arrays()) <= baseline + 1  # no per-epoch growth
+
+
+def test_bcd_epoch_donates_carry():
+    import jax
+
+    from keystone_tpu.models.block_ls import _bcd_epoch, blockify
+
+    rng = np.random.default_rng(26)
+    x = rng.normal(size=(16, 12)).astype(np.float32)
+    y = rng.normal(size=(16, 3)).astype(np.float32)
+    xb = blockify(jnp.asarray(x), 8)
+    w = jnp.zeros((xb.shape[0], 8, 3), jnp.float32)
+    p = jnp.zeros((16, 3), jnp.float32)
+    w2, p2 = _bcd_epoch(xb, jnp.asarray(y), jnp.float32(16.0), 1e-3, w, p)
+    jax.block_until_ready(w2)
+    assert w.is_deleted() and p.is_deleted()
+    assert not xb.is_deleted()
+
+
+def test_lbfgs_chunk_donates_carry(tmp_path):
+    """The resumable L-BFGS driver's scan carry is donated between
+    chunks: all carry leaves are consumed, so the 2·m weight-sized
+    history buffers never exist twice across a chunk boundary."""
+    import jax
+
+    from keystone_tpu.models.lbfgs import lbfgs_minimize_resumable
+
+    rng = np.random.default_rng(27)
+    x = jnp.asarray(rng.normal(size=(32, 6)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(32, 2)).astype(np.float32))
+
+    captured = []
+
+    def save_cb(it, carry):
+        captured.append(tuple(carry))
+
+    def vag(data, w):
+        xd, yd = data
+        r = xd @ w - yd
+        return 0.5 * jnp.vdot(r, r), xd.T @ r
+
+    w = lbfgs_minimize_resumable(
+        vag,
+        (x, y),
+        jnp.zeros((6, 2), jnp.float32),
+        max_iter=6,
+        history=3,
+        checkpoint_every=3,
+        save_cb=save_cb,
+    )
+    jax.block_until_ready(w)
+    assert len(captured) == 2
+    # the first chunk's carry was donated INTO the second chunk
+    assert all(leaf.is_deleted() for leaf in captured[0])
+    # the final carry is live (its iterate was just returned)
+    assert not captured[1][0].is_deleted()
+
+
+def test_oc_fit_dataflow_in_obs_summary(tmp_path):
+    """An out-of-core fit under a run ledger reports the dataflow
+    accounts (device-busy + transfer seconds) the bench artifact embeds."""
+    from keystone_tpu.obs import ledger, metrics
+    from tools.obs_report import summarize
+
+    metrics.REGISTRY.reset()
+    x, y, _ = _problem(seed=31)
+    store = FeatureBlockStore.from_array(str(tmp_path / "s"), x, block_size=16)
+    led = ledger.start_run(str(tmp_path / "obs"))
+    try:
+        est = BlockLeastSquaresEstimator(block_size=16, num_iter=2, lam=1e-2)
+        est.fit_store(store, Dataset(y, n=y.shape[0]))
+        path = led.path
+    finally:
+        ledger.stop_run()
+    s = summarize(path)
+    df = s["dataflow"]
+    assert df["device_busy_seconds"] > 0
+    assert df["transfer_seconds"] > 0
+    assert 0 < df["device_busy_fraction"] or df["device_busy_fraction"] == 0
 
 
 def test_iter_blocks_close_joins_producer(tmp_path):

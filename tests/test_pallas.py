@@ -105,6 +105,214 @@ def test_fisher_vector_auto_mode_selects_by_gamma_size(monkeypatch):
     assert calls == []
 
 
+# ------------------------------------------------ fused forward megakernel
+
+
+def _fused_setup(n=3, t=150, d_in=32, d=16, k=8, seed=1):
+    rng = np.random.default_rng(seed)
+    raw = rng.normal(size=(n, t, d_in)).astype(np.float32)
+    mask = (rng.random((n, t)) < 0.8).astype(np.float32)
+    comp = np.linalg.qr(rng.normal(size=(d_in, d)))[0].astype(np.float32)
+    mean = (0.1 * rng.normal(size=(d_in,))).astype(np.float32)
+    w = np.abs(rng.random(k)).astype(np.float32)
+    w /= w.sum()
+    mu = rng.normal(size=(k, d)).astype(np.float32)
+    var = (0.5 + rng.random((k, d))).astype(np.float32)
+    return raw, mask, comp, mean, w, mu, var
+
+
+def _chain_reference(raw, mask, comp, mean, w, mu, var, normalize):
+    """The unfused per-stage path the megakernel must match."""
+    from keystone_tpu.ops.sift import _sift_normalize
+
+    z = jnp.asarray(raw)
+    if normalize:
+        z = _sift_normalize(z)
+    if mean is not None:
+        z = z - mean
+    z = z @ jnp.asarray(comp)
+    return np.asarray(_fisher_encode(z, jnp.asarray(mask), w, mu, var))
+
+
+@pytest.mark.parametrize("normalize", [True, False])
+@pytest.mark.parametrize("with_mean", [True, False])
+def test_fused_forward_matches_unfused_chain(normalize, with_mean):
+    from keystone_tpu.ops.fisher_pallas import fused_forward_pallas
+
+    raw, mask, comp, mean, w, mu, var = _fused_setup()
+    mean_arg = mean if with_mean else None
+    ref = _chain_reference(raw, mask, comp, mean_arg, w, mu, var, normalize)
+    got = np.asarray(
+        fused_forward_pallas(
+            raw, mask, comp, mean_arg, w, mu, var,
+            interpret=True, normalize=normalize,
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=3e-5)
+
+
+def test_fused_forward_multi_tile_accumulation(monkeypatch):
+    """Multiple descriptor tiles exercise the revolving accumulators AND
+    the in-kernel normalize/projection of tile PADDING rows (masked to
+    zero contribution)."""
+    from keystone_tpu.ops import fisher_pallas as fp
+
+    monkeypatch.setattr(fp, "_VMEM_TILE_BUDGET", 1 << 17)
+    raw, mask, comp, mean, w, mu, var = _fused_setup(t=1500)
+    assert -(-1500 // fp._tile_t(1500, 8, 32 + 16)) >= 2
+    ref = _chain_reference(raw, mask, comp, mean, w, mu, var, True)
+    got = np.asarray(
+        fp.fused_forward_pallas(
+            raw, mask, comp, mean, w, mu, var, interpret=True, normalize=True
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=3e-5)
+
+
+def test_fused_forward_bf16_stream_tolerance():
+    """Under the bf16 policies the descriptor stream crosses HBM at half
+    width; the encode must stay within bf16-quantization tolerance of
+    the f32 kernel (compute is f32 in VMEM either way)."""
+    from keystone_tpu.ops.fisher_pallas import fused_forward_pallas
+
+    raw, mask, comp, mean, w, mu, var = _fused_setup(seed=3)
+    f32 = np.asarray(
+        fused_forward_pallas(
+            raw, mask, comp, mean, w, mu, var, interpret=True, normalize=True
+        )
+    )
+    for mode in ("bf16", "bf16_apply"):
+        half = np.asarray(
+            fused_forward_pallas(
+                raw, mask, comp, mean, w, mu, var,
+                interpret=True, mxu=mode, normalize=True,
+            )
+        )
+        # raw descriptors are O(1); bf16 has an 8-bit mantissa
+        np.testing.assert_allclose(half, f32, atol=5e-2)
+        assert np.abs(half - f32).max() > 0  # the cast actually happened
+
+
+def test_fused_transformer_fallback_matches_chain():
+    """Off-TPU the FusedPcaFisherVector transformer applies the
+    IDENTICAL math as the PCATransformer → FisherVector chain."""
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.ops.fisher import FisherVector, FusedPcaFisherVector
+
+    raw, mask, comp, mean, w, mu, var = _fused_setup(seed=5)
+    pca = PCATransformer(jnp.asarray(comp), mean=jnp.asarray(mean))
+    gmm = GaussianMixtureModel(
+        jnp.asarray(w), jnp.asarray(mu), jnp.asarray(var)
+    )
+    z, m2 = pca.apply_batch(jnp.asarray(raw), mask=jnp.asarray(mask))
+    want = np.asarray(FisherVector(gmm).apply_batch(z, mask=m2))
+    fused = FusedPcaFisherVector(pca, gmm, use_pallas=False)
+    got = np.asarray(
+        fused.apply_batch(jnp.asarray(raw), mask=jnp.asarray(mask))
+    )
+    np.testing.assert_array_equal(got, want)  # same ops, same bits
+    # the sift_normalize variant folds the extractor's tail in front
+    from keystone_tpu.ops.sift import _sift_normalize
+
+    fused_n = FusedPcaFisherVector(
+        pca, gmm, sift_normalize=True, use_pallas=False
+    )
+    z2, _ = pca.apply_batch(_sift_normalize(jnp.asarray(raw)), mask=jnp.asarray(mask))
+    want_n = np.asarray(FisherVector(gmm).apply_batch(z2, mask=m2))
+    got_n = np.asarray(
+        fused_n.apply_batch(jnp.asarray(raw), mask=jnp.asarray(mask))
+    )
+    np.testing.assert_array_equal(got_n, want_n)
+
+
+def test_fused_transformer_routes_to_pallas(monkeypatch):
+    """When the backend is Pallas-capable and γ crosses the threshold,
+    the transformer dispatches the fused kernel (one program)."""
+    from keystone_tpu.models.gmm import GaussianMixtureModel
+    from keystone_tpu.models.pca import PCATransformer
+    from keystone_tpu.ops import fisher as fisher_mod
+    from keystone_tpu.ops import fisher_pallas as fp_mod
+    from keystone_tpu.ops.fisher import FusedPcaFisherVector
+
+    raw, mask, comp, mean, w, mu, var = _fused_setup(
+        t=fisher_mod.FisherVector._PALLAS_GAMMA_THRESHOLD // 8
+    )
+    calls = []
+
+    def fake_fused(xs, mask_, comp_, mean_, w_, mu_, var_, **kw):
+        calls.append(kw.get("normalize"))
+        return jnp.zeros(
+            (xs.shape[0], 2 * mu_.shape[0] * mu_.shape[1]), jnp.float32
+        )
+
+    monkeypatch.setattr(fp_mod, "pallas_supported", lambda x=None: True)
+    monkeypatch.setattr(fp_mod, "fused_forward_pallas", fake_fused)
+    pca = PCATransformer(jnp.asarray(comp), mean=jnp.asarray(mean))
+    gmm = GaussianMixtureModel(
+        jnp.asarray(w), jnp.asarray(mu), jnp.asarray(var)
+    )
+    FusedPcaFisherVector(pca, gmm, sift_normalize=True).apply_batch(
+        jnp.asarray(raw), mask=jnp.asarray(mask)
+    )
+    assert calls == [True]
+
+
+def test_pallas_fv_fusion_rule_rewrites_and_matches(monkeypatch):
+    """End to end: on a Pallas-capable mesh the optimizer rule collapses
+    each branch's PCA → FV pair into one fused node (absorbing the
+    exclusive SIFT feed's normalize), and the rewritten pipeline scores
+    identically (the CPU fallback is the bit-identical chain)."""
+    import keystone_tpu.ops.fisher_pallas as fp
+    from keystone_tpu.loaders.imagenet import ImageNetLoader
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        Config,
+        ImageNetSiftLcsFV,
+    )
+    from keystone_tpu.workflow.optimizer import PallasFvFusionRule
+
+    cfg = Config(
+        num_classes=4, synthetic_n=16, image_size=32, gmm_k=4, pca_dims=8,
+        gmm_iters=2, num_epochs=1,
+    )
+    train = ImageNetLoader.synthetic(16, 4, size=(32, 32), seed=1)
+    fitted = ImageNetSiftLcsFV.build(cfg, train.data, train.labels).fit()
+    test = ImageNetLoader.synthetic(8, 4, size=(32, 32), seed=2)
+    base = fitted(test.data).get().numpy()
+
+    g = fitted.graph
+    # inert off-TPU: the CPU graph is untouched (compile-count pins ride
+    # the pre-rule path)
+    assert PallasFvFusionRule().apply(g) is g
+    with monkeypatch.context() as mp:
+        mp.setattr(fp, "pallas_supported", lambda x=None: True)
+        g2 = PallasFvFusionRule().apply(g)
+        # the kill switch wins even on capable devices
+        mp.setenv("KEYSTONE_FUSED_FV", "0")
+        assert PallasFvFusionRule().apply(g) is g
+    labels = {
+        getattr(g2.operators.get(n), "transformer", None)
+        and g2.operators[n].transformer.label
+        for n in g2.topological_nodes()
+    }
+    assert "FusedFV[SiftNorm > PCA > FV]" in labels  # SIFT branch, absorbed
+    assert "FusedFV[PCA > FV]" in labels  # LCS branch
+    assert not any(lbl == "PCATransformer" for lbl in labels if lbl)
+    # SIFT now emits raw descriptors for the fused consumer
+    sift = next(
+        g2.operators[n].transformer
+        for n in g2.topological_nodes()
+        if getattr(
+            getattr(g2.operators.get(n), "transformer", None), "label", ""
+        )
+        == "SIFTExtractor"
+    )
+    assert sift.normalize is False
+    fitted.graph = g2
+    fused_out = fitted(test.data).get().numpy()
+    np.testing.assert_array_equal(fused_out, base)
+
+
 def test_fisher_vector_transformer_pallas_flag():
     from keystone_tpu.models.gmm import GaussianMixtureModel
     from keystone_tpu.ops.fisher import FisherVector

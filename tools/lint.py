@@ -30,7 +30,13 @@ Rules:
   ``# lint: allow-wall-clock`` comment;
 - ``obs-gating``   — a variable bound from ``ledger.active()`` is only
   dereferenced under an ``is not None`` guard (the inert-hook
-  contract: one ``None`` check when obs is off).
+  contract: one ``None`` check when obs is off);
+- ``host-sync``    — no ``np.asarray(...)`` / ``.tolist()`` inside a
+  ``for``/``while`` loop of the solver sweep modules (block_ls,
+  block_weighted_ls, lbfgs): a host read of a device value there
+  stalls the async dispatch pipeline the fit-path dataflow relies on
+  (double-buffered staging + donated epoch carries).  A deliberate,
+  obs-gated read takes a trailing ``# lint: allow-host-sync``.
 
 Escape hatch: a trailing ``# lint: allow-<rule>`` comment allowlists
 one line, visibly.
@@ -78,6 +84,16 @@ SUPERVISED_PREFIXES = (
     "keystone_tpu/loaders/stream.py",
     "keystone_tpu/parallel/multihost.py",
     "keystone_tpu/serve/",
+)
+
+#: solver modules whose BCD sweep / epoch loops ride the async fit-path
+#: dataflow: an un-annotated host sync inside a loop there silently
+#: re-serializes the double-buffered feed a future edit can't see
+#: locally.  Scoped per-file like the wall-clock rule.
+SOLVER_SYNC_PREFIXES = (
+    "keystone_tpu/models/block_ls.py",
+    "keystone_tpu/models/block_weighted_ls.py",
+    "keystone_tpu/models/lbfgs.py",
 )
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow-([a-z-]+)")
@@ -147,6 +163,11 @@ def _str_arg0(call: ast.Call) -> Optional[Tuple[str, int]]:
 def _is_supervised(rel_path: str) -> bool:
     rel = rel_path.replace(os.sep, "/")
     return any(rel.startswith(p) or rel == p.rstrip("/") for p in SUPERVISED_PREFIXES)
+
+
+def _is_solver_sweep(rel_path: str) -> bool:
+    rel = rel_path.replace(os.sep, "/")
+    return any(rel.startswith(p) for p in SOLVER_SYNC_PREFIXES)
 
 
 # ------------------------------------------------------------ obs gating
@@ -278,10 +299,12 @@ def lint_source(
     sites: frozenset,
     metric_kinds: Dict[str, Tuple[str, str, int]],
     supervised: Optional[bool] = None,
+    solver_scoped: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one file's source.  ``metric_kinds`` accumulates
     name → (kind, path, line) across files for the metric-kind rule.
-    ``supervised`` overrides the path-based wall-clock scoping (tests)."""
+    ``supervised`` overrides the path-based wall-clock scoping, and
+    ``solver_scoped`` the host-sync scoping (tests)."""
     out: List[Violation] = []
     lines = source.splitlines()
     try:
@@ -290,6 +313,8 @@ def lint_source(
         return [Violation(rel_path, e.lineno or 0, "syntax", str(e))]
     if supervised is None:
         supervised = _is_supervised(rel_path)
+    if solver_scoped is None:
+        solver_scoped = _is_solver_sweep(rel_path)
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -374,6 +399,44 @@ def lint_source(
                     "'# lint: allow-wall-clock' for a true timestamp)",
                 )
             )
+
+    # ---- host-sync: np.asarray / .tolist() inside solver sweep loops
+    if solver_scoped:
+        seen_syncs: set = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                sync = None
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "asarray"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                ):
+                    sync = "np.asarray(...)"
+                elif isinstance(f, ast.Attribute) and f.attr == "tolist":
+                    sync = ".tolist()"
+                if sync is None or (node.lineno, sync) in seen_syncs:
+                    continue  # nested loops revisit the same call
+                seen_syncs.add((node.lineno, sync))
+                if not _allowed(lines, node.lineno, "host-sync"):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            node.lineno,
+                            "host-sync",
+                            f"{sync} inside a solver sweep/epoch loop "
+                            "forces a host sync that stalls the async "
+                            "dispatch pipeline (double-buffered feed + "
+                            "donated carries); hoist it out of the loop "
+                            "or annotate '# lint: allow-host-sync' for "
+                            "a deliberate, obs-gated read",
+                        )
+                    )
 
     # ---- obs-gating: per function scope
     scopes: List[Tuple[List[ast.stmt], ast.AST]] = [(tree.body, tree)]

@@ -162,6 +162,24 @@ def summarize(path: str, top_k: int = 10) -> dict:
         "stream_retries": io["stream_retries"],
     }
 
+    # -------------------------------------------------------- dataflow
+    # the fit-path dataflow accounts (host-side measures): seconds the
+    # host spent BLOCKED on device results (obs-gated solver syncs,
+    # ledger.device_wait) vs blocked on host→device staging
+    # (blockstore.iter_device_blocks).  The busy fraction is the
+    # tentpole metric of the async-feed work: a starved device shows a
+    # fraction near zero; a fed one approaches the solver's share of
+    # wall time.
+    def _hist_sum(name: str) -> float:
+        return sum(
+            float(h.get("sum") or 0.0)
+            for k, h in hists.items()
+            if k == name or k.startswith(name + "{")
+        )
+
+    device_busy = _hist_sum("device.busy_seconds")
+    transfer = _hist_sum("blockstore.stage_wait_seconds")
+
     memory = {
         "hbm_bytes_in_use": gauges.get("hbm.bytes_in_use"),
         "hbm_peak_bytes_in_use": gauges.get("hbm.peak_bytes_in_use"),
@@ -201,16 +219,25 @@ def summarize(path: str, top_k: int = 10) -> dict:
     run_ids = {e.get("run_id") for e in events if e.get("run_id")}
     t0 = min((e["ts"] for e in events if "ts" in e), default=None)
     t1 = max((e["ts"] for e in events if "ts" in e), default=None)
+    wall = (t1 - t0) if (t0 is not None and t1 is not None) else None
+    dataflow = {
+        "device_busy_seconds": device_busy,
+        "transfer_seconds": transfer,
+        "device_busy_fraction": (
+            device_busy / wall if wall else None
+        ),
+    }
     return {
         "path": path,
         "run_id": sorted(run_ids)[0] if run_ids else None,
         "events": len(events),
-        "wall_seconds": (t1 - t0) if (t0 is not None and t1 is not None) else None,
+        "wall_seconds": wall,
         "stage_top": stage_top,
         "retries": retries,
         "convergence": convergence,
         "io": io,
         "memory": memory,
+        "dataflow": dataflow,
         "faults": faults,
         "fault_restarts": fault_events,
     }
@@ -300,6 +327,21 @@ def render(summary: dict) -> str:
                 f"  {k}: n={h.get('count')} mean={mean * 1e3:.2f}ms "
                 f"max={(h.get('max') or 0) * 1e3:.2f}ms"
             )
+
+    df = summary.get("dataflow") or {}
+    if df.get("device_busy_seconds") or df.get("transfer_seconds"):
+        out.append("\n== fit dataflow ==")
+        out.append(
+            f"  device busy (host-blocked): "
+            f"{df.get('device_busy_seconds', 0.0):.3f}s"
+        )
+        out.append(
+            f"  transfer (h2d staging):     "
+            f"{df.get('transfer_seconds', 0.0):.3f}s"
+        )
+        frac = df.get("device_busy_fraction")
+        if frac is not None:
+            out.append(f"  device-busy fraction of wall: {frac:.1%}")
 
     mem = summary.get("memory") or {}
     if any(v is not None for v in mem.values()):
