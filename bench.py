@@ -133,6 +133,28 @@ SERVE_MAX_BATCH = 16
 SERVE_QUEUE_BOUND = 64
 SERVE_DEADLINE_MS = 250.0
 SERVE_BATCH_DELAY_MS = 10.0
+
+# --- fleet leg (ISSUE 8): the replica fleet + live blue/green hot-swap
+# under the same open-loop generator.  Offered load sits ABOVE one
+# replica's capacity (max_batch rows per 40 ms-delayed flush ≈ 0.7k QPS)
+# and below the fleet's, so achieved QPS is the scaling claim: the
+# N-replica leg must sustain more than the 1-replica leg run with the
+# IDENTICAL config (recorded side by side).  The emulated model is
+# deliberately HEAVY (40 ms per flush): flush time must dominate the
+# per-request host work (submit path, future resolution — all GIL-bound
+# Python) or a 2-core CI host measures the GIL, not the fleet.  A swap
+# fires at the offer window's midpoint; the artifact tracks per-replica
+# occupancy (router balance) and the swap pause p99 across legs (must
+# stay far under one flush interval — commit is a pointer swap, priming
+# is off-path).
+FLEET_LEGS = int(os.environ.get("BENCH_FLEET_LEGS", "1"))
+FLEET_REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", "4"))
+FLEET_QPS = 2000.0
+FLEET_DURATION_S = 3.0
+FLEET_MAX_BATCH = 32
+FLEET_QUEUE_BOUND = 256
+FLEET_DEADLINE_MS = 1500.0
+FLEET_BATCH_DELAY_MS = 40.0
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -628,6 +650,30 @@ def main():
         print(json.dumps(rep))
         return
 
+    if "--leg-serve-fleet" in sys.argv:
+        from tools import serve_bench
+
+        svc, item_shape = serve_bench.build_service(
+            max_batch=FLEET_MAX_BATCH,
+            queue_bound=FLEET_QUEUE_BOUND,
+            deadline_ms=FLEET_DEADLINE_MS,
+            replicas=FLEET_REPLICAS,
+        )
+        try:
+            rep = serve_bench.run_bench(
+                svc,
+                item_shape,
+                qps=FLEET_QPS,
+                duration=FLEET_DURATION_S,
+                deadline_ms=FLEET_DEADLINE_MS,
+                batch_delay_ms=FLEET_BATCH_DELAY_MS,
+                swap_pipeline=serve_bench.build_pipeline(seed=1),
+            )
+        finally:
+            svc.close()
+        print(json.dumps(rep))
+        return
+
     if "--leg-solver-scale" in sys.argv:
         print(json.dumps(measure_solver_at_scale()))
         return
@@ -754,6 +800,39 @@ def main():
         if lg
     ]
 
+    # fleet leg (ISSUE 8): the N-replica fleet + mid-run hot-swap, and
+    # ONE 1-replica leg with the identical config — their achieved-QPS
+    # ratio is the recorded scaling claim.  On CPU hosts the child needs
+    # the host platform split into N devices (appended, so a TPU host's
+    # existing XLA_FLAGS survive; the flag is inert off-CPU).
+    fleet_env = {
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={FLEET_REPLICAS}"
+        ).strip()
+    }
+    fleet_legs = [
+        lg
+        for lg in (
+            subprocess_leg(
+                "--leg-serve-fleet",
+                required=("achieved_qps", "replica_occupancy"),
+                env=fleet_env,
+            )
+            for _ in range(FLEET_LEGS)
+        )
+        if lg
+    ] if FLEET_LEGS > 0 else []
+    fleet_single_leg = (
+        subprocess_leg(
+            "--leg-serve-fleet",
+            required=("achieved_qps",),
+            env={**fleet_env, "BENCH_FLEET_REPLICAS": "1"},
+        )
+        if fleet_legs
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -861,6 +940,32 @@ def main():
                 if vals:
                     sv[key] = round(float(np.median(vals)), 2)
         out["serve"] = sv
+    if fleet_legs:
+        fv = dict(fleet_legs[0])
+        if len(fleet_legs) > 1:
+            for key in ("achieved_qps", "p50_ms", "p95_ms", "p99_ms"):
+                vals = [
+                    float(lg[key]) for lg in fleet_legs if lg.get(key) is not None
+                ]
+                if vals:
+                    fv[key] = round(float(np.median(vals)), 2)
+        pauses_ms = [
+            1000.0 * float(lg["swap"]["pause_seconds"])
+            for lg in fleet_legs
+            if lg.get("swap") and lg["swap"].get("pause_seconds") is not None
+        ]
+        if pauses_ms:
+            fv["swap_pause_p99_ms"] = round(
+                float(np.percentile(pauses_ms, 99)), 4
+            )
+        if fleet_single_leg and fleet_single_leg.get("achieved_qps"):
+            single = float(fleet_single_leg["achieved_qps"])
+            fv["single_replica_achieved_qps"] = round(single, 1)
+            if single > 0 and fv.get("achieved_qps"):
+                fv["fleet_speedup"] = round(
+                    float(fv["achieved_qps"]) / single, 2
+                )
+        out["serve_fleet"] = fv
     if fit_scale_legs:
         fss = [float(lg["fit_seconds"]) for lg in fit_scale_legs]
         out["fit_at_scale"] = {

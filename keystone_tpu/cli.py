@@ -39,20 +39,49 @@ def _apply_platform_env() -> None:
 
 
 def _serve_main(argv) -> int:
-    """``serve`` subcommand: load a saved fitted pipeline and expose it
-    over HTTP (POST /predict, GET /healthz, GET /metrics) through the
-    micro-batching service (keystone_tpu/serve)."""
+    """``serve`` subcommand: load a saved fitted pipeline (or the
+    current version from a model registry) and expose it over HTTP
+    (POST /predict, GET /healthz, GET /replicas, POST /swap,
+    GET /metrics) through the micro-batching replica fleet
+    (keystone_tpu/serve)."""
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="python -m keystone_tpu.cli serve",
         description="serve a saved fitted pipeline over HTTP with "
-        "dynamic micro-batching and admission control",
+        "dynamic micro-batching, admission control, a multi-device "
+        "replica fleet, and registry-driven live model hot-swap",
     )
     ap.add_argument(
         "--model",
-        required=True,
+        default=None,
         help="path to a FittedPipeline saved via save()/fit_or_load()",
+    )
+    ap.add_argument(
+        "--model-dir",
+        default=None,
+        metavar="DIR",
+        help="versioned model registry root (serve/registry.py): serve "
+        "the CURRENT version (falling back past corrupt ones), enable "
+        "POST /swap, and (with --watch) hot-swap newly published "
+        "versions live.  Exactly one of --model/--model-dir is required.",
+    )
+    ap.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="serving fleet size: one FrozenApplier clone per local "
+        "device (cycling when replicas > devices); flushes are routed "
+        "to the least-loaded replica whose breaker admits work",
+    )
+    ap.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="poll --model-dir's CURRENT pointer this often and blue/"
+        "green hot-swap new versions into the fleet (prime in the "
+        "background, commit at the flush boundary; requires --model-dir)",
     )
     ap.add_argument("--max-batch", type=int, default=32)
     ap.add_argument(
@@ -87,11 +116,25 @@ def _serve_main(argv) -> int:
         "Without it the first request per bucket compiles in-band.",
     )
     args = ap.parse_args(argv)
+    if (args.model is None) == (args.model_dir is None):
+        ap.error("exactly one of --model / --model-dir is required")
+    if args.watch is not None and args.model_dir is None:
+        ap.error("--watch requires --model-dir (a registry to poll)")
 
     from keystone_tpu.serve import HttpFrontend, serve
-    from keystone_tpu.workflow import FittedPipeline
 
-    fitted = FittedPipeline.load(args.model)
+    registry = None
+    if args.model_dir is not None:
+        from keystone_tpu.serve import ModelRegistry
+
+        registry = ModelRegistry(args.model_dir)
+        fitted, version = registry.load()
+        source = f"{args.model_dir} ({version})"
+    else:
+        from keystone_tpu.workflow import FittedPipeline
+
+        fitted = FittedPipeline.load(args.model)
+        version, source = "v0", args.model
     example = None
     if args.example_shape:
         import numpy as np
@@ -105,12 +148,23 @@ def _serve_main(argv) -> int:
         queue_bound=args.queue_bound,
         deadline_ms=args.deadline_ms,
         example=example,
+        replicas=args.replicas,
+        version=version,
     )
-    front = HttpFrontend(svc, host=args.host, port=args.port)
+    watcher = None
+    if args.watch is not None:
+        from keystone_tpu.serve import RegistryWatcher
+
+        watcher = RegistryWatcher(
+            svc, registry, poll_seconds=args.watch
+        ).start()
+    front = HttpFrontend(svc, host=args.host, port=args.port, registry=registry)
     print(
-        f"serving {args.model} on http://{args.host}:{front.port} "
-        f"(max_batch={args.max_batch}, max_wait_ms={args.max_wait_ms}, "
-        f"queue_bound={args.queue_bound})",
+        f"serving {source} on http://{args.host}:{front.port} "
+        f"(replicas={svc.replicas}, max_batch={args.max_batch}, "
+        f"max_wait_ms={args.max_wait_ms}, queue_bound={args.queue_bound}"
+        + (f", watching every {args.watch:g}s" if watcher else "")
+        + ")",
         flush=True,
     )
     try:
@@ -118,6 +172,8 @@ def _serve_main(argv) -> int:
     except KeyboardInterrupt:
         print("shutting down (draining in-flight requests)", flush=True)
     finally:
+        if watcher is not None:
+            watcher.stop()
         front.server.server_close()
         svc.close()
     return 0

@@ -77,6 +77,8 @@ SITES = {
     "executor.stage",
     "serve.enqueue",
     "serve.batch",
+    "serve.replica",
+    "serve.swap",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")

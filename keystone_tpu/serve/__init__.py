@@ -1,6 +1,8 @@
 """Online serving: dynamic micro-batching pipeline endpoint with
 admission control (the Clipper-layer over frozen keystone_tpu
-pipelines; see ``serve/service.py`` for the design).
+pipelines; see ``serve/service.py`` for the design), scaled out as a
+replica fleet with versioned live model hot-swap (``serve/fleet.py``,
+``serve/registry.py``).
 
 Deliberately NOT imported by ``keystone_tpu/__init__`` — the offline
 library import path (and every traced program) is byte-identical
@@ -8,7 +10,13 @@ whether or not a service exists in the process (pinned by
 tests/test_serve.py).
 """
 
+from keystone_tpu.serve.fleet import Replica, ReplicaPool  # noqa: F401
 from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
+from keystone_tpu.serve.registry import (  # noqa: F401
+    ModelRegistry,
+    RegistryError,
+    RegistryWatcher,
+)
 from keystone_tpu.serve.service import (  # noqa: F401
     Overloaded,
     PipelineService,
@@ -19,8 +27,13 @@ from keystone_tpu.serve.service import (  # noqa: F401
 
 __all__ = [
     "HttpFrontend",
+    "ModelRegistry",
     "Overloaded",
     "PipelineService",
+    "Replica",
+    "ReplicaPool",
+    "RegistryError",
+    "RegistryWatcher",
     "ServiceClosed",
     "default_buckets",
     "serve",

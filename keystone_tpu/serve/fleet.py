@@ -1,0 +1,514 @@
+"""Replica fleet: N frozen appliers behind a least-outstanding router.
+
+One `PipelineService` batcher thread draining onto one `FrozenApplier`
+(PR 5) saturates exactly one device; the "millions of users" direction
+(ROADMAP item 1) needs every local device serving and a live model-swap
+story.  This module is that layer:
+
+- **Replica** — one :class:`~keystone_tpu.workflow.pipeline.FrozenApplier`
+  pinned to one device.  Multi-replica pools clone the fitted pipeline
+  per replica (pickle round-trip) and re-place every fitted device array
+  with an explicit ``jax.device_put`` onto the replica's device, so each
+  flush's computation lands where its parameters live (committed inputs
+  pin XLA placement).  Each replica owns a worker thread with a private
+  flush queue — while replica 0 computes, the batcher is already
+  dispatching the next flush to replica 1 — and a per-replica
+  :class:`~keystone_tpu.utils.guard.CircuitBreaker` (key
+  ``<service>.replica.<i>``) charged by flush outcomes.
+- **ReplicaPool** — the router.  ``dispatch`` picks the replica with the
+  fewest outstanding flushes whose breaker admits work (a tripped
+  replica is routed *around* until its half-open probe); when every
+  breaker refuses, the least-loaded replica serves anyway (degraded
+  service beats refusing the whole fleet — counted as
+  ``serve.router_forced``).
+- **Blue/green swap** — ``stage()`` builds a full staged generation of
+  replicas for a new model version on the same devices (the caller
+  primes their padding-bucket programs while the old generation keeps
+  serving); ``commit()`` swaps the routing list under the router lock —
+  the swap pause IS that lock-held window, microseconds — and retires
+  the old generation: each old worker drains its already-queued flushes
+  before exiting, so queued requests never drop and in-flight requests
+  resolve from the version that admitted them.
+
+Observability: per-replica series share the label key ``replica``
+(``serve.replica_flushes{replica=i}`` counter,
+``serve.replica_outstanding{replica=i}`` / queue-share gauges) — one
+metric name per quantity, fan-out via labels, which is the convention
+``tools/lint.py`` now enforces.  Fault site ``serve.replica`` fires on
+every live flush's replica apply (chaos: fail/stall one flush, trip a
+breaker, exercise failover).
+
+The single-replica default (``replicas=1``, no devices) wraps the given
+pipeline's applier directly — no clone, no placement — so the PR-5
+service behavior, program counts, and byte-identity pins are exactly
+unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from keystone_tpu.faults import fault_point
+from keystone_tpu.obs import metrics
+from keystone_tpu.utils import guard
+
+logger = logging.getLogger(__name__)
+
+#: replica breakers default to a short reset so a swapped-in healthy
+#: model is probed within seconds, not the 30 s stage-retry default
+DEFAULT_REPLICA_BREAKER_RESET = 5.0
+
+
+def _place_on_device(obj, device, _seen=None, _depth=0):
+    """Recursively ``jax.device_put`` every device array reachable from
+    ``obj`` onto ``device``; containers/attributes are updated in place
+    where possible (the mirror of ``executor.block_on_arrays``'s walk —
+    same depth cap, same "has block_until_ready" leaf test).  Returns
+    the — possibly replaced — object.  ``_seen`` maps ``id(original)``
+    to the placed result so an array referenced from two sites gets ONE
+    placed copy at both — a set-based guard would re-place the first
+    reference and leave the alias on the default device, and XLA
+    rejects the resulting mixed placement on every flush."""
+    import jax
+
+    if _depth > 8 or obj is None or isinstance(obj, (str, bytes, int, float, bool)):
+        return obj
+    if _seen is None:
+        _seen = {}
+    if id(obj) in _seen:
+        return _seen[id(obj)]
+    if hasattr(obj, "block_until_ready"):
+        placed = jax.device_put(obj, device)
+        _seen[id(obj)] = placed
+        return placed
+    _seen[id(obj)] = obj  # containers: in-place update, cycle-safe
+    if isinstance(obj, dict):
+        for k in list(obj):
+            obj[k] = _place_on_device(obj[k], device, _seen, _depth + 1)
+        return obj
+    if isinstance(obj, list):
+        for i in range(len(obj)):
+            obj[i] = _place_on_device(obj[i], device, _seen, _depth + 1)
+        return obj
+    if isinstance(obj, tuple):
+        new = type(obj)(
+            _place_on_device(v, device, _seen, _depth + 1) for v in obj
+        )
+        _seen[id(obj)] = new  # aliases of the tuple get the rebuilt one
+        return new
+    if hasattr(obj, "__dict__") and not isinstance(obj, type):
+        for k, v in list(vars(obj).items()):
+            nv = _place_on_device(v, device, _seen, _depth + 1)
+            if nv is not v:
+                setattr(obj, k, nv)
+        return obj
+    return obj
+
+
+def _clone_and_place(pipeline, device):
+    """An independent copy of a fitted pipeline with its fitted state
+    committed to ``device`` (None = leave placement alone).  The clone
+    is a pickle round-trip — the same serialization contract
+    ``FittedPipeline.save``/``load`` already pin — so replicas share no
+    transformer instances and therefore no per-instance jit caches:
+    each replica compiles (and keeps hot) its own bucket programs
+    against its own device."""
+    clone = pickle.loads(pickle.dumps(pipeline))
+    if device is not None:
+        for op in clone.graph.operators.values():
+            t = getattr(op, "transformer", None)
+            if t is not None:
+                _place_on_device(t, device)
+    return clone
+
+
+def _as_applier(pipeline):
+    from keystone_tpu.workflow.pipeline import FrozenApplier
+
+    return (
+        pipeline
+        if isinstance(pipeline, FrozenApplier)
+        else FrozenApplier(pipeline)
+    )
+
+
+_SENTINEL = object()
+
+
+class Replica:
+    """One frozen applier pinned to one device, plus its flush worker,
+    queue, breaker, and counters.  Constructed by :class:`ReplicaPool`."""
+
+    def __init__(
+        self,
+        index: int,
+        applier,
+        device=None,
+        version: str = "v0",
+        breaker: Optional[guard.CircuitBreaker] = None,
+        pool_name: str = "serve",
+    ):
+        self.index = int(index)
+        self.applier = applier
+        self.device = device
+        self.version = version
+        self.pool_name = pool_name
+        self.breaker = breaker or guard.CircuitBreaker(
+            f"{pool_name}.replica.{index}",
+            reset_timeout=DEFAULT_REPLICA_BREAKER_RESET,
+        )
+        #: dispatched-but-unfinished flushes (queued + in flight);
+        #: guarded by the owning pool's lock — the router reads it
+        self.outstanding = 0
+        self.flushes = 0
+        self.errors = 0
+        self._q: list = []
+        self._cond = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._retired = False
+
+    # ------------------------------------------------------------ apply
+    def apply(self, ds, deadline=None, prime: bool = False):
+        """Run the frozen graph over one padded batch on THIS replica.
+        Live flushes pass through the ``serve.replica`` fault site;
+        priming warm-ups (``prime=True``) do not — chaos plans target
+        traffic, not warm-up."""
+        if not prime:
+            fault_point("serve.replica", replica=self.index)
+        return self.applier(ds, deadline=deadline)
+
+    # ----------------------------------------------------------- worker
+    def start(self, runner: Callable) -> None:
+        """Spawn the flush worker: pops queued items and hands them to
+        ``runner(replica, batch)`` until the retire sentinel."""
+
+        def loop():
+            while True:
+                with self._cond:
+                    while not self._q:
+                        self._cond.wait()
+                    item = self._q.pop(0)
+                if item is _SENTINEL:
+                    return
+                try:
+                    runner(self, item)
+                except BaseException:  # runner owns failure delivery
+                    logger.exception(
+                        "replica %d flush runner raised", self.index
+                    )
+
+        self._worker = threading.Thread(
+            target=loop,
+            daemon=True,
+            name=f"{self.pool_name}-replica{self.index}",
+        )
+        self._worker.start()
+
+    def enqueue(self, batch) -> None:
+        with self._cond:
+            self._q.append(batch)
+            self._cond.notify()
+
+    def retire(self) -> None:
+        """Queue the stop sentinel BEHIND any already-dispatched flushes
+        — the worker drains them first, so a swap never drops work."""
+        with self._cond:
+            if not self._retired:
+                self._retired = True
+                self._q.append(_SENTINEL)
+                self._cond.notify()
+
+    def join(self, timeout: float) -> List:
+        """Wait for the worker to exit; returns any batches left in the
+        queue so the caller can fail their futures — a wedged worker's
+        abandoned flushes, or flushes enqueued after retirement (the
+        worker exits at the sentinel and never sees what lands behind
+        it)."""
+        if self._worker is not None:
+            self._worker.join(timeout)
+        with self._cond:
+            left = [b for b in self._q if b is not _SENTINEL]
+            self._q.clear()
+        return left
+
+    def status(self) -> dict:
+        return {
+            "replica": self.index,
+            "device": str(self.device) if self.device is not None else None,
+            "version": self.version,
+            "breaker": self.breaker.state(),
+            "outstanding": self.outstanding,
+            "flushes": self.flushes,
+            "errors": self.errors,
+        }
+
+
+class ReplicaPool:
+    """N replicas + the least-outstanding router + blue/green swap.
+
+    ``pipeline``: a fitted pipeline (or ``FrozenApplier``).  With
+    ``replicas=1`` and no explicit devices the pool wraps the given
+    applier directly (the PR-5 single-device behavior, bit-for-bit);
+    with more, each replica gets an independent clone of the fitted
+    state ``jax.device_put`` onto its device (``devices=None`` cycles
+    ``jax.local_devices()``)."""
+
+    def __init__(
+        self,
+        pipeline,
+        replicas: int = 1,
+        devices: Optional[Sequence] = None,
+        version: str = "v0",
+        name: str = "serve",
+        dispatch_window: int = 2,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if dispatch_window < 1:
+            raise ValueError(
+                f"dispatch_window must be >= 1, got {dispatch_window}"
+            )
+        self.name = name
+        self._lock = threading.Lock()
+        #: flow control between the batcher and the replica queues:
+        #: ``dispatch`` blocks while EVERY replica already holds
+        #: ``dispatch_window`` outstanding flushes (one computing + one
+        #: queued behind it, by default).  Without this bound the
+        #: batcher drains the admission queue into the replicas' private
+        #: queues at line rate, the admission queue never fills, and
+        #: overload bypasses ``Overloaded`` backpressure entirely —
+        #: excess work queues invisibly and completes past its deadline
+        #: instead of being rejected at submit.
+        self._window = int(dispatch_window)
+        self._cond = threading.Condition(self._lock)
+        self._draining = False
+        self._runner: Optional[Callable] = None
+        self.version = version
+        self.replicas: List[Replica] = self._build(
+            pipeline, int(replicas), devices, version
+        )
+
+    # ------------------------------------------------------------ build
+    def _devices_for(self, n: int, devices) -> list:
+        if devices is not None:
+            devices = list(devices)
+            if not devices:
+                raise ValueError("devices must be non-empty when given")
+            return [devices[i % len(devices)] for i in range(n)]
+        if n == 1:
+            return [None]  # single replica: no placement, no clone
+        import jax
+
+        local = jax.local_devices()
+        return [local[i % len(local)] for i in range(n)]
+
+    def _build(self, pipeline, n: int, devices, version) -> List[Replica]:
+        devs = self._devices_for(n, devices)
+        out = []
+        for i, dev in enumerate(devs):
+            if dev is None and n == 1:
+                applier = _as_applier(pipeline)
+            else:
+                applier = _as_applier(_clone_and_place(pipeline, dev))
+            out.append(
+                Replica(
+                    i,
+                    applier,
+                    device=dev,
+                    version=version,
+                    pool_name=self.name,
+                )
+            )
+        return out
+
+    @property
+    def size(self) -> int:
+        return len(self.replicas)
+
+    # ----------------------------------------------------------- router
+    def start(self, runner: Callable) -> None:
+        """Start every replica worker; ``runner(replica, batch)`` is the
+        service's flush body (shed + pad + apply + resolve futures)."""
+        self._runner = runner
+        for r in self.replicas:
+            r.start(runner)
+
+    def dispatch(self, batch) -> Replica:
+        """Route one batch: least outstanding work first, skipping
+        replicas whose breaker refuses (``allow()`` on the chosen
+        replica doubles as the half-open probe admission).  All-open
+        falls back to the least-loaded replica — refusing the entire
+        fleet would turn one bad model generation into a total outage,
+        and the probe path needs traffic to ever close a breaker.
+
+        Blocks while every replica is at the dispatch window — the
+        backpressure that makes submit-side admission control real (the
+        bound is per-replica occupancy, so it is soft in the degraded
+        all-breakers-open case where routing ignores load)."""
+        with self._cond:
+            while (
+                not self._draining
+                and self.replicas
+                and min(r.outstanding for r in self.replicas) >= self._window
+            ):
+                # timed: a commit/complete notify can land between the
+                # predicate and the wait on another generation's list
+                self._cond.wait(0.05)
+            order = sorted(self.replicas, key=lambda r: (r.outstanding, r.index))
+            chosen = None
+            for r in order:
+                if r.breaker.allow():
+                    chosen = r
+                    break
+            if chosen is None:
+                chosen = order[0]
+                metrics.inc("serve.router_forced")
+            chosen.outstanding += 1
+            metrics.set_gauge(
+                "serve.replica_outstanding",
+                chosen.outstanding,
+                replica=chosen.index,
+            )
+            # enqueue UNDER the router lock: commit() retires the old
+            # generation only after taking this lock, so a batch routed
+            # to an old replica is queued ahead of the retire sentinel
+            # and the draining worker still serves it.  Enqueued outside
+            # the lock, a concurrent swap could slot the sentinel first
+            # and the batch's futures would hang forever (swap-retired
+            # replicas are never join()ed).
+            chosen.enqueue(batch)
+        return chosen
+
+    def complete(self, replica: Replica, ok: Optional[bool]) -> None:
+        """Account one finished flush: outstanding/queue-share updates
+        plus the breaker charge.  ``ok=True`` records a success (closes
+        a half-open breaker), ``ok=False`` a failure (accumulates toward
+        open), ``ok=None`` is NEUTRAL — nothing ran on the device
+        (shed/cancelled-only flush), so it must neither pass a half-open
+        probe nor reset the consecutive-failure streak: a sick replica
+        shedding 100% of its riders would otherwise keep its breaker
+        closed exactly when failover matters most."""
+        with self._cond:
+            replica.outstanding = max(0, replica.outstanding - 1)
+            self._cond.notify_all()
+            replica.flushes += 1
+            if ok is False:
+                replica.errors += 1
+            metrics.set_gauge(
+                "serve.replica_outstanding",
+                replica.outstanding,
+                replica=replica.index,
+            )
+            metrics.inc("serve.replica_flushes", replica=replica.index)
+            if ok is False:
+                metrics.inc("serve.replica_errors", replica=replica.index)
+            total = sum(r.flushes for r in self.replicas) or 1
+            for r in self.replicas:
+                metrics.set_gauge(
+                    "serve.replica_queue_share",
+                    r.flushes / total,
+                    replica=r.index,
+                )
+        if ok is True:
+            replica.breaker.record_success()
+        elif ok is False:
+            replica.breaker.record_failure()
+
+    # ------------------------------------------------------------- swap
+    def stage(self, pipeline, version: str) -> List[Replica]:
+        """Build (and start) a full staged generation for ``version`` on
+        the same devices as the current one.  Staged replicas accept
+        priming applies but receive no routed traffic until
+        :meth:`commit` — the old generation keeps serving."""
+        devices = [r.device for r in self.replicas]
+        n = len(devices)
+        if n == 1 and devices[0] is None:
+            staged = [
+                Replica(
+                    0,
+                    _as_applier(_clone_and_place(pipeline, None)),
+                    device=None,
+                    version=version,
+                    pool_name=self.name,
+                )
+            ]
+        else:
+            staged = [
+                Replica(
+                    i,
+                    _as_applier(_clone_and_place(pipeline, dev)),
+                    device=dev,
+                    version=version,
+                    pool_name=self.name,
+                )
+                for i, dev in enumerate(devices)
+            ]
+        if self._runner is not None:
+            for r in staged:
+                r.start(self._runner)
+        return staged
+
+    def commit(self, staged: List[Replica], version: str) -> float:
+        """Atomically install a staged generation; returns the swap
+        pause in seconds — the router-lock-held window during which no
+        flush could be dispatched.  Old workers retire AFTER the lock is
+        released: they drain their queued flushes, then exit."""
+        t0 = time.perf_counter()
+        with self._cond:
+            refused = self._draining
+            if not refused:
+                old, self.replicas = self.replicas, staged
+                self.version = version
+                pause = time.perf_counter() - t0
+                # the fresh generation has zero outstanding work: wake a
+                # batcher blocked on the old generation's window
+                self._cond.notify_all()
+        if refused:
+            # the pool is closing: installing a fresh generation now
+            # would leak its worker threads (close() has already
+            # snapshotted the replicas it will retire).  Retire the
+            # staged workers instead and refuse the swap.
+            for r in staged:
+                r.retire()
+            raise RuntimeError(
+                f"replica pool {self.name!r} is closing; swap commit refused"
+            )
+        for r in old:
+            r.retire()
+        return pause
+
+    # ------------------------------------------------------------ close
+    def begin_drain(self) -> None:
+        """Release a ``dispatch`` blocked at the dispatch window: with
+        draining set it dispatches regardless, so the batch lands in a
+        replica queue where :meth:`close` can collect and hand it back
+        instead of the batcher holding it in-hand forever.  The service
+        calls this BEFORE joining its batcher thread — otherwise a
+        batcher blocked on a wedged fleet burns the whole join timeout
+        and its in-hand batch's futures never resolve."""
+        with self._lock:
+            self._draining = True
+            self._cond.notify_all()
+
+    def close(self, timeout: float = 30.0) -> List:
+        """Retire and join every replica; returns batches abandoned by
+        wedged workers (the service fails their futures)."""
+        self.begin_drain()
+        with self._lock:
+            replicas = list(self.replicas)
+        abandoned: List = []
+        for r in replicas:
+            r.retire()
+        deadline = time.monotonic() + timeout
+        for r in replicas:
+            abandoned.extend(r.join(max(0.1, deadline - time.monotonic())))
+        return abandoned
+
+    def statuses(self) -> List[dict]:
+        with self._lock:
+            replicas = list(self.replicas)
+        return [r.status() for r in replicas]

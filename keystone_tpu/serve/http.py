@@ -10,11 +10,31 @@ Endpoints (JSON unless noted):
   its deadline (``DeadlineExceeded``; a request that COMPLETES late
   still answers 200 — the ``serve.deadline_miss`` counter records it),
   **400** on malformed bodies, **503** on service shutdown.
-- ``GET /healthz`` — liveness + queue depth.
+- ``GET /healthz`` — liveness + queue depth + the live model version +
+  per-replica status (version, breaker state, outstanding flushes), so
+  a load balancer can see a HALF-sick fleet — one replica's breaker
+  open, a replica still serving the old version mid-swap — not just
+  process liveness.
+- ``GET /replicas`` — the per-replica status list alone.
+- ``POST /swap`` — admin: blue/green hot-swap the serving model from
+  the attached :class:`~keystone_tpu.serve.registry.ModelRegistry`
+  (``serve_http(svc, registry=...)``; without one the endpoint answers
+  409).  Body ``{"version": "v0007"}`` picks a version; empty body
+  deploys the registry's best candidate (``CURRENT``, with corrupt
+  fallback).  A successful swap also moves ``CURRENT`` to the served
+  version — the registry stays the source of truth, so a ``--watch``
+  poller (or a restart) agrees with an admin rollback instead of
+  reverting it.  Replies with the swap info dict (version, pause,
+  prime seconds, replicas).
 - ``GET /metrics`` — the process metrics registry in Prometheus text
   exposition format (``obs.metrics.to_prometheus_text``): queue depth,
   batch occupancy, latency histograms, shed/rejected counters — the
   whole registry, so serving metrics land next to everything else.
+
+A 429's ``Retry-After`` is derived from the batcher's EWMA
+flush-completion estimate (``PipelineService.retry_after_hint``) —
+integer-ceiled for the header (delta-seconds), exact in the JSON body —
+instead of a hard-coded constant.
 
 ``ThreadingHTTPServer`` (one thread per in-flight request) is the right
 shape here: handler threads block on their futures while the single
@@ -36,6 +56,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -88,8 +109,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "queue_bound": svc.queue_bound,
                     "max_batch": svc.max_batch,
                     "buckets": list(svc.buckets),
+                    "version": svc.version,
+                    "replicas": svc.replica_statuses(),
                 },
             )
+        elif self.path == "/replicas":
+            self._send(200, {"replicas": self.service.replica_statuses()})
         elif self.path == "/metrics":
             self._send(
                 200,
@@ -100,6 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no such path {self.path!r}"})
 
     def do_POST(self):
+        if self.path == "/swap":
+            self._do_swap()
+            return
         if self.path != "/predict":
             self._send(404, {"error": f"no such path {self.path!r}"})
             return
@@ -121,7 +149,15 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             futs = self.service.submit_many(arr, deadline=deadline)
         except Overloaded as e:
-            self._send(429, {"error": str(e)}, headers=(("Retry-After", "1"),))
+            # Retry-After from the EWMA flush-completion estimate the
+            # shedding path maintains: the header is delta-seconds (an
+            # integer, so ceiled, >= 1); the body carries the exact hint
+            hint = self.service.retry_after_hint()
+            self._send(
+                429,
+                {"error": str(e), "retry_after_seconds": hint},
+                headers=(("Retry-After", str(max(1, math.ceil(hint)))),),
+            )
             return
         except ServiceClosed as e:
             self._send(503, {"error": str(e)})
@@ -145,6 +181,63 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, {"predictions": preds})
 
+    def _do_swap(self):
+        """Admin blue/green swap from the attached registry.  Codes:
+        200 swapped, 409 no registry configured, 404 unknown version,
+        503 service closed, 502 the load/swap itself failed (bad
+        publish, injected fault) — the old version keeps serving."""
+        registry = getattr(self.server, "registry", None)
+        if registry is None:
+            self._send(
+                409,
+                {
+                    "error": "no model registry attached; start the "
+                    "frontend with serve_http(svc, registry=...) or "
+                    "`cli serve --model-dir`"
+                },
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}") or {}
+            if not isinstance(body, dict):
+                # valid JSON but not an object ('"v0002"', '[1]'): a
+                # client error, not a handler crash
+                raise ValueError("body must be a JSON object")
+            version = body.get("version")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+            return
+        from keystone_tpu.serve.registry import RegistryError
+
+        try:
+            fitted, ver = registry.load(version)
+            info = self.service.swap(fitted, version=ver)
+        except RegistryError as e:
+            self._send(404, {"error": str(e)})
+            return
+        except ServiceClosed as e:
+            self._send(503, {"error": str(e)})
+            return
+        except Exception as e:
+            logger.warning("admin swap failed: %s: %s", type(e).__name__, e)
+            self._send(502, {"error": f"swap failed: {type(e).__name__}: {e}"})
+            return
+        # the registry is the source of truth: move CURRENT to what the
+        # fleet now serves, else a --watch poller (or a process restart)
+        # would silently revert an admin rollback to the stale pointer
+        # within one poll interval
+        try:
+            if registry.current() != ver:
+                registry.set_current(ver)
+        except Exception as e:
+            logger.warning(
+                "swap to %s succeeded but CURRENT update failed: %s", ver, e
+            )
+            info = dict(info)
+            info["current_pointer_error"] = f"{type(e).__name__}: {e}"
+        self._send(200, info)
+
 
 class HttpFrontend:
     """A :class:`ThreadingHTTPServer` bound to a service.  ``start()``
@@ -157,9 +250,12 @@ class HttpFrontend:
         service: PipelineService,
         host: str = "127.0.0.1",
         port: int = 8000,
+        registry=None,
     ):
         self.server = ThreadingHTTPServer((host, port), _Handler)
         self.server.service = service  # type: ignore[attr-defined]
+        #: ModelRegistry backing POST /swap (None: endpoint answers 409)
+        self.server.registry = registry  # type: ignore[attr-defined]
         self.server.daemon_threads = True
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -201,9 +297,14 @@ class HttpFrontend:
 
 
 def serve_http(
-    service: PipelineService, host: str = "127.0.0.1", port: int = 8000
+    service: PipelineService,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    registry=None,
 ) -> HttpFrontend:
     """Stand up (and start) the HTTP front end for ``service`` on a
     background thread; returns the :class:`HttpFrontend` (``.port`` for
-    ephemeral binds, ``.stop()`` to shut down)."""
-    return HttpFrontend(service, host=host, port=port).start()
+    ephemeral binds, ``.stop()`` to shut down).  ``registry``: a
+    :class:`~keystone_tpu.serve.registry.ModelRegistry` enabling the
+    ``POST /swap`` admin endpoint."""
+    return HttpFrontend(service, host=host, port=port, registry=registry).start()

@@ -1,0 +1,265 @@
+"""Versioned model registry on the durable layer, plus the poll-watcher
+that turns a registry publish into a live fleet hot-swap.
+
+Layout (everything published through ``utils/durable.atomic_write`` —
+tmp + fsync + rename + BLAKE2b sidecar, so a crash mid-publish never
+destroys the previous good version and readers never observe a torn
+one)::
+
+    <root>/
+      v0001/model.pkl     (+ model.pkl.b2 sidecar)
+      v0002/model.pkl     (+ sidecar)
+      CURRENT             (+ sidecar)  — the version id serving traffic
+
+``publish`` writes the model blob FIRST and flips ``CURRENT`` last, so
+a watcher that observes the new pointer always finds a fully-published
+payload behind it.  ``load(None)`` (the deploy path) scans
+current → newest → oldest and skips corrupt/unreadable candidates — a
+damaged newest version degrades to the previous one instead of taking
+the fleet down; ``load(version)`` (the forensic path) is strict.
+
+:class:`RegistryWatcher` is what ``cli.py serve --watch`` runs: poll
+``current()`` every N seconds, and when it moves, load the new version
+and :meth:`~keystone_tpu.serve.service.PipelineService.swap` it into
+the serving fleet (prime in the background, commit at the flush
+boundary).  Failures are logged-and-counted, never fatal: a bad publish
+must not kill the process serving the good version.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import re
+import threading
+from typing import List, Optional, Tuple
+
+from keystone_tpu.obs import metrics
+from keystone_tpu.utils import durable
+
+logger = logging.getLogger(__name__)
+
+CURRENT = "CURRENT"
+MODEL_FILE = "model.pkl"
+
+_VERSION_RE = re.compile(r"^v(\d+)$")
+
+
+class RegistryError(RuntimeError):
+    """A registry operation failed structurally (unknown version,
+    empty registry, malformed version id) — as opposed to transient I/O
+    (retried) or corruption (:class:`~keystone_tpu.utils.durable.CorruptStateError`)."""
+
+
+class ModelRegistry:
+    """Filesystem-backed versioned store of fitted pipelines."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------ paths
+    def version_dir(self, version: str) -> str:
+        return os.path.join(self.root, version)
+
+    def model_path(self, version: str) -> str:
+        return os.path.join(self.version_dir(version), MODEL_FILE)
+
+    def _current_path(self) -> str:
+        return os.path.join(self.root, CURRENT)
+
+    # ------------------------------------------------------------ reads
+    def versions(self) -> List[str]:
+        """Published version ids, oldest → newest (numeric order)."""
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in entries:
+            m = _VERSION_RE.match(name)
+            if m and os.path.exists(self.model_path(name)):
+                out.append((int(m.group(1)), name))
+        return [name for _, name in sorted(out)]
+
+    def current(self) -> Optional[str]:
+        """The version id ``CURRENT`` points at (None: nothing
+        published, or an unreadable/corrupt pointer — the watcher treats
+        both as "no news")."""
+        path = self._current_path()
+        if not os.path.exists(path):
+            return None
+        try:
+            durable.verify_checksum(path)
+            with open(path) as f:
+                v = f.read().strip()
+        except (OSError, durable.CorruptStateError) as e:
+            logger.warning("unreadable CURRENT pointer in %s: %s", self.root, e)
+            return None
+        return v or None
+
+    def _read_model(self, version: str):
+        path = self.model_path(version)
+
+        def _read():
+            durable.verify_checksum(path)
+            with open(path, "rb") as f:
+                return pickle.load(f)
+
+        return durable.with_retries(
+            _read, description=f"registry load {version}"
+        )
+
+    def load(self, version: Optional[str] = None) -> Tuple[object, str]:
+        """Load a fitted pipeline; returns ``(fitted, version)``.
+
+        Explicit ``version``: strict — corruption raises.  ``None``:
+        the deploy path — try ``current()``, then every published
+        version newest → oldest, skipping corrupt/unreadable candidates
+        (counted as ``serve.registry_fallback``)."""
+        if version is not None:
+            if version not in self.versions():
+                raise RegistryError(
+                    f"version {version!r} not in registry {self.root} "
+                    f"(have: {self.versions()})"
+                )
+            fitted = self._read_model(version)
+            metrics.inc("serve.registry_loads")
+            return fitted, version
+        candidates = []
+        cur = self.current()
+        if cur:
+            candidates.append(cur)
+        candidates.extend(
+            v for v in reversed(self.versions()) if v not in candidates
+        )
+        if not candidates:
+            raise RegistryError(f"registry {self.root} has no versions")
+        for i, cand in enumerate(candidates):
+            try:
+                fitted = self._read_model(cand)
+            except Exception as e:
+                logger.warning(
+                    "skipping unreadable registry version %s: %s", cand, e
+                )
+                continue
+            metrics.inc("serve.registry_loads")
+            if i > 0:
+                metrics.inc("serve.registry_fallback")
+                logger.warning(
+                    "serving fallback version %s (newer candidates invalid)",
+                    cand,
+                )
+            return fitted, cand
+        raise RegistryError(
+            f"registry {self.root}: no loadable version among {candidates}"
+        )
+
+    # ----------------------------------------------------------- writes
+    def next_version(self) -> str:
+        vs = self.versions()
+        n = int(_VERSION_RE.match(vs[-1]).group(1)) + 1 if vs else 1
+        return f"v{n:04d}"
+
+    def publish(
+        self, fitted, version: Optional[str] = None, set_current: bool = True
+    ) -> str:
+        """Durably publish a fitted pipeline as a new version and
+        (default) flip ``CURRENT`` to it.  Model blob lands before the
+        pointer moves, so watchers never race a half-published version."""
+        version = version or self.next_version()
+        if not _VERSION_RE.match(version):
+            raise RegistryError(
+                f"version ids must look like v0001, got {version!r}"
+            )
+        vdir = self.version_dir(version)
+        os.makedirs(vdir, exist_ok=True)
+        blob = pickle.dumps(fitted)
+
+        def _write(tmp: str) -> None:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+
+        durable.with_retries(
+            lambda: durable.atomic_write(self.model_path(version), _write),
+            description=f"registry publish {version}",
+        )
+        if set_current:
+            self.set_current(version)
+        metrics.inc("serve.registry_published")
+        logger.info("published %s to registry %s", version, self.root)
+        return version
+
+    def set_current(self, version: str) -> None:
+        if not os.path.exists(self.model_path(version)):
+            raise RegistryError(
+                f"cannot point CURRENT at unpublished version {version!r}"
+            )
+
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                f.write(version + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+        durable.with_retries(
+            lambda: durable.atomic_write(self._current_path(), _write),
+            description="registry CURRENT update",
+        )
+
+
+class RegistryWatcher:
+    """Poll a registry and hot-swap the service when ``CURRENT`` moves.
+
+    ``cli.py serve --watch N`` runs one of these; tests drive it with a
+    sub-second interval.  One failed poll/load/swap is logged and
+    counted (``serve.watch_errors``) — the fleet keeps serving the
+    version it has."""
+
+    def __init__(
+        self,
+        service,
+        registry: ModelRegistry,
+        poll_seconds: float = 5.0,
+        on_swap=None,
+    ):
+        self.service = service
+        self.registry = registry
+        self.poll_seconds = max(0.05, float(poll_seconds))
+        self.on_swap = on_swap
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-registry-watch"
+        )
+
+    def start(self) -> "RegistryWatcher":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_seconds):
+            try:
+                cur = self.registry.current()
+                if not cur or cur == self.service.version:
+                    continue
+                fitted, ver = self.registry.load(cur)
+                info = self.service.swap(fitted, version=ver)
+                metrics.inc("serve.watch_swaps")
+                logger.info(
+                    "watcher swapped in %s (pause %.1f ms)",
+                    ver,
+                    1000.0 * info["pause_seconds"],
+                )
+                if self.on_swap is not None:
+                    self.on_swap(info)
+            except Exception as e:
+                metrics.inc("serve.watch_errors")
+                logger.warning("registry watch iteration failed: %s", e)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)

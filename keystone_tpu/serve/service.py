@@ -49,6 +49,19 @@ Usage::
     y = fut.result()
     svc.close()                    # drains in-flight requests
 
+**Replica fleet (PR 8)** — the batcher no longer applies flushes
+inline: it forms batches and hands them to a
+:class:`~keystone_tpu.serve.fleet.ReplicaPool` router, which dispatches
+each flush to the least-loaded of N per-device replicas (falling past
+replicas whose breaker is open).  ``replicas=1`` with no explicit
+devices is the PR-5 single-device behavior bit-for-bit — the pool wraps
+the given applier directly.  :meth:`PipelineService.swap` performs a
+blue/green model hot-swap: stage a new generation of replicas, prime
+their padding-bucket programs while the old generation keeps serving,
+then commit at the flush boundary — queued requests never drop.  The
+versioned model store feeding swaps is
+``keystone_tpu/serve/registry.py``.
+
 The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 ``python -m keystone_tpu.cli serve``; the load generator is
 ``tools/serve_bench.py``.
@@ -67,6 +80,7 @@ import numpy as np
 
 from keystone_tpu.faults import fault_point
 from keystone_tpu.obs import ledger, metrics
+from keystone_tpu.serve.fleet import ReplicaPool
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
@@ -134,15 +148,20 @@ class PipelineService:
         example=None,
         degrade: bool = True,
         name: str = "serve",
+        replicas: int = 1,
+        devices: Optional[Sequence] = None,
+        version: str = "v0",
     ):
-        from keystone_tpu.workflow.pipeline import FrozenApplier
-
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if queue_bound < 1:
             raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
-        self._applier = (
-            pipeline if isinstance(pipeline, FrozenApplier) else FrozenApplier(pipeline)
+        self._pool = ReplicaPool(
+            pipeline,
+            replicas=replicas,
+            devices=devices,
+            version=version,
+            name=name,
         )
         self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
@@ -165,6 +184,11 @@ class PipelineService:
         self._closing = False
         self._closed = False
         self._ewma_batch_s = 0.0
+        #: EWMA writes now race across replica workers; keep them atomic
+        self._ewma_lock = threading.Lock()
+        #: serializes concurrent swap() calls (watcher + admin endpoint)
+        self._swap_lock = threading.Lock()
+        self._swap_seq = 0
         #: admission-time shape/dtype contract, learned from ``example``
         #: (or the first request): a mismatched request fails ITS submit,
         #: never the whole batch it would have ridden in
@@ -175,25 +199,29 @@ class PipelineService:
             self._item_shape = tuple(ex.shape)
             self._dtype = ex.dtype
             self.prime()
+        self._pool.start(self._run_flush)
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name=f"{name}-batcher"
         )
         self._worker.start()
 
     # ------------------------------------------------------------ priming
-    def prime(self) -> None:
+    def prime(self, replicas=None) -> None:
         """Compile (or cache-load) the apply programs at every bucket
-        shape NOW, so no request ever pays a trace+compile against its
-        deadline.  Requires the item shape (an ``example`` at
-        construction, or a first request already served)."""
+        shape on every replica NOW, so no request ever pays a
+        trace+compile against its deadline.  Requires the item shape (an
+        ``example`` at construction, or a first request already served).
+        ``replicas``: prime just these (the swap path primes a staged
+        generation; default: the pool's live replicas)."""
         if self._item_shape is None:
             raise ValueError(
                 "prime() needs the request item shape; construct the "
                 "service with example=<one datum> (or serve a request first)"
             )
-        for bucket in self.buckets:
-            zeros = np.zeros((bucket,) + self._item_shape, self._dtype)
-            self._apply_rows(zeros, deadline=None)
+        for replica in self._pool.replicas if replicas is None else replicas:
+            for bucket in self.buckets:
+                zeros = np.zeros((bucket,) + self._item_shape, self._dtype)
+                self._apply_rows(zeros, deadline=None, replica=replica, prime=True)
 
     # ------------------------------------------------------------- submit
     def submit(self, x, deadline=None) -> Future:
@@ -272,6 +300,97 @@ class PipelineService:
     def closed(self) -> bool:
         return self._closed
 
+    @property
+    def version(self) -> str:
+        """The model version the live replica generation serves."""
+        return self._pool.version
+
+    @property
+    def replicas(self) -> int:
+        return self._pool.size
+
+    def replica_statuses(self) -> list:
+        """Per-replica status dicts (index, device, model version,
+        breaker state, outstanding flushes) — the fleet view ``/healthz``
+        and ``/replicas`` expose so a load balancer can see a half-sick
+        fleet, not just process liveness."""
+        return self._pool.statuses()
+
+    def retry_after_hint(self) -> float:
+        """Estimated seconds until the queue drains — what a 429 should
+        send as ``Retry-After`` instead of a constant.  Derived from the
+        shedding path's EWMA flush-completion estimate: a full queue is
+        ``ceil(depth / max_batch)`` flushes, spread across the fleet's
+        replicas.  Falls back to 1 s before the first sample."""
+        ewma = self._ewma_batch_s
+        if ewma <= 0.0:
+            return 1.0
+        with self._cond:
+            depth = len(self._q)
+        flushes = -(-max(1, depth) // self.max_batch)  # ceil division
+        return ewma * flushes / max(1, self._pool.size)
+
+    # --------------------------------------------------------------- swap
+    def swap(self, pipeline, version: Optional[str] = None, prime: bool = True) -> dict:
+        """Blue/green model hot-swap: stage a full replica generation
+        for ``pipeline``, prime its padding-bucket programs while the
+        OLD generation keeps serving, then atomically commit at the
+        flush boundary.  Queued requests never drop — flushes already
+        routed to an old replica resolve from the version that admitted
+        them; everything dispatched after the commit runs on the new
+        one.  Returns ``{"version", "pause_seconds", "prime_seconds",
+        "replicas"}`` (``pause_seconds`` is the router-lock-held window:
+        the only time no flush can be dispatched).
+
+        Concurrent swaps serialize; a failed stage/prime leaves the old
+        generation serving untouched (the ``serve.swap`` fault site
+        injects exactly that)."""
+        if self._closing:
+            raise ServiceClosed(f"service {self.name!r} is closed")
+        with self._swap_lock:
+            # re-check under the lock: close() sets _closing and then
+            # waits on this lock, so a swap that was queued behind
+            # another swap (or raced close()'s first check) must not
+            # stage a fresh generation into a shutting-down service
+            if self._closing:
+                raise ServiceClosed(f"service {self.name!r} is closed")
+            self._swap_seq += 1
+            version = version or f"swap{self._swap_seq}"
+            with ledger.span("serve.swap", version=version):
+                fault_point("serve.swap", version=version)
+                t0 = time.monotonic()
+                staged = self._pool.stage(pipeline, version)
+                try:
+                    if prime and self._item_shape is not None:
+                        self.prime(replicas=staged)
+                except BaseException:
+                    # failed prime = failed swap: retire the staged
+                    # workers instead of leaking them; the old
+                    # generation never stopped serving
+                    for r in staged:
+                        r.retire()
+                    raise
+                prime_s = time.monotonic() - t0
+                pause_s = self._pool.commit(staged, version)
+            metrics.inc("serve.swaps")
+            metrics.observe("serve.swap_pause_seconds", pause_s)
+            metrics.observe("serve.swap_prime_seconds", prime_s)
+            logger.info(
+                "hot-swapped %r to version %s (%d replicas, prime %.2fs, "
+                "pause %.2fms)",
+                self.name,
+                version,
+                len(staged),
+                prime_s,
+                1000.0 * pause_s,
+            )
+            return {
+                "version": version,
+                "pause_seconds": pause_s,
+                "prime_seconds": prime_s,
+                "replicas": len(staged),
+            }
+
     # ----------------------------------------------------------- shutdown
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting requests and shut the batcher down.  With
@@ -288,6 +407,30 @@ class PipelineService:
                     )
                 metrics.set_gauge("serve.queue_depth", 0)
             self._cond.notify_all()
+        # wait out an in-flight swap: with _closing set no NEW swap can
+        # start, and an in-flight one either commits into the still-live
+        # pool (its generation is then retired below) or fails on its
+        # own.  Without this, a swap mid-prime would commit fresh worker
+        # threads into a pool close() already tore down, leaking them.
+        # Bounded: a wedged prime must not wedge close() — the pool's
+        # _draining flag makes a late commit() refuse the install.
+        if self._swap_lock.acquire(timeout=timeout):
+            self._swap_lock.release()
+        else:
+            logger.warning(
+                "service %r closing with a swap still in flight after "
+                "%.1fs; a late commit will be refused",
+                self.name,
+                timeout,
+            )
+        # release a batcher blocked at the pool's dispatch window BEFORE
+        # joining it: on a wedged fleet the batcher would otherwise burn
+        # this whole join timeout, and its in-hand batch would be
+        # dropped on the floor (in neither the service queue nor any
+        # replica queue) with its futures never resolved.  Drained, it
+        # dispatches the batch into a replica queue where the pool
+        # close below hands it back as abandoned.
+        self._pool.begin_drain()
         self._worker.join(timeout)
         if self._worker.is_alive():
             logger.warning(
@@ -307,6 +450,18 @@ class PipelineService:
                         ),
                     )
                 metrics.set_gauge("serve.queue_depth", 0)
+        # retire the replica workers: each drains its already-routed
+        # flushes first, so drained == every admitted future resolved.
+        # A wedged replica worker hands back its abandoned batches.
+        for abandoned in self._pool.close(timeout=timeout):
+            for req in abandoned:
+                self._fail(
+                    req,
+                    ServiceClosed(
+                        "service closed with its replica wedged; "
+                        "request never executed"
+                    ),
+                )
         self._closed = True
 
     def __enter__(self) -> "PipelineService":
@@ -317,11 +472,15 @@ class PipelineService:
 
     # ------------------------------------------------------------- worker
     def _loop(self) -> None:
+        """The batcher: form flushes, route each onto a replica.  The
+        dispatch is an enqueue — while replica 0 computes a flush, the
+        batcher is already forming (and routing) the next one, which is
+        what lets N replicas serve N flushes concurrently."""
         while True:
             batch = self._next_batch()
             if batch is None:
                 return
-            self._run_batch(batch)
+            self._pool.dispatch(batch)
 
     def _next_batch(self):
         """Block until a flush is due; pop and return it (None = shut
@@ -357,7 +516,24 @@ class PipelineService:
         except InvalidStateError:
             pass
 
-    def _run_batch(self, batch) -> None:
+    def _run_flush(self, replica, batch) -> None:
+        """One routed flush, on ``replica``'s worker thread: shed, pad,
+        apply, resolve futures, account the outcome to the router and
+        the replica's breaker."""
+        ok: Optional[bool] = False
+        try:
+            ok = self._run_batch(batch, replica)
+        finally:
+            self._pool.complete(replica, ok=ok)
+
+    def _run_batch(self, batch, replica) -> Optional[bool]:
+        """Returns False exactly when the replica's APPLY failed — the
+        outcome that should charge its breaker toward open.  True means
+        the apply succeeded (charges a success, closes a half-open
+        probe).  Shed/cancelled-only batches return None — nothing ran
+        on the device, so the breaker is not charged either way: a sick
+        replica whose inflated EWMA sheds every rider must not keep
+        "passing" its half-open probes with zero device work."""
         # shed what cannot make it: a request whose deadline expires
         # before the batch's predicted completion would occupy a padded
         # row and return an answer its caller already abandoned
@@ -389,13 +565,17 @@ class PipelineService:
             # traffic forever.  Decay-and-retry converges: predicted
             # drops geometrically until a batch runs and real samples
             # resume.
-            self._ewma_batch_s *= 1.0 - _EWMA_ALPHA
-            return
+            with self._ewma_lock:
+                self._ewma_batch_s *= 1.0 - _EWMA_ALPHA
+            return None
         k = len(live)
         t0 = time.monotonic()
         try:
             with ledger.span(
-                "serve.batch", rows=k, bucket=self._bucket_for(k)
+                "serve.batch",
+                rows=k,
+                bucket=self._bucket_for(k),
+                replica=replica.index,
             ):
                 fault_point("serve.batch")
                 stacked = np.stack([req.x for req in live])
@@ -412,21 +592,28 @@ class PipelineService:
                     dls = [r.deadline for r in live if r.deadline is not None]
                     if dls and len(dls) == len(live):
                         batch_deadline = max(dls, key=lambda d: d.at)
-                out = self._apply_rows(stacked, deadline=batch_deadline)
+                out = self._apply_rows(
+                    stacked, deadline=batch_deadline, replica=replica
+                )
         except BaseException as e:  # one bad batch must not kill the worker
             metrics.inc("serve.batch_errors")
             logger.warning(
-                "serve batch of %d failed: %s: %s", k, type(e).__name__, e
+                "serve batch of %d failed on replica %d: %s: %s",
+                k,
+                replica.index,
+                type(e).__name__,
+                e,
             )
             for req in live:
                 self._fail(req, e)
-            return
+            return False
         dt = time.monotonic() - t0
-        self._ewma_batch_s = (
-            dt
-            if not self._ewma_batch_s
-            else (1.0 - _EWMA_ALPHA) * self._ewma_batch_s + _EWMA_ALPHA * dt
-        )
+        with self._ewma_lock:
+            self._ewma_batch_s = (
+                dt
+                if not self._ewma_batch_s
+                else (1.0 - _EWMA_ALPHA) * self._ewma_batch_s + _EWMA_ALPHA * dt
+            )
         metrics.inc("serve.batches")
         metrics.observe("serve.batch_seconds", dt)
         metrics.observe("serve.batch_rows", k)
@@ -440,6 +627,7 @@ class PipelineService:
                 metrics.inc("serve.deadline_miss")
             metrics.inc("serve.completed")
             req.future.set_result(out[i])
+        return True
 
     # -------------------------------------------------------------- apply
     def _bucket_for(self, k: int) -> int:
@@ -448,18 +636,30 @@ class PipelineService:
                 return b
         return self.buckets[-1]
 
-    def _apply_rows(self, stacked: np.ndarray, deadline=None) -> np.ndarray:
+    def _apply_rows(
+        self, stacked: np.ndarray, deadline=None, replica=None, prime: bool = False
+    ) -> np.ndarray:
         """Pad ``(k, ...)`` rows up to the smallest bucket >= k (the
         ``iter_row_chunks`` pad discipline — zero pad rows, outputs
-        sliced back to k), apply the frozen graph, return host rows."""
+        sliced back to k), apply the frozen graph on ``replica``
+        (default: the pool's first), return host rows."""
         from keystone_tpu.workflow.dataset import Dataset
         from keystone_tpu.workflow.transformer import iter_row_chunks
 
         k = stacked.shape[0]
         bucket = self._bucket_for(k)
         padded, _mask, _start = next(iter(iter_row_chunks(stacked, None, bucket)))
-        ds = Dataset(padded, n=k)
-        out = self._applier(ds, deadline=deadline)
+        rep = replica if replica is not None else self._pool.replicas[0]
+        if rep.device is not None:
+            # fleet path: commit the batch to THIS replica's device —
+            # the default Dataset sharding spans every local device,
+            # which XLA rejects against parameters pinned to one
+            import jax
+
+            ds = Dataset(jax.device_put(padded, rep.device), n=k, shard=False)
+        else:
+            ds = Dataset(padded, n=k)
+        out = rep.apply(ds, deadline=deadline, prime=prime)
         return np.asarray(out.array)[:k]
 
 
@@ -474,6 +674,9 @@ def serve(
     example=None,
     degrade: bool = True,
     name: str = "serve",
+    replicas: int = 1,
+    devices: Optional[Sequence] = None,
+    version: str = "v0",
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -493,6 +696,14 @@ def serve(
       executor so ``optional``/``with_fallback`` stages degrade on the
       serve path (loosest so a single tight straggler cannot fail its
       co-batched requests; applied only when every rider has one).
+    - ``replicas`` / ``devices`` — size of the serving fleet: each
+      replica is an independent clone of the fitted state placed on its
+      own device (``devices=None`` cycles ``jax.local_devices()``).
+      ``replicas=1`` with no devices is the single-device fast path —
+      the given pipeline's applier serves directly, no clone.
+    - ``version`` — the model version label the initial replica
+      generation reports (``/healthz``, ``/replicas``); hot-swaps via
+      :meth:`PipelineService.swap` move it.
     """
     return PipelineService(
         pipeline,
@@ -504,4 +715,7 @@ def serve(
         example=example,
         degrade=degrade,
         name=name,
+        replicas=replicas,
+        devices=devices,
+        version=version,
     )

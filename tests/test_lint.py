@@ -70,6 +70,38 @@ def test_metric_name_rule_fires(sites):
     assert not _run('metrics.set_gauge("serve.queue_depth", 3)', sites)
 
 
+def test_metric_label_convention_rejects_index_in_name(sites):
+    """Per-replica fan-out rides labels, never the metric name: an
+    underscore-delimited integer segment mints one series per entity."""
+    v = _run('metrics.inc("serve.replica_0_flushes")', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    v = _run('metrics.set_gauge("serve.replica_12", 1.0)', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    # the blessed form: one name, entity via label
+    assert not _run('metrics.inc("serve.replica_flushes", replica=3)', sites)
+    # digits glued to a word (no underscore delimiter) are legitimate
+    assert not _run('metrics.observe("serve.p99_seconds", 0.1)', sites)
+    assert not _run('metrics.inc("solver.bf16_casts")', sites)
+
+
+def test_metric_label_convention_rejects_interpolated_name(sites):
+    """An f-string / concatenated metric name is the dynamic form of
+    the same violation (the entity index lands in the name at runtime,
+    invisible to the literal checks)."""
+    v = _run('metrics.inc(f"serve.replica{i}.flushes")', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    v = _run('metrics.observe("serve." + kind, 1.0)', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    v = _run('metrics.inc("serve.replica_{}_flushes".format(i))', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    # escape hatch stays available, visibly
+    assert not _run(
+        'metrics.inc(f"serve.{x}")  # lint: allow-metric-name', sites
+    )
+    # a plain variable is not flagged (could be a validated constant)
+    assert not _run("metrics.inc(name)", sites)
+
+
 def test_metric_kind_rule_fires_across_files(sites):
     mk = {}
     assert not _run('metrics.inc("x.y")', sites, metric_kinds=mk)

@@ -18,7 +18,13 @@ Rules:
 - ``metric-name``  — string-literal names in
   ``metrics.inc/observe/set_gauge/gauge_max/remove_gauge(...)`` (and
   ``REGISTRY.<same>``) match ``subsystem.metric_name`` — lowercase,
-  dot-separated, underscore words;
+  dot-separated, underscore words.  Per-entity fan-out (per replica,
+  per site, per rule) must ride LABELS
+  (``metrics.inc("serve.replica_flushes", replica=i)``), never the
+  name: an interpolated name (f-string/concat/``.format``) or an
+  underscore-delimited integer segment (``serve.replica_0_flushes``)
+  mints one metric series per entity, fragmenting dashboards and
+  unbounding the registry — both are violations;
 - ``metric-kind``  — one metric name is used as one instrument kind
   across the whole tree (the static twin of
   ``obs.metrics.MetricKindError``);
@@ -62,6 +68,13 @@ FAULTS_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "faults.py")
 
 #: registry-convention metric names: subsystem.name[.more], lowercase
 METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+#: an underscore-delimited pure-integer word inside a name segment
+#: (``replica_0``, ``shard_12_bytes``): an entity index baked into the
+#: metric NAME — the per-replica label convention says fan-out rides
+#: labels, one name per quantity (digits glued to a word — ``bf16``,
+#: ``p99`` — are fine)
+METRIC_INDEX_SEGMENT_RE = re.compile(r"(^|_)\d+(_|$)")
 
 #: metrics-registry write methods → instrument kind
 _METRIC_KINDS = {
@@ -348,6 +361,33 @@ def lint_source(
         recv = _receiver_name(func)
         if recv is not None and recv[1] in _METRIC_KINDS:
             arg = _str_arg0(node)
+            if (
+                arg is None
+                and node.args
+                and (
+                    isinstance(node.args[0], (ast.JoinedStr, ast.BinOp))
+                    or (
+                        isinstance(node.args[0], ast.Call)
+                        and isinstance(node.args[0].func, ast.Attribute)
+                        and node.args[0].func.attr == "format"
+                    )
+                )
+                and not _allowed(lines, node.args[0].lineno, "metric-name")
+            ):
+                # an f-string / concatenated metric name is how an
+                # entity index sneaks into the NAME (one series minted
+                # per replica/site/...) — fan-out must use labels
+                out.append(
+                    Violation(
+                        rel_path,
+                        node.args[0].lineno,
+                        "metric-name",
+                        "interpolated metric name — per-entity fan-out "
+                        "must ride labels "
+                        "(metrics.inc('serve.replica_flushes', "
+                        "replica=i)), not name interpolation",
+                    )
+                )
             if arg is not None:
                 mname, lineno = arg
                 if not METRIC_NAME_RE.match(mname) and not _allowed(
@@ -361,6 +401,21 @@ def lint_source(
                             f"metric {mname!r} does not match the "
                             "registry convention "
                             "(lowercase dotted: subsystem.metric_name)",
+                        )
+                    )
+                elif any(
+                    METRIC_INDEX_SEGMENT_RE.search(seg)
+                    for seg in mname.split(".")
+                ) and not _allowed(lines, lineno, "metric-name"):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "metric-name",
+                            f"metric {mname!r} bakes an entity index "
+                            "into the name — per-replica/per-entity "
+                            "fan-out must ride labels (one name per "
+                            "quantity)",
                         )
                     )
                 kind = _METRIC_KINDS[recv[1]]

@@ -23,6 +23,14 @@ Usage (CPU-safe; any laptop)::
     # serve a saved model instead of the synthetic default
     ... --model fitted.pkl --dim 512
 
+    # replica fleet: one FrozenApplier clone per local device
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python tools/serve_bench.py --replicas 4 ...
+
+    # blue/green hot-swap halfway through the offer window; the report
+    # gains the swap pause + prime time and per-replica occupancy
+    ... --replicas 4 --swap-mid-run
+
 The default workload is a small synthetic two-stage pipeline
 (NormalizeRows → LinearMapper) so the tool measures the serving layer
 itself; ``--model`` swaps in a real fitted pipeline whose input is a
@@ -44,6 +52,20 @@ from concurrent.futures import wait as futures_wait
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def build_pipeline(dim: int = 64, classes: int = 16, seed: int = 0):
+    """The synthetic two-stage workload (NormalizeRows → LinearMapper)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.models.linear import LinearMapper
+    from keystone_tpu.ops.stats import NormalizeRows
+    from keystone_tpu.workflow import Pipeline
+
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(dim, classes)).astype(np.float32))
+    return Pipeline.of(NormalizeRows()) | LinearMapper(w)
+
+
 def build_service(
     dim: int = 64,
     classes: int = 16,
@@ -53,10 +75,10 @@ def build_service(
     deadline_ms: float = 250.0,
     model: str | None = None,
     seed: int = 0,
+    replicas: int = 1,
 ):
     """A primed service over the synthetic two-stage pipeline (or a
     saved fitted model); returns ``(service, item_shape)``."""
-    import jax.numpy as jnp
     import numpy as np
 
     from keystone_tpu.serve import serve
@@ -66,13 +88,7 @@ def build_service(
 
         pipe = FittedPipeline.load(model)
     else:
-        from keystone_tpu.models.linear import LinearMapper
-        from keystone_tpu.ops.stats import NormalizeRows
-        from keystone_tpu.workflow import Pipeline
-
-        rng = np.random.default_rng(seed)
-        w = jnp.asarray(rng.normal(size=(dim, classes)).astype(np.float32))
-        pipe = Pipeline.of(NormalizeRows()) | LinearMapper(w)
+        pipe = build_pipeline(dim=dim, classes=classes, seed=seed)
     item_shape = (int(dim),)
     svc = serve(
         pipe,
@@ -82,6 +98,7 @@ def build_service(
         deadline_ms=deadline_ms,
         example=np.zeros(item_shape, np.float32),
         name="serve_bench",
+        replicas=replicas,
     )
     return svc, item_shape
 
@@ -100,12 +117,16 @@ def run_bench(
     burst: int = 1,
     deadline_ms: float | None = None,
     batch_delay_ms: float = 0.0,
+    swap_pipeline=None,
 ) -> dict:
     """Offer ``qps`` requests/sec for ``duration`` seconds (groups of
     ``burst`` arrivals at the same mean rate), wait for the tail to
     drain, and report.  ``batch_delay_ms`` > 0 stalls every flush via a
     ``serve.batch:delay=…`` fault plan (emulating a heavier model, so a
-    laptop can exercise overload deterministically)."""
+    laptop can exercise overload deterministically).  ``swap_pipeline``:
+    blue/green hot-swap this fitted pipeline in at the midpoint of the
+    offer window; the report gains the swap info (pause, prime time) so
+    the round artifact records what a live rollout costs under load."""
     import contextlib
 
     import numpy as np
@@ -147,7 +168,21 @@ def run_bench(
         if batch_delay_ms > 0
         else contextlib.nullcontext()
     )
+    swap_info: dict = {}
+    swap_thread = None
+    if swap_pipeline is not None:
+
+        def _swap_midway():
+            time.sleep(duration / 2.0)
+            try:
+                swap_info.update(svc.swap(swap_pipeline, version="bench-swap"))
+            except Exception as e:  # report it; don't kill the offer loop
+                swap_info["error"] = f"{type(e).__name__}: {e}"
+
+        swap_thread = threading.Thread(target=_swap_midway, daemon=True)
     t_start = time.monotonic()
+    if swap_thread is not None:
+        swap_thread.start()
     with plan:
         next_t = t_start
         sent = 0
@@ -181,6 +216,9 @@ def run_bench(
         # shed) — the report must account for every offered request
         futures_wait(futs, timeout=duration + 30.0)
     wall_elapsed = time.monotonic() - t_start
+    if swap_thread is not None:
+        swap_thread.join(timeout=duration + 60.0)
+    replica_stats = svc.replica_statuses()
 
     snap1 = metrics.snapshot()
     c1 = dict(snap1.get("counters") or {})
@@ -222,8 +260,39 @@ def run_bench(
         "deadline_miss": int(
             c1.get("serve.deadline_miss", 0.0) - c0.get("serve.deadline_miss", 0.0)
         ),
+        "replicas": len(replica_stats),
+        # flush share per replica: a healthy least-outstanding router
+        # keeps these near-uniform; a skew marks a slow/broken replica.
+        # Counter deltas, not replica statuses — statuses reset at a
+        # swap (a fresh generation), counters span the whole run
+        "replica_occupancy": _occupancy(replica_stats, c0, c1),
     }
+    if swap_pipeline is not None:
+        report["swap"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in swap_info.items()
+        }
     return report
+
+
+def _occupancy(replica_stats: list, c0: dict, c1: dict) -> list:
+    def flushes(i: int) -> int:
+        key = f"serve.replica_flushes{{replica={i}}}"
+        return int(c1.get(key, 0.0) - c0.get(key, 0.0))
+
+    counts = {r["replica"]: flushes(r["replica"]) for r in replica_stats}
+    total = sum(counts.values()) or 1
+    return [
+        {
+            "replica": r["replica"],
+            "version": r["version"],
+            "flushes": counts[r["replica"]],
+            "share": round(counts[r["replica"]] / total, 4),
+            "errors": r["errors"],
+            "breaker": r["breaker"],
+        }
+        for r in replica_stats
+    ]
 
 
 def main(argv=None) -> int:
@@ -251,6 +320,20 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--model", default=None, help="serve this saved FittedPipeline instead"
     )
+    ap.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="serving fleet size (one FrozenApplier clone per local "
+        "device; pair with XLA_FLAGS=--xla_force_host_platform_device_count=N "
+        "on CPU)",
+    )
+    ap.add_argument(
+        "--swap-mid-run",
+        action="store_true",
+        help="blue/green hot-swap a freshly-built model in at the offer "
+        "window's midpoint; the report gains the swap pause/prime times",
+    )
     args = ap.parse_args(argv)
 
     svc, item_shape = build_service(
@@ -261,7 +344,18 @@ def main(argv=None) -> int:
         queue_bound=args.queue_bound,
         deadline_ms=args.deadline_ms,
         model=args.model,
+        replicas=args.replicas,
     )
+    swap_pipeline = None
+    if args.swap_mid_run:
+        if args.model:
+            from keystone_tpu.workflow import FittedPipeline
+
+            swap_pipeline = FittedPipeline.load(args.model)
+        else:
+            swap_pipeline = build_pipeline(
+                dim=args.dim, classes=args.classes, seed=1
+            )
     try:
         report = run_bench(
             svc,
@@ -271,6 +365,7 @@ def main(argv=None) -> int:
             burst=args.burst,
             deadline_ms=args.deadline_ms,
             batch_delay_ms=args.batch_delay_ms,
+            swap_pipeline=swap_pipeline,
         )
     finally:
         svc.close()
