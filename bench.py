@@ -134,6 +134,16 @@ SERVE_QUEUE_BOUND = 64
 SERVE_DEADLINE_MS = 250.0
 SERVE_BATCH_DELAY_MS = 10.0
 
+# --- recorder-overhead pair (ISSUE 9): the flight-recorder tax, pinned.
+# An in-process A/B — the identical steady-state workload against a
+# recorder-on and a recorder-off service, order-alternating rounds with
+# a discarded warmup — because the overload leg above sits ON the
+# collapse cliff, where achieved QPS swings tens of percent run-to-run
+# and no 5%-budget claim is measurable.  The artifact records per-mode
+# medians and the on/off ratios (budget: within 5% of 1.0).
+SERVE_OVERHEAD_QPS = float(os.environ.get("BENCH_SERVE_OVERHEAD_QPS", "300"))
+SERVE_OVERHEAD_ROUNDS = int(os.environ.get("BENCH_SERVE_OVERHEAD_ROUNDS", "4"))
+
 # --- fleet leg (ISSUE 8): the replica fleet + live blue/green hot-swap
 # under the same open-loop generator.  Offered load sits ABOVE one
 # replica's capacity (max_batch rows per 40 ms-delayed flush ≈ 0.7k QPS)
@@ -635,6 +645,11 @@ def main():
             max_batch=SERVE_MAX_BATCH,
             queue_bound=SERVE_QUEUE_BOUND,
             deadline_ms=SERVE_DEADLINE_MS,
+            # tracing on by default (the shipping config); the
+            # recorder-overhead pin is its own in-process A/B leg
+            # (--leg-serve-overhead), since THIS leg sits on the
+            # overload collapse cliff where ratios are unmeasurable
+            recorder=os.environ.get("BENCH_SERVE_RECORDER", "1") != "0",
         )
         try:
             rep = serve_bench.run_bench(
@@ -648,6 +663,22 @@ def main():
         finally:
             svc.close()
         print(json.dumps(rep))
+        return
+
+    if "--leg-serve-overhead" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                serve_bench.run_overhead_pair(
+                    qps=SERVE_OVERHEAD_QPS,
+                    duration=SERVE_DURATION_S,
+                    rounds=SERVE_OVERHEAD_ROUNDS,
+                    max_batch=SERVE_MAX_BATCH,
+                    deadline_ms=500.0,
+                )
+            )
+        )
         return
 
     if "--leg-serve-fleet" in sys.argv:
@@ -799,6 +830,14 @@ def main():
         )
         if lg
     ]
+    # recorder-overhead pin (ISSUE 9): in-process A/B of the identical
+    # steady-state workload with the flight recorder on vs off — the
+    # tracing tax must keep p99 and achieved QPS within 5%
+    serve_overhead_leg = (
+        subprocess_leg("--leg-serve-overhead", required=("overhead",))
+        if serve_legs
+        else None
+    )
 
     # fleet leg (ISSUE 8): the N-replica fleet + mid-run hot-swap, and
     # ONE 1-replica leg with the identical config — their achieved-QPS
@@ -939,6 +978,10 @@ def main():
                 ]
                 if vals:
                     sv[key] = round(float(np.median(vals)), 2)
+        if serve_overhead_leg:
+            # ratios near 1.0 = the recorder lives inside its overhead
+            # budget (acceptance: within 5%)
+            sv["recorder_overhead"] = serve_overhead_leg
         out["serve"] = sv
     if fleet_legs:
         fv = dict(fleet_legs[0])

@@ -42,8 +42,10 @@ def _serve_main(argv) -> int:
     """``serve`` subcommand: load a saved fitted pipeline (or the
     current version from a model registry) and expose it over HTTP
     (POST /predict, GET /healthz, GET /replicas, POST /swap,
-    GET /metrics) through the micro-batching replica fleet
-    (keystone_tpu/serve)."""
+    GET /metrics, plus the live ops surface GET /statusz, GET /tracez,
+    GET /requestz/<id>) through the micro-batching replica fleet
+    (keystone_tpu/serve) with request-scoped tracing into an always-on
+    bounded flight recorder."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -104,6 +106,28 @@ def _serve_main(argv) -> int:
         help="default per-request deadline; doomed requests are shed "
         "(HTTP 504) instead of executed",
     )
+    ap.add_argument(
+        "--slo-ms",
+        type=float,
+        default=None,
+        help="latency objective for GET /statusz's SLO error-budget "
+        "burn rate (default: --deadline-ms when set)",
+    )
+    ap.add_argument(
+        "--slo-target",
+        type=float,
+        default=0.99,
+        help="fraction of requests that must beat the objective "
+        "(burn rate = windowed bad fraction / (1 - target))",
+    )
+    ap.add_argument(
+        "--no-recorder",
+        action="store_true",
+        help="disable the in-memory flight recorder (request tracing; "
+        "GET /tracez and GET /requestz/<id> answer 409).  HTTP "
+        "responses still echo a request id (client log correlation); "
+        "nothing records or resolves it server-side",
+    )
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument(
@@ -150,6 +174,9 @@ def _serve_main(argv) -> int:
         example=example,
         replicas=args.replicas,
         version=version,
+        recorder=not args.no_recorder,
+        slo_ms=args.slo_ms,
+        slo_target=args.slo_target,
     )
     watcher = None
     if args.watch is not None:
@@ -164,6 +191,7 @@ def _serve_main(argv) -> int:
         f"(replicas={svc.replicas}, max_batch={args.max_batch}, "
         f"max_wait_ms={args.max_wait_ms}, queue_bound={args.queue_bound}"
         + (f", watching every {args.watch:g}s" if watcher else "")
+        + (", tracing off" if args.no_recorder else ", tracing on")
         + ")",
         flush=True,
     )

@@ -11,7 +11,14 @@ primitives every subsystem reports through:
 - :mod:`keystone_tpu.obs.ledger` — a per-run JSONL span/event stream
   (Dapper-style), activated by ``KEYSTONE_OBS_DIR`` or
   ``ledger.start_run``; default OFF and inert.  Spans also annotate the
-  jax profiler timeline and sample HBM/RSS watermarks.
+  jax profiler timeline and sample HBM/RSS watermarks.  Long-lived runs
+  rotate past ``KEYSTONE_OBS_MAX_BYTES`` into keep-N numbered segments.
+- :mod:`keystone_tpu.obs.recorder` — the serving path's flight
+  recorder: a bounded in-memory ring of recent request traces with
+  tail-based retention (shed/error/slow traces pinned), ON by default
+  in ``serve()`` and independent of the ledger.  Read it live via
+  ``GET /tracez`` / ``GET /requestz/<id>`` (``serve/http.py``) or
+  render a dump with ``python tools/trace_report.py``.
 
 Render a ledger with ``python tools/obs_report.py <run.jsonl>``.
 """
@@ -24,4 +31,12 @@ from keystone_tpu.obs.ledger import (  # noqa: F401
     start_run,
     stop_run,
 )
-from keystone_tpu.obs.metrics import REGISTRY, MetricsRegistry  # noqa: F401
+from keystone_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    WindowedHistogram,
+)
+from keystone_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    new_request_id,
+)

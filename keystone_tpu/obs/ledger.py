@@ -52,11 +52,99 @@ from typing import Any, Dict, Optional, Tuple
 from keystone_tpu.obs import metrics
 
 ENV_DIR = "KEYSTONE_OBS_DIR"
+#: size cap (bytes) per ledger segment before rotation; unset = no cap.
+#: A long-lived ``serve --watch`` process with KEYSTONE_OBS_DIR set
+#: appends forever — without a cap it eventually fills the disk.
+ENV_MAX_BYTES = "KEYSTONE_OBS_MAX_BYTES"
+#: rotated segments kept per run (oldest pruned); default 8
+ENV_KEEP_SEGMENTS = "KEYSTONE_OBS_KEEP_SEGMENTS"
+
+DEFAULT_KEEP_SEGMENTS = 8
+
+#: Registered span/event attribute-key vocabulary.  ``tools/lint.py``'s
+#: ``attr`` rule parses this set from the AST (the fault-site rule's
+#: discipline — no package import) and requires every literal keyword
+#: at a ``ledger.span(...)``/``ledger.event(...)``/flight-recorder
+#: emit site to be a snake_case member: a typo'd key otherwise vanishes
+#: silently into the JSONL/ring stream and every downstream reader
+#: (obs_report, trace_report, jq recipes) quietly reads nothing.  Add
+#: a key here when introducing a genuinely new attribute; a one-off
+#: escape is a trailing ``# lint: allow-attr``.
+ATTR_VOCABULARY = {
+    "apply_seconds",
+    "attempt",
+    "attempts",
+    "batch",
+    "bucket",
+    "budget_bytes",
+    "budget_seconds",
+    "checkpoint_save_seconds",
+    "chunk_seconds",
+    "degraded",
+    "epoch",
+    "epoch_seconds",
+    "error",
+    "failed_attempt_seconds",
+    "from_state",
+    "grad_norm",
+    "instances",
+    "it",
+    "key",
+    "late",
+    "n",
+    "no_memoize_demotions",
+    "node",
+    "node_id",
+    "objective",
+    "outcome",
+    "path",
+    "pause_seconds",
+    "pid",
+    "pinned_bytes",
+    "predicted_seconds",
+    "prime_seconds",
+    "queue_depth",
+    "queue_wait_seconds",
+    "reason",
+    "replica",
+    "replicas",
+    "request_id",
+    "request_ids",
+    "retries",
+    "rows",
+    "rule",
+    "seconds",
+    "shared_bytes",
+    "shared_nodes",
+    "sick",
+    "site",
+    "solver",
+    "stats",
+    "substitute",
+    "tag",
+    "to_state",
+    "version",
+    "waited_seconds",
+}
 
 #: per-process run discriminator: time.time() alone has 1-second
 #: resolution, and two runs started within the same second would
 #: silently append into the same JSONL file
 _RUN_COUNTER = itertools.count()
+
+
+def _env_int(name: str) -> Optional[int]:
+    """Non-negative int from the environment, or None (unset, empty,
+    or non-numeric — warned-free: the ledger must never fail to open
+    over a malformed knob)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v >= 0 else None
 
 
 def _json_safe(v):
@@ -128,9 +216,26 @@ class _Span:
 
 
 class RunLedger:
-    """Append-only JSONL event stream for one run."""
+    """Append-only JSONL event stream for one run.
 
-    def __init__(self, directory: str, run_id: Optional[str] = None):
+    **Rotation** — a long-lived process (``serve --watch`` under
+    ``KEYSTONE_OBS_DIR``) appends to one run forever, so the active file
+    carries a size cap: past ``max_bytes`` it is renamed to a numbered
+    segment (``run_<id>.jsonl.000001``, monotonically increasing) and a
+    fresh active file continues the run; only the newest
+    ``keep_segments`` segments are kept, oldest pruned.  ``self.path``
+    always names the ACTIVE file — readers of a live run see the newest
+    tail, and each rotation bumps the ``obs.ledger_rotations`` counter.
+    Defaults come from ``KEYSTONE_OBS_MAX_BYTES`` (unset = unbounded,
+    the historical behavior) and ``KEYSTONE_OBS_KEEP_SEGMENTS``."""
+
+    def __init__(
+        self,
+        directory: str,
+        run_id: Optional[str] = None,
+        max_bytes: Optional[int] = None,
+        keep_segments: Optional[int] = None,
+    ):
         os.makedirs(directory, exist_ok=True)
         if run_id is None:
             run_id = (
@@ -139,6 +244,30 @@ class RunLedger:
         self.run_id = run_id
         self.directory = directory
         self.path = os.path.join(directory, f"run_{run_id}.jsonl")
+        if max_bytes is None:
+            max_bytes = _env_int(ENV_MAX_BYTES)
+        self.max_bytes = max_bytes if max_bytes and max_bytes > 0 else None
+        if keep_segments is None:
+            keep_segments = _env_int(ENV_KEEP_SEGMENTS) or DEFAULT_KEEP_SEGMENTS
+        self.keep_segments = max(1, int(keep_segments))
+        # resume rotation state from disk: reopening an EXISTING run id
+        # (a restarted serve --watch process) must count the bytes
+        # already in the active file and continue segment numbering
+        # past the highest kept suffix — starting both at zero would
+        # let the active file grow to existing+max_bytes and the first
+        # rotation os.replace() over (destroy) a retained segment
+        try:
+            self._bytes = os.path.getsize(self.path)
+        except OSError:
+            self._bytes = 0
+        self._segment = 0
+        prefix = f"run_{run_id}.jsonl."
+        try:
+            for name in os.listdir(directory):
+                if name.startswith(prefix) and name[len(prefix):].isdigit():
+                    self._segment = max(self._segment, int(name[len(prefix):]))
+        except OSError:
+            pass
         self._lock = threading.RLock()
         self._seq = 0
         self._f = open(self.path, "a", encoding="utf-8")
@@ -180,8 +309,41 @@ class RunLedger:
                 return
             self._seq += 1
             rec["seq"] = self._seq
-            self._f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            self._f.write(line)
             self._f.flush()
+            self._bytes += len(line)
+            if self.max_bytes is not None and self._bytes >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Must hold self._lock.  Seal the active file as the next
+        numbered segment, reopen a fresh active file, prune segments
+        past ``keep_segments`` (oldest first)."""
+        self._f.close()
+        self._segment += 1
+        try:
+            os.replace(self.path, f"{self.path}.{self._segment:06d}")
+        except OSError:
+            # the active file vanished under us (operator cleanup): a
+            # rotation failure must not kill the instrumented path
+            pass
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._bytes = 0
+        prefix = os.path.basename(self.path) + "."
+        segments = []
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith(prefix) and name[len(prefix):].isdigit():
+                    segments.append((int(name[len(prefix):]), name))
+        except OSError:
+            segments = []
+        for _, name in sorted(segments)[: -self.keep_segments]:
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:
+                pass
+        metrics.inc("obs.ledger_rotations")
 
     def event(self, name: str, **attrs) -> None:
         st = self._stack()

@@ -33,6 +33,7 @@ import json
 import math
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 ENV_DISABLE = "KEYSTONE_METRICS"
@@ -50,6 +51,31 @@ DEFAULT_BUCKETS = (
     5.0,
     10.0,
     60.0,
+)
+
+#: millisecond-resolution bounds for serve-path latencies.  The default
+#: bounds alias everything under 1 ms into one bucket and everything
+#: between 1 and 5 ms into another — useless for a micro-batching
+#: service whose whole latency budget is tens of milliseconds.  Register
+#: these per name via :meth:`MetricsRegistry.register_buckets` (the
+#: serve subsystem does for ``serve.latency_seconds`` /
+#: ``serve.batch_seconds``), and windowed percentile estimates inherit
+#: the resolution.
+LATENCY_MS_BUCKETS = (
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
 )
 
 
@@ -85,25 +111,78 @@ def _key(name: str, labels: Dict[str, object]) -> _Key:
 
 
 class _Histogram:
-    __slots__ = ("count", "sum", "min", "max", "buckets")
+    __slots__ = ("count", "sum", "min", "max", "buckets", "bounds")
 
     def __init__(self, bounds=DEFAULT_BUCKETS):
+        self.bounds = tuple(bounds)
         self.count = 0
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
-        self.buckets = [0] * (len(bounds) + 1)  # last = +Inf
+        self.buckets = [0] * (len(self.bounds) + 1)  # last = +Inf
 
-    def observe(self, value: float, bounds=DEFAULT_BUCKETS) -> None:
+    def observe(self, value: float) -> None:
         self.count += 1
         self.sum += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
-        for i, b in enumerate(bounds):
+        for i, b in enumerate(self.bounds):
             if value <= b:
                 self.buckets[i] += 1
                 return
         self.buckets[-1] += 1
+
+    def merge_into(self, other: "_Histogram") -> None:
+        """Accumulate this histogram into ``other`` (same bounds — the
+        windowed wrapper's read-side merge)."""
+        other.count += self.count
+        other.sum += self.sum
+        other.min = min(other.min, self.min)
+        other.max = max(other.max, self.max)
+        for i, n in enumerate(self.buckets):
+            other.buckets[i] += n
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated ``q``-quantile (0..1) by linear interpolation
+        within the containing bucket, clamped to the observed min/max.
+        Resolution is the bucket grid's — register fine bounds
+        (:data:`LATENCY_MS_BUCKETS`) for names whose percentiles matter."""
+        if self.count == 0:
+            return None
+        target = max(0.0, min(1.0, float(q))) * self.count
+        cum = 0.0
+        lo = 0.0
+        for b, n in zip(self.bounds, self.buckets[:-1]):
+            if n and cum + n >= target:
+                val = lo + (b - lo) * (target - cum) / n
+                return min(max(val, self.min), self.max)
+            cum += n
+            lo = b
+        return self.max
+
+    def fraction_above(self, threshold: float) -> float:
+        """Estimated fraction of samples strictly above ``threshold``
+        (same interpolation as :meth:`quantile`) — the SLO burn-rate
+        numerator."""
+        if self.count == 0:
+            return 0.0
+        t = float(threshold)
+        below = 0.0
+        lo = 0.0
+        for b, n in zip(self.bounds, self.buckets[:-1]):
+            if b <= t:
+                below += n
+            elif lo < t:
+                below += n * (t - lo) / (b - lo)
+            lo = b
+        n_inf = self.buckets[-1]
+        if n_inf:
+            top = self.max if self.max > lo else lo
+            if t >= top:
+                below += n_inf
+            elif t > lo:
+                below += n_inf * (t - lo) / (top - lo)
+        return max(0.0, min(1.0, 1.0 - below / self.count))
 
     def as_dict(self) -> dict:
         return {
@@ -126,6 +205,11 @@ class MetricsRegistry:
         #: reset) — a second registration under a different kind used to
         #: silently shadow the first in the snapshot
         self._kinds: Dict[str, str] = {}
+        #: name -> histogram bucket bounds.  Configuration, not data:
+        #: survives :meth:`reset` so module-import-time registrations
+        #: (the serve subsystem's ms-resolution latency bounds) hold for
+        #: the whole process, including across test resets.
+        self._bounds_by_name: Dict[str, Tuple[float, ...]] = {}
 
     def _check_kind(self, name: str, kind: str) -> None:
         """Must hold self._lock.  Raises :class:`MetricKindError` when
@@ -168,7 +252,9 @@ class MetricsRegistry:
                 self._gauges[k] = float(value)
 
     def observe(self, name: str, value: float, **labels) -> None:
-        """Record one sample into a histogram."""
+        """Record one sample into a histogram (bucket bounds: the ones
+        :meth:`register_buckets` registered for ``name``, else
+        :data:`DEFAULT_BUCKETS`)."""
         if not enabled():
             return
         k = _key(name, labels)
@@ -176,8 +262,29 @@ class MetricsRegistry:
             self._check_kind(name, "histogram")
             h = self._hists.get(k)
             if h is None:
-                h = self._hists[k] = _Histogram()
+                h = self._hists[k] = _Histogram(
+                    self._bounds_by_name.get(name, DEFAULT_BUCKETS)
+                )
             h.observe(float(value))
+
+    def register_buckets(self, name: str, bounds) -> None:
+        """Register per-metric histogram bucket bounds for ``name``.
+        Applies to histograms created AFTER registration (register at
+        module import, before the first sample); an already-live series
+        keeps the bounds it was born with.  Registration claims the name
+        as a histogram — recording it as a counter/gauge afterwards
+        raises :class:`MetricKindError`, same as any kind conflict."""
+        bounds = tuple(sorted(float(b) for b in bounds))
+        if not bounds:
+            raise ValueError(f"register_buckets({name!r}): empty bounds")
+        with self._lock:
+            self._check_kind(name, "histogram")
+            self._bounds_by_name[name] = bounds
+
+    def bucket_bounds(self, name: str) -> Tuple[float, ...]:
+        """The bucket bounds a new ``name`` histogram would use."""
+        with self._lock:
+            return self._bounds_by_name.get(name, DEFAULT_BUCKETS)
 
     # ------------------------------------------------------------- read
     @staticmethod
@@ -248,7 +355,7 @@ class MetricsRegistry:
                 lines.append(f"{base}_count{lbl(labels)} {h.count}")
                 lines.append(f"{base}_sum{lbl(labels)} {h.sum:g}")
                 cum = 0
-                for bound, n in zip(DEFAULT_BUCKETS, h.buckets):
+                for bound, n in zip(h.bounds, h.buckets):
                     cum += n
                     le = 'le="%g"' % bound
                     lines.append(f"{base}_bucket{lbl(labels, le)} {cum}")
@@ -263,10 +370,108 @@ class MetricsRegistry:
             self._gauges.clear()
             self._hists.clear()
             self._kinds.clear()
+            # bucket registrations are configuration, not data: they
+            # survive, and so does the histogram-kind claim they made
+            for name in self._bounds_by_name:
+                self._kinds[name] = "histogram"
 
 
 #: the process-wide registry every subsystem reports to
 REGISTRY = MetricsRegistry()
+
+
+class WindowedHistogram:
+    """A rolling-window histogram: a ring of per-interval
+    :class:`_Histogram` slices merged on read.
+
+    The registry's histograms are cumulative — correct for counters and
+    whole-run totals, useless for "p99 over the last minute" (one slow
+    hour ago poisons the percentile forever).  This wrapper keeps
+    ``intervals`` fixed-width time slices covering ``window_seconds``;
+    :meth:`observe` lands the sample in the current slice AND forwards
+    it to the process-wide registry under the same ``name`` — so
+    ``/metrics`` keeps its cumulative series while ``/statusz`` reads
+    the window.  Reads merge the non-expired slices into one histogram
+    and answer :meth:`percentile` / :meth:`fraction_above` from it
+    (bucket-interpolated: register fine bounds for the name —
+    :data:`LATENCY_MS_BUCKETS` — or the estimates are as coarse as
+    :data:`DEFAULT_BUCKETS`).
+
+    Lock-cheap: one observe is the registry's lock plus one slot lock;
+    an expired slot is recycled in place, so memory is
+    ``intervals × len(bounds)`` forever.  ``clock`` is injectable for
+    tests (monotonic seconds)."""
+
+    def __init__(
+        self,
+        name: str,
+        window_seconds: float = 60.0,
+        intervals: int = 12,
+        bounds=None,
+        clock=time.monotonic,
+        **labels,
+    ):
+        self.name = name
+        self.window_seconds = float(window_seconds)
+        self._n = max(1, int(intervals))
+        self._interval = self.window_seconds / self._n
+        self._labels = labels
+        self._bounds = (
+            tuple(bounds) if bounds is not None else REGISTRY.bucket_bounds(name)
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: slot -> (interval epoch index, histogram); epoch -1 = empty
+        self._ring: List[Tuple[int, _Histogram]] = [
+            (-1, _Histogram(self._bounds)) for _ in range(self._n)
+        ]
+
+    def observe(self, value: float) -> None:
+        REGISTRY.observe(self.name, value, **self._labels)
+        if not enabled():
+            return
+        v = float(value)
+        idx = int(self._clock() // self._interval)
+        slot = idx % self._n
+        with self._lock:
+            epoch, h = self._ring[slot]
+            if epoch != idx:  # slot holds an expired interval: recycle
+                h = _Histogram(self._bounds)
+                self._ring[slot] = (idx, h)
+            h.observe(v)
+
+    def merged(self) -> _Histogram:
+        """One histogram over every non-expired interval (the window)."""
+        idx = int(self._clock() // self._interval)
+        m = _Histogram(self._bounds)
+        with self._lock:
+            for epoch, h in self._ring:
+                if epoch >= 0 and idx - epoch < self._n:
+                    h.merge_into(m)
+        return m
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Windowed percentile (``p`` in 0..100), or None when empty."""
+        return self.merged().quantile(p / 100.0)
+
+    def fraction_above(self, threshold: float) -> float:
+        return self.merged().fraction_above(threshold)
+
+    def summary(self) -> dict:
+        """Windowed ``{count, sum, min, max, p50, p95, p99,
+        window_seconds}`` — the shape ``/statusz`` embeds."""
+        m = self.merged()
+        return {
+            "count": m.count,
+            "sum": m.sum,
+            "min": m.min if m.count else None,
+            "max": m.max if m.count else None,
+            "p50": m.quantile(0.50),
+            "p95": m.quantile(0.95),
+            "p99": m.quantile(0.99),
+            "window_seconds": self.window_seconds,
+        }
+
 
 # module-level conveniences (the instrumented call sites use these)
 inc = REGISTRY.inc
@@ -275,3 +480,4 @@ set_gauge = REGISTRY.set_gauge
 gauge_max = REGISTRY.gauge_max
 snapshot = REGISTRY.snapshot
 reset = REGISTRY.reset
+register_buckets = REGISTRY.register_buckets

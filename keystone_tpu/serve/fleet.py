@@ -53,7 +53,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from keystone_tpu.faults import fault_point
-from keystone_tpu.obs import metrics
+from keystone_tpu.obs import ledger, metrics
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
@@ -182,11 +182,16 @@ class Replica:
         return self.applier(ds, deadline=deadline)
 
     # ----------------------------------------------------------- worker
-    def start(self, runner: Callable) -> None:
+    def start(self, runner: Callable, obs_context=None) -> None:
         """Spawn the flush worker: pops queued items and hands them to
-        ``runner(replica, batch)`` until the retire sentinel."""
+        ``runner(replica, batch)`` until the retire sentinel.
+        ``obs_context``: a ``ledger.capture_context`` token restored at
+        worker start, so the runner's ledger spans (``serve.batch`` and
+        the executor stages under it) parent where the service was
+        constructed instead of floating rootless on this thread."""
 
         def loop():
+            ledger.restore_context(obs_context)
             while True:
                 with self._cond:
                     while not self._q:
@@ -287,6 +292,7 @@ class ReplicaPool:
         self._cond = threading.Condition(self._lock)
         self._draining = False
         self._runner: Optional[Callable] = None
+        self._obs_ctx = None
         self.version = version
         self.replicas: List[Replica] = self._build(
             pipeline, int(replicas), devices, version
@@ -330,12 +336,16 @@ class ReplicaPool:
         return len(self.replicas)
 
     # ----------------------------------------------------------- router
-    def start(self, runner: Callable) -> None:
+    def start(self, runner: Callable, obs_context=None) -> None:
         """Start every replica worker; ``runner(replica, batch)`` is the
-        service's flush body (shed + pad + apply + resolve futures)."""
+        service's flush body (shed + pad + apply + resolve futures).
+        ``obs_context`` (a ``ledger.capture_context`` token) is restored
+        in every worker — including staged generations built later — so
+        span parenting survives the replica threads."""
         self._runner = runner
+        self._obs_ctx = obs_context
         for r in self.replicas:
-            r.start(runner)
+            r.start(runner, obs_context)
 
     def dispatch(self, batch) -> Replica:
         """Route one batch: least outstanding work first, skipping
@@ -449,7 +459,7 @@ class ReplicaPool:
             ]
         if self._runner is not None:
             for r in staged:
-                r.start(self._runner)
+                r.start(self._runner, self._obs_ctx)
         return staged
 
     def commit(self, staged: List[Replica], version: str) -> float:

@@ -30,6 +30,28 @@ Endpoints (JSON unless noted):
   exposition format (``obs.metrics.to_prometheus_text``): queue depth,
   batch occupancy, latency histograms, shed/rejected counters — the
   whole registry, so serving metrics land next to everything else.
+- ``GET /statusz`` — the rolling-window ops view
+  (``PipelineService.status``): p50/p95/p99 latency over the last
+  window (not process lifetime), per-replica occupancy/breaker
+  statuses, outcome counters, recorder stats, and the SLO error-budget
+  burn rate when a latency objective is configured.
+- ``GET /tracez`` — recent request traces from the flight recorder
+  (newest first; shed/error/slow traces pinned past the happy-path
+  ring).  Query: ``?filter=slow|shed|error|rejected|degraded|completed``,
+  ``?limit=N``, ``?full=1`` for the complete dump (events + batch
+  records + ops spans — the ``tools/trace_report.py`` input).  409 when
+  the service runs with ``recorder=False``.
+- ``GET /requestz/<id>`` — one request's full causal chain (its trace
+  events joined with the batch records it rode), 404 for an unknown or
+  long-evicted id.
+
+**Request ids** — ``POST /predict`` honors an ``X-Request-Id`` header
+(else generates an id) and echoes it in EVERY response — the 200 body,
+the 429/503/504/400/500 error bodies, and an ``X-Request-Id`` response
+header alike — so a client can always quote the exact id that
+``/requestz/<id>`` resolves.  Multi-instance bodies fan sub-ids
+``<id>/0``, ``<id>/1``, ... (listed in the response as
+``request_ids``).
 
 A 429's ``Retry-After`` is derived from the batcher's EWMA
 flush-completion estimate (``PipelineService.retry_after_hint``) —
@@ -60,10 +82,12 @@ import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, unquote, urlsplit
 
 import numpy as np
 
 from keystone_tpu.obs import metrics
+from keystone_tpu.obs.recorder import new_request_id
 from keystone_tpu.serve.service import Overloaded, PipelineService, ServiceClosed
 from keystone_tpu.utils import guard
 
@@ -99,7 +123,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        if self.path == "/healthz":
+        parts = urlsplit(self.path)
+        path, query = parts.path, parse_qs(parts.query)
+        if path == "/healthz":
             svc = self.service
             self._send(
                 200,
@@ -113,9 +139,18 @@ class _Handler(BaseHTTPRequestHandler):
                     "replicas": svc.replica_statuses(),
                 },
             )
-        elif self.path == "/replicas":
+        elif path == "/replicas":
             self._send(200, {"replicas": self.service.replica_statuses()})
-        elif self.path == "/metrics":
+        elif path == "/statusz":
+            self._send(200, self.service.status())
+        elif path == "/tracez":
+            self._do_tracez(query)
+        elif path.startswith("/requestz/"):
+            # unquote: a client-supplied X-Request-Id may need
+            # percent-encoding in the URL; the trace is stored under
+            # the raw id
+            self._do_requestz(unquote(path[len("/requestz/"):]))
+        elif path == "/metrics":
             self._send(
                 200,
                 metrics.REGISTRY.to_prometheus_text().encode("utf-8"),
@@ -124,6 +159,65 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no such path {self.path!r}"})
 
+    def _recorder_or_409(self):
+        rec = self.service.recorder
+        if rec is None:
+            self._send(
+                409,
+                {
+                    "error": "flight recorder disabled; start the service "
+                    "with recorder=True (the default) to trace requests"
+                },
+            )
+        return rec
+
+    def _do_tracez(self, query):
+        rec = self._recorder_or_409()
+        if rec is None:
+            return
+        flt = (query.get("filter") or [None])[0]
+        try:
+            limit = int((query.get("limit") or ["50"])[0])
+        except ValueError:
+            self._send(400, {"error": "limit must be an integer"})
+            return
+        full = (query.get("full") or ["0"])[0] not in ("", "0", "false")
+        if full:
+            out = rec.dump()
+            if flt:
+                out["traces"] = [
+                    t
+                    for t in out["traces"]
+                    if (t["slow"] if flt == "slow" else t["outcome"] == flt)
+                ]
+            self._send(200, out)
+            return
+        self._send(
+            200,
+            {
+                "traces": rec.tracez(filter=flt, limit=limit),
+                "ops": rec.ops_spans(limit=limit),
+                "stats": rec.stats(),
+            },
+        )
+
+    def _do_requestz(self, request_id: str):
+        rec = self._recorder_or_409()
+        if rec is None:
+            return
+        trace = rec.request(request_id)
+        if trace is None:
+            self._send(
+                404,
+                {
+                    "error": f"no trace for request id {request_id!r} "
+                    "(unknown, or evicted from the ring — shed/error/slow "
+                    "traces are retained longest)"
+                },
+            )
+            return
+        self._send(200, trace)
+
     def do_POST(self):
         if self.path == "/swap":
             self._do_swap()
@@ -131,6 +225,12 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._send(404, {"error": f"no such path {self.path!r}"})
             return
+        # the trace identity: honor the client's X-Request-Id, else mint
+        # one — resolved BEFORE parsing so even a 400 echoes an id, and
+        # echoed in every response body + X-Request-Id header so the
+        # client can always quote the id /requestz/<id> resolves
+        rid = (self.headers.get("X-Request-Id") or "").strip() or new_request_id()
+        hdrs = (("X-Request-Id", rid),)
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -144,10 +244,22 @@ class _Handler(BaseHTTPRequestHandler):
             deadline_ms = body.get("deadline_ms")
             deadline = None if deadline_ms is None else float(deadline_ms) / 1000.0
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
-            self._send(400, {"error": f"bad request: {e}"})
+            self._send(
+                400, {"error": f"bad request: {e}", "request_id": rid}, headers=hdrs
+            )
             return
+        # one HTTP request = one trace id; a multi-instance body fans
+        # out sub-ids so each datum's causal chain resolves individually
+        ids = [rid] if len(arr) == 1 else [f"{rid}/{i}" for i in range(len(arr))]
+        rec = self.service.recorder
+        if rec is not None:
+            for i in ids:
+                rec.annotate(i, "http.ingress", path="/predict", instances=len(arr))
+        id_body = {"request_id": rid}
+        if len(ids) > 1:
+            id_body["request_ids"] = ids
         try:
-            futs = self.service.submit_many(arr, deadline=deadline)
+            futs = self.service.submit_many(arr, deadline=deadline, request_ids=ids)
         except Overloaded as e:
             # Retry-After from the EWMA flush-completion estimate the
             # shedding path maintains: the header is delta-seconds (an
@@ -155,18 +267,24 @@ class _Handler(BaseHTTPRequestHandler):
             hint = self.service.retry_after_hint()
             self._send(
                 429,
-                {"error": str(e), "retry_after_seconds": hint},
-                headers=(("Retry-After", str(max(1, math.ceil(hint)))),),
+                {"error": str(e), "retry_after_seconds": hint, **id_body},
+                headers=hdrs + (("Retry-After", str(max(1, math.ceil(hint)))),),
             )
             return
         except ServiceClosed as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e), **id_body}, headers=hdrs)
             return
         except TypeError as e:  # shape mismatch: the CLIENT's fault
-            self._send(400, {"error": f"bad request: {e}"})
+            self._send(
+                400, {"error": f"bad request: {e}", **id_body}, headers=hdrs
+            )
             return
         except Exception as e:  # e.g. injected fault
-            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            self._send(
+                500,
+                {"error": f"{type(e).__name__}: {e}", **id_body},
+                headers=hdrs,
+            )
             return
         try:
             preds = [
@@ -174,12 +292,16 @@ class _Handler(BaseHTTPRequestHandler):
                 for f in futs
             ]
         except guard.DeadlineExceeded as e:
-            self._send(504, {"error": str(e)})
+            self._send(504, {"error": str(e), **id_body}, headers=hdrs)
             return
         except Exception as e:
-            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            self._send(
+                500,
+                {"error": f"{type(e).__name__}: {e}", **id_body},
+                headers=hdrs,
+            )
             return
-        self._send(200, {"predictions": preds})
+        self._send(200, {"predictions": preds, **id_body}, headers=hdrs)
 
     def _do_swap(self):
         """Admin blue/green swap from the attached registry.  Codes:

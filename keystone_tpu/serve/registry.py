@@ -253,11 +253,27 @@ class RegistryWatcher:
                     ver,
                     1000.0 * info["pause_seconds"],
                 )
+                rec = getattr(self.service, "recorder", None)
+                if rec is not None:
+                    # control-plane moment in the flight recorder: a
+                    # watcher-driven rollout shows up in /tracez between
+                    # the request traces it interleaved with
+                    rec.ops(
+                        "serve.watch_swap",
+                        version=ver,
+                        pause_seconds=info["pause_seconds"],
+                    )
                 if self.on_swap is not None:
                     self.on_swap(info)
             except Exception as e:
                 metrics.inc("serve.watch_errors")
                 logger.warning("registry watch iteration failed: %s", e)
+                rec = getattr(self.service, "recorder", None)
+                if rec is not None:
+                    rec.ops(
+                        "serve.watch_error",
+                        error=f"{type(e).__name__}: {e}",
+                    )
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()

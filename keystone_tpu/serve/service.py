@@ -62,6 +62,31 @@ then commit at the flush boundary — queued requests never drop.  The
 versioned model store feeding swaps is
 ``keystone_tpu/serve/registry.py``.
 
+**Request-scoped tracing (ISSUE 9)** — every request carries a
+``request_id`` (honored from the caller / ``X-Request-Id``, else
+generated) from ingress through enqueue → batch flush → replica apply
+to its terminal outcome (``completed`` / ``shed`` / ``rejected`` /
+``degraded`` / ``error``).  The trace lands in an always-on in-memory
+:class:`~keystone_tpu.obs.recorder.FlightRecorder` (bounded, tail-based
+retention — shed/error/slow traces pinned) that is independent of the
+JSONL ledger, so a shed request is explainable live via
+``GET /requestz/<id>`` even with the ledger off.  When a ledger IS
+active, ``serve.batch`` spans additionally record their rider request
+ids as span links and each terminal outcome emits a ``serve.request``
+event, so ``tools/trace_report.py`` reconstructs the same chains from
+either source.  Span parenting survives the batcher and replica worker
+threads via the PR-4 ``ledger.capture_context``/``restore_context``
+machinery (captured at service construction, restored in every worker).
+``serve(recorder=False)`` disables all of it — the PR-5 single-batcher
+path and solver HLO are byte-identical with the recorder off (pinned).
+
+``GET /statusz`` reads rolling-window latency percentiles from
+:class:`~keystone_tpu.obs.metrics.WindowedHistogram` wrappers (ring of
+per-interval histograms merged on read, ms-resolution buckets) that
+also feed the cumulative ``/metrics`` series, plus an SLO error-budget
+burn rate against a configurable latency objective (``slo_ms``,
+defaulting to the service deadline).
+
 The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 ``python -m keystone_tpu.cli serve``; the load generator is
 ``tools/serve_bench.py``.
@@ -69,21 +94,32 @@ The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from keystone_tpu.faults import fault_point
 from keystone_tpu.obs import ledger, metrics
+from keystone_tpu.obs.recorder import FlightRecorder, new_request_id
 from keystone_tpu.serve.fleet import ReplicaPool
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
+
+# millisecond-resolution histogram bounds for the serve-path latencies:
+# the registry defaults alias every sub-millisecond flush into one
+# bucket, which makes windowed p99 estimates (and Prometheus
+# histogram_quantile) useless at serving timescales.  Registered at
+# import, before any service records a sample.
+metrics.register_buckets("serve.latency_seconds", metrics.LATENCY_MS_BUCKETS)
+metrics.register_buckets("serve.batch_seconds", metrics.LATENCY_MS_BUCKETS)
+metrics.register_buckets("serve.failed_wait_seconds", metrics.LATENCY_MS_BUCKETS)
 
 #: EWMA smoothing for the per-batch latency predictor the shed decision
 #: uses: new = (1-ALPHA)*old + ALPHA*sample.  0.3 tracks load shifts
@@ -119,13 +155,21 @@ def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "future", "t_submit")
+    __slots__ = ("x", "deadline", "future", "t_submit", "request_id")
 
-    def __init__(self, x, deadline: Optional[guard.Deadline]):
+    def __init__(
+        self,
+        x,
+        deadline: Optional[guard.Deadline],
+        request_id: Optional[str] = None,
+    ):
         self.x = x
         self.deadline = deadline
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        #: trace identity; None when tracing is off for this request —
+        #: every trace hook takes the None id as its inert no-op
+        self.request_id = request_id
 
 
 class PipelineService:
@@ -151,6 +195,9 @@ class PipelineService:
         replicas: int = 1,
         devices: Optional[Sequence] = None,
         version: str = "v0",
+        recorder=True,
+        slo_ms: Optional[float] = None,
+        slo_target: float = 0.99,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -163,6 +210,39 @@ class PipelineService:
             version=version,
             name=name,
         )
+        #: the flight recorder: True (default) = a fresh bounded
+        #: recorder, False/None = tracing fully off (request ids stay
+        #: None, no trace hook runs — the PR-5 path, pinned), or a
+        #: caller-provided FlightRecorder instance
+        if recorder is True:
+            self.recorder: Optional[FlightRecorder] = FlightRecorder()
+        elif recorder:
+            self.recorder = recorder
+        else:
+            self.recorder = None
+        #: rolling-window latency/batch instruments backing /statusz
+        #: percentiles; every observe also feeds the cumulative
+        #: registry series of the same name (/metrics)
+        self._lat_win = metrics.WindowedHistogram("serve.latency_seconds")
+        self._batch_win = metrics.WindowedHistogram("serve.batch_seconds")
+        #: time failed requests (shed/rejected/errored) spent waiting
+        #: before their terminal — and, for the SLO burn rate, the
+        #: windowed COUNT of failures: a shed flood must drain the
+        #: error budget, not hide from a completed-only latency window
+        self._fail_win = metrics.WindowedHistogram("serve.failed_wait_seconds")
+        #: SLO latency objective (seconds): explicit slo_ms, else the
+        #: service deadline, else no SLO section in /statusz
+        self._slo_s = (
+            float(slo_ms) / 1000.0
+            if slo_ms
+            else (float(deadline_ms) / 1000.0 if deadline_ms else None)
+        )
+        self._slo_target = min(1.0, max(0.0, float(slo_target)))
+        self._batch_seq = itertools.count(1)
+        #: span-parenting context captured where the service was built:
+        #: restored in the batcher and every replica worker, so ledger
+        #: spans emitted there nest under the constructor's open span
+        self._obs_ctx = ledger.capture_context()
         self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
         self.queue_bound = int(queue_bound)
@@ -199,7 +279,7 @@ class PipelineService:
             self._item_shape = tuple(ex.shape)
             self._dtype = ex.dtype
             self.prime()
-        self._pool.start(self._run_flush)
+        self._pool.start(self._run_flush, obs_context=self._obs_ctx)
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name=f"{name}-batcher"
         )
@@ -224,71 +304,131 @@ class PipelineService:
                 self._apply_rows(zeros, deadline=None, replica=replica, prime=True)
 
     # ------------------------------------------------------------- submit
-    def submit(self, x, deadline=None) -> Future:
+    def submit(self, x, deadline=None, request_id: Optional[str] = None) -> Future:
         """Enqueue one datum; returns a Future resolving to its result
         row (numpy).  ``deadline``: seconds or a ``guard.Deadline``
-        (default: the service's ``deadline_ms``).  Raises
+        (default: the service's ``deadline_ms``).  ``request_id``: the
+        trace identity (default: generated when the flight recorder is
+        on — resolve the outcome later via ``/requestz/<id>``).  Raises
         :class:`Overloaded` when the queue is at bound and
         :class:`ServiceClosed` after shutdown began."""
-        return self._submit_all([x], deadline)[0]
+        return self._submit_all(
+            [x], deadline, None if request_id is None else [request_id]
+        )[0]
 
-    def submit_many(self, xs, deadline=None) -> list:
+    def submit_many(self, xs, deadline=None, request_ids=None) -> list:
         """Enqueue a sequence of datums; returns their Futures in order.
         One shared deadline resolution (all requests of the call carry
         the same absolute expiry) and ATOMIC admission: either every
         datum is enqueued or none is — a partial enqueue would leave
-        orphaned requests executing for a caller that saw the error."""
-        return self._submit_all(list(xs), deadline)
+        orphaned requests executing for a caller that saw the error.
+        ``request_ids``: per-datum trace identities (default: generated
+        when the flight recorder is on)."""
+        return self._submit_all(list(xs), deadline, request_ids)
 
-    def _submit_all(self, xs, deadline) -> list:
+    def _resolve_request_ids(self, n: int, request_ids) -> List[Optional[str]]:
+        if request_ids is not None:
+            rids = [None if r is None else str(r) for r in request_ids]
+            if len(rids) != n:
+                raise ValueError(
+                    f"got {len(rids)} request_ids for {n} datums"
+                )
+            return rids
+        if self.recorder is not None:
+            return [new_request_id() for _ in range(n)]
+        return [None] * n
+
+    def _submit_all(self, xs, deadline, request_ids=None) -> list:
         if not xs:
             return []
-        if self._closing:
-            raise ServiceClosed(f"service {self.name!r} is closed")
-        dl = guard.as_deadline(
-            deadline if deadline is not None else self.default_deadline_s
-        )
-        for _ in xs:
-            fault_point("serve.enqueue")
-        arrs = [np.asarray(x) for x in xs]
-        with self._cond:
+        rids = self._resolve_request_ids(len(xs), request_ids)
+        rec = self.recorder
+        try:
             if self._closing:
                 raise ServiceClosed(f"service {self.name!r} is closed")
-            # the shape/dtype contract is learned and checked UNDER the
-            # lock: concurrent first requests must agree on one item
-            # shape, and a mismatched request must fail ITS OWN submit
-            # (before anything is enqueued), never the batch it would
-            # have ridden in.  Staged, committed only after admission:
-            # a rejected (or internally-inconsistent) call must not fix
-            # the contract for requests that were never served
-            item_shape, dtype = self._item_shape, self._dtype
-            for arr in arrs:
-                if item_shape is None:
-                    item_shape, dtype = tuple(arr.shape), arr.dtype
-                elif tuple(arr.shape) != item_shape:
-                    raise TypeError(
-                        f"request shape {tuple(arr.shape)} != service item "
-                        f"shape {item_shape}"
+            dl = guard.as_deadline(
+                deadline if deadline is not None else self.default_deadline_s
+            )
+            for _ in xs:
+                fault_point("serve.enqueue")
+            arrs = [np.asarray(x) for x in xs]
+            with self._cond:
+                if self._closing:
+                    raise ServiceClosed(f"service {self.name!r} is closed")
+                # the shape/dtype contract is learned and checked UNDER the
+                # lock: concurrent first requests must agree on one item
+                # shape, and a mismatched request must fail ITS OWN submit
+                # (before anything is enqueued), never the batch it would
+                # have ridden in.  Staged, committed only after admission:
+                # a rejected (or internally-inconsistent) call must not fix
+                # the contract for requests that were never served
+                item_shape, dtype = self._item_shape, self._dtype
+                for arr in arrs:
+                    if item_shape is None:
+                        item_shape, dtype = tuple(arr.shape), arr.dtype
+                    elif tuple(arr.shape) != item_shape:
+                        raise TypeError(
+                            f"request shape {tuple(arr.shape)} != service item "
+                            f"shape {item_shape}"
+                        )
+                if len(self._q) + len(arrs) > self.queue_bound:
+                    metrics.inc("serve.rejected", len(arrs))
+                    raise Overloaded(
+                        f"service {self.name!r} queue at bound "
+                        f"({self.queue_bound}); retry later"
                     )
-            if len(self._q) + len(arrs) > self.queue_bound:
-                metrics.inc("serve.rejected", len(arrs))
-                raise Overloaded(
-                    f"service {self.name!r} queue at bound "
-                    f"({self.queue_bound}); retry later"
-                )
-            self._item_shape, self._dtype = item_shape, dtype
-            reqs = [
-                _Request(
-                    a if a.dtype == dtype else a.astype(dtype), dl
-                )
-                for a in arrs
-            ]
-            self._q.extend(reqs)
-            # gauge set under the lock: written outside it, a stale
-            # pre-flush depth could overwrite the batcher's newer value
-            # and report a full queue on an idle service
-            metrics.set_gauge("serve.queue_depth", len(self._q))
-            self._cond.notify_all()
+                self._item_shape, self._dtype = item_shape, dtype
+                reqs = [
+                    _Request(
+                        a if a.dtype == dtype else a.astype(dtype), dl, rid
+                    )
+                    for a, rid in zip(arrs, rids)
+                ]
+                if rec is not None:
+                    # annotate UNDER the queue lock, BEFORE the extend:
+                    # the batcher pops under this same lock, so once we
+                    # release, the flush path's finish() cannot run
+                    # ahead of the enqueue event (annotated after the
+                    # lock, a preempted submitter could lose the event
+                    # — or resurrect an evicted id as a phantom trace)
+                    depth = len(self._q) + len(reqs)
+                    for rid in rids:
+                        rec.annotate(rid, "serve.enqueue", queue_depth=depth)
+                self._q.extend(reqs)
+                # gauge set under the lock: written outside it, a stale
+                # pre-flush depth could overwrite the batcher's newer value
+                # and report a full queue on an idle service
+                metrics.set_gauge("serve.queue_depth", len(self._q))
+                self._cond.notify_all()
+        except BaseException as e:
+            # terminal outcome at admission: the trace (if any) must not
+            # dangle open — a rejected request is as explainable as a
+            # shed one.  Finished OUTSIDE the queue lock.
+            outcome = (
+                "rejected"
+                if isinstance(e, (Overloaded, ServiceClosed))
+                else "error"
+            )
+            # rejected/errored admissions burn the SLO error budget too
+            # (waited ~0: admission answers immediately) — EXCEPT client
+            # faults (shape mismatch, malformed payloads: the 400
+            # family): a misbehaving client must not be able to page an
+            # operator by draining the server's error budget
+            if not isinstance(e, (TypeError, ValueError)):
+                for _ in xs:
+                    self._fail_win.observe(0.0)
+            err = f"{type(e).__name__}: {e}"
+            for rid in rids:
+                if rid is not None:
+                    if rec is not None:
+                        rec.finish(rid, outcome, error=err)
+                    ledger.event(
+                        "serve.request",
+                        request_id=rid,
+                        outcome=outcome,
+                        error=err,
+                    )
+            raise
         metrics.inc("serve.submitted", len(reqs))
         return [r.future for r in reqs]
 
@@ -329,6 +469,83 @@ class PipelineService:
             depth = len(self._q)
         flushes = -(-max(1, depth) // self.max_batch)  # ceil division
         return ewma * flushes / max(1, self._pool.size)
+
+    # ------------------------------------------------------------- statusz
+    @staticmethod
+    def _ms(window_summary: dict) -> dict:
+        """A windowed summary in milliseconds (rounded for the wire)."""
+        out = {"count": window_summary["count"]}
+        for key in ("p50", "p95", "p99", "min", "max"):
+            v = window_summary.get(key)
+            out[key] = None if v is None else round(1000.0 * v, 3)
+        return out
+
+    def status(self) -> dict:
+        """The live ops view ``GET /statusz`` serves: rolling-window
+        latency/batch percentiles (from the windowed histograms — the
+        last ``window_seconds``, not process lifetime), per-replica
+        occupancy/breaker statuses, whole-process outcome counters, the
+        flight-recorder stats, and — when a latency objective is
+        configured — the SLO error-budget burn rate: the windowed
+        fraction of requests over the objective divided by the allowed
+        fraction (``1 - slo_target``); burn > 1 means the error budget
+        is draining faster than it accrues."""
+        lat = self._lat_win.summary()
+        bat = self._batch_win.summary()
+        reg = metrics.REGISTRY
+        rec = self.recorder
+        out = {
+            "name": self.name,
+            "status": "closed" if self._closed else "ok",
+            "version": self.version,
+            "queue_depth": self.queue_depth,
+            "queue_bound": self.queue_bound,
+            "max_batch": self.max_batch,
+            "window_seconds": self._lat_win.window_seconds,
+            "latency_ms": self._ms(lat),
+            "batch_ms": self._ms(bat),
+            "counters": {
+                name.split(".", 1)[1]: reg.counter_total(name)
+                for name in (
+                    "serve.submitted",
+                    "serve.completed",
+                    "serve.shed",
+                    "serve.rejected",
+                    "serve.deadline_miss",
+                    "serve.batch_errors",
+                )
+            },
+            "replicas": self.replica_statuses(),
+            "recorder": None if rec is None else rec.stats(),
+        }
+        if self._slo_s is not None:
+            # bad = completed-but-over-objective PLUS every failed
+            # terminal (shed/rejected/error) in the window: a shed
+            # flood is the worst latency violation there is and must
+            # drain the budget, not hide from a completed-only window
+            n_ok = lat["count"]
+            n_fail = self._fail_win.summary()["count"]
+            n = n_ok + n_fail
+            bad = (
+                0.0
+                if n == 0
+                else (self._lat_win.fraction_above(self._slo_s) * n_ok + n_fail)
+                / n
+            )
+            budget = 1.0 - self._slo_target
+            out["slo"] = {
+                "objective_ms": round(1000.0 * self._slo_s, 3),
+                "target": self._slo_target,
+                "window_seconds": self._lat_win.window_seconds,
+                "window_requests": n,
+                "window_failed": n_fail,
+                "bad_fraction": round(bad, 6),
+                "compliance": round(1.0 - bad, 6),
+                "burn_rate": (
+                    None if budget <= 0.0 else round(bad / budget, 3)
+                ),
+            }
+        return out
 
     # --------------------------------------------------------------- swap
     def swap(self, pipeline, version: Optional[str] = None, prime: bool = True) -> dict:
@@ -375,6 +592,19 @@ class PipelineService:
             metrics.inc("serve.swaps")
             metrics.observe("serve.swap_pause_seconds", pause_s)
             metrics.observe("serve.swap_prime_seconds", prime_s)
+            rec = self.recorder
+            if rec is not None:
+                # the swap is a control-plane span in the recorder, so
+                # /tracez shows it BETWEEN the request traces it
+                # interleaves with (riders routed to the retiring
+                # generation before it, new-generation traffic after)
+                rec.ops(
+                    "serve.swap",
+                    version=version,
+                    pause_seconds=round(pause_s, 6),
+                    prime_seconds=round(prime_s, 6),
+                    replicas=len(staged),
+                )
             logger.info(
                 "hot-swapped %r to version %s (%d replicas, prime %.2fs, "
                 "pause %.2fms)",
@@ -476,6 +706,7 @@ class PipelineService:
         dispatch is an enqueue — while replica 0 computes a flush, the
         batcher is already forming (and routing) the next one, which is
         what lets N replicas serve N flushes concurrently."""
+        ledger.restore_context(self._obs_ctx)
         while True:
             batch = self._next_batch()
             if batch is None:
@@ -506,11 +737,35 @@ class PipelineService:
             metrics.set_gauge("serve.queue_depth", len(self._q))
             return batch
 
-    @staticmethod
-    def _fail(req, exc) -> None:
+    def _fail(self, req, exc, **attrs) -> None:
         """Deliver an exception to a request, tolerating a caller that
         already cancelled its future — an InvalidStateError here would
-        kill the batcher thread and brick the whole service."""
+        kill the batcher thread and brick the whole service.  Also the
+        trace terminal for failure paths: the outcome is ``shed`` for a
+        deadline shed, ``error`` otherwise, finished only if the trace
+        is still live (an already-finalized id is left alone).  The
+        trace is finalized BEFORE the future is delivered, so a caller
+        woken by ``.result()`` can immediately resolve its id via
+        ``/requestz`` without racing the finalization."""
+        self._fail_win.observe(time.monotonic() - req.t_submit)
+        rid = req.request_id
+        if rid is not None:
+            outcome = (
+                "shed" if isinstance(exc, guard.DeadlineExceeded) else "error"
+            )
+            rec = self.recorder
+            if rec is not None:
+                rec.finish(
+                    rid,
+                    outcome,
+                    only_live=True,
+                    error=f"{type(exc).__name__}: {exc}",
+                    **attrs,
+                )
+            if ledger.active() is not None:
+                ledger.event(
+                    "serve.request", request_id=rid, outcome=outcome, **attrs
+                )
         try:
             req.future.set_exception(exc)
         except InvalidStateError:
@@ -534,6 +789,28 @@ class PipelineService:
         on the device, so the breaker is not charged either way: a sick
         replica whose inflated EWMA sheds every rider must not keep
         "passing" its half-open probes with zero device work."""
+        rec = self.recorder
+        bid = f"b{next(self._batch_seq)}"
+        now = time.monotonic()
+        if rec is not None:
+            riders = [r.request_id for r in batch if r.request_id is not None]
+            if riders:
+                # the batch span records its rider ids as span links —
+                # the flush is SHARED by its riders, so it is recorded
+                # once and joined on read (/requestz, trace_report).
+                # One "serve.batch" event per rider marks its arrival on
+                # THIS replica's worker (batch id + replica + queue
+                # wait); deeper flush facts live on the batch record —
+                # per-rider event count is part of the overhead budget.
+                rec.batch(bid, riders, replica=replica.index, rows=len(batch))
+            for req in batch:
+                rec.annotate(
+                    req.request_id,
+                    "serve.batch",
+                    batch=bid,
+                    replica=replica.index,
+                    queue_wait_seconds=round(now - req.t_submit, 6),
+                )
         # shed what cannot make it: a request whose deadline expires
         # before the batch's predicted completion would occupy a padded
         # row and return an answer its caller already abandoned
@@ -546,6 +823,14 @@ class PipelineService:
                 # a surviving request can no longer be cancelled out
                 # from under the set_result below)
                 metrics.inc("serve.cancelled")
+                if rec is not None:
+                    rec.finish(
+                        req.request_id,
+                        "cancelled",
+                        only_live=True,
+                        batch=bid,
+                        replica=replica.index,
+                    )
                 continue
             if req.deadline is not None and req.deadline.remaining() <= predicted:
                 metrics.inc("serve.shed")
@@ -554,6 +839,10 @@ class PipelineService:
                     guard.DeadlineExceeded(
                         "serve.shed", time.monotonic() - req.t_submit
                     ),
+                    batch=bid,
+                    replica=replica.index,
+                    predicted_seconds=round(predicted, 6),
+                    waited_seconds=round(time.monotonic() - req.t_submit, 6),
                 )
             else:
                 live.append(req)
@@ -569,13 +858,22 @@ class PipelineService:
                 self._ewma_batch_s *= 1.0 - _EWMA_ALPHA
             return None
         k = len(live)
+        bucket = self._bucket_for(k)
+        trace_ids = [r.request_id for r in live if r.request_id is not None]
+        deg0 = (
+            metrics.REGISTRY.counter_total("executor.degraded")
+            if rec is not None
+            else 0.0
+        )
         t0 = time.monotonic()
         try:
             with ledger.span(
                 "serve.batch",
                 rows=k,
-                bucket=self._bucket_for(k),
+                bucket=bucket,
                 replica=replica.index,
+                batch=bid,
+                request_ids=trace_ids,
             ):
                 fault_point("serve.batch")
                 stacked = np.stack([req.x for req in live])
@@ -604,8 +902,10 @@ class PipelineService:
                 type(e).__name__,
                 e,
             )
+            if rec is not None:
+                rec.batch_update(bid, error=f"{type(e).__name__}: {e}")
             for req in live:
-                self._fail(req, e)
+                self._fail(req, e, batch=bid, replica=replica.index)
             return False
         dt = time.monotonic() - t0
         with self._ewma_lock:
@@ -615,17 +915,59 @@ class PipelineService:
                 else (1.0 - _EWMA_ALPHA) * self._ewma_batch_s + _EWMA_ALPHA * dt
             )
         metrics.inc("serve.batches")
-        metrics.observe("serve.batch_seconds", dt)
+        self._batch_win.observe(dt)
         metrics.observe("serve.batch_rows", k)
+        degraded = False
+        if rec is not None:
+            # best-effort per-flush degradation detection: the executor
+            # counts declared-stage degradations process-wide, so a
+            # delta across THIS apply marks the flush (concurrent
+            # flushes can cross-attribute — observability, not control)
+            degraded = (
+                metrics.REGISTRY.counter_total("executor.degraded") > deg0
+            )
+            rec.batch_update(
+                bid,
+                rows=k,
+                bucket=bucket,
+                seconds=round(dt, 6),
+                degraded=degraded,
+            )
+        outcome = "degraded" if degraded else "completed"
         done_t = time.monotonic()
+        # one ledger-activation check per FLUSH, not per rider: the
+        # inert-path cost of N module-frontend calls is real at serving
+        # rates (part of the recorder overhead budget)
+        led_on = ledger.active() is not None
         for i, req in enumerate(live):
-            metrics.observe("serve.latency_seconds", done_t - req.t_submit)
-            if req.deadline is not None and req.deadline.expired():
+            self._lat_win.observe(done_t - req.t_submit)
+            late = req.deadline is not None and req.deadline.expired()
+            if late:
                 # completed, but late: the shed predictor under-estimated
                 # (e.g. the first batch after a stall) — count it so the
                 # bench's "completed beat their deadlines" claim is honest
                 metrics.inc("serve.deadline_miss")
             metrics.inc("serve.completed")
+            if req.request_id is not None:
+                if rec is not None:
+                    rec.finish(
+                        req.request_id,
+                        outcome,
+                        batch=bid,
+                        replica=replica.index,
+                        apply_seconds=round(dt, 6),
+                        late=late,
+                    )
+                if led_on:
+                    ledger.event(
+                        "serve.request",
+                        request_id=req.request_id,
+                        outcome=outcome,
+                        batch=bid,
+                        replica=replica.index,
+                        seconds=round(done_t - req.t_submit, 6),
+                        queue_wait_seconds=round(t0 - req.t_submit, 6),
+                    )
             req.future.set_result(out[i])
         return True
 
@@ -677,6 +1019,9 @@ def serve(
     replicas: int = 1,
     devices: Optional[Sequence] = None,
     version: str = "v0",
+    recorder=True,
+    slo_ms: Optional[float] = None,
+    slo_target: float = 0.99,
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -704,6 +1049,18 @@ def serve(
     - ``version`` — the model version label the initial replica
       generation reports (``/healthz``, ``/replicas``); hot-swaps via
       :meth:`PipelineService.swap` move it.
+    - ``recorder`` — the flight recorder (ON by default): every request
+      gets a traced causal chain (ingress → enqueue → batch → replica →
+      outcome) in a bounded in-memory ring, served live by
+      ``GET /tracez`` / ``GET /requestz/<id>``.  ``False`` disables
+      tracing entirely — the service mints no ids and runs no trace
+      hook (the PR-5 path, byte-identical — pinned); the HTTP front
+      end still echoes an id per response for client-side log
+      correlation, it just resolves nowhere server-side.  Or pass a
+      configured :class:`~keystone_tpu.obs.recorder.FlightRecorder`.
+    - ``slo_ms`` / ``slo_target`` — the latency objective behind
+      ``GET /statusz``'s error-budget burn rate (default objective:
+      ``deadline_ms``; no deadline, no SLO section).
     """
     return PipelineService(
         pipeline,
@@ -718,4 +1075,7 @@ def serve(
         replicas=replicas,
         devices=devices,
         version=version,
+        recorder=recorder,
+        slo_ms=slo_ms,
+        slo_target=slo_target,
     )

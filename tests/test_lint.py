@@ -22,7 +22,19 @@ def sites():
     return lint.load_registered_sites()
 
 
-def _run(src, sites, supervised=False, metric_kinds=None, solver_scoped=False):
+@pytest.fixture(scope="module")
+def attr_vocab():
+    return lint.load_attr_vocabulary()
+
+
+def _run(
+    src,
+    sites,
+    supervised=False,
+    metric_kinds=None,
+    solver_scoped=False,
+    attr_vocab=None,
+):
     return lint.lint_source(
         "seeded.py",
         src,
@@ -30,6 +42,7 @@ def _run(src, sites, supervised=False, metric_kinds=None, solver_scoped=False):
         metric_kinds if metric_kinds is not None else {},
         supervised=supervised,
         solver_scoped=solver_scoped,
+        attr_vocab=attr_vocab,
     )
 
 
@@ -178,6 +191,85 @@ def test_solver_sync_prefixes_cover_solver_modules():
     assert lint._is_solver_sweep("keystone_tpu/models/block_weighted_ls.py")
     assert lint._is_solver_sweep("keystone_tpu/models/lbfgs.py")
     assert not lint._is_solver_sweep("keystone_tpu/workflow/executor.py")
+
+
+# ------------------------------------------------- seeded: attr keys
+def test_attr_vocabulary_parsed_without_import(attr_vocab):
+    from keystone_tpu.obs import ledger
+
+    assert attr_vocab == frozenset(ledger.ATTR_VOCABULARY)
+    assert "request_id" in attr_vocab and "seconds" in attr_vocab
+
+
+def test_attr_rule_fires_on_unregistered_key(sites, attr_vocab):
+    v = _run(
+        'ledger.event("serve.request", request_idd=rid)',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    assert [x.rule for x in v] == ["attr"]
+    assert "request_idd" in v[0].message
+    # same typo class at a flight-recorder emit site
+    v = _run(
+        'rec.annotate(rid, "serve.enqueue", queue_dep=3)',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    assert [x.rule for x in v] == ["attr"]
+    # registered keys pass, on both receivers
+    assert not _run(
+        'ledger.event("serve.request", request_id=rid, outcome="shed")',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    assert not _run(
+        'rec.finish(rid, "shed", replica=0, waited_seconds=w)',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    # recorder API control flags are exempt WITHOUT being vocabulary
+    # members (the vocabulary documents only what lands in the stream)
+    assert "only_live" not in attr_vocab
+    assert not _run(
+        'rec.finish(rid, "shed", only_live=True, replica=0)',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    # ...but the exemption is per recorder method, not global
+    v = _run(
+        'ledger.event("x.y", only_live=True)', sites, attr_vocab=attr_vocab
+    )
+    assert [x.rule for x in v] == ["attr"]
+
+
+def test_attr_rule_requires_snake_case(sites, attr_vocab):
+    v = _run(
+        'with ledger.span("serve.batch", Rows=k): pass',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    assert [x.rule for x in v] == ["attr"]
+
+
+def test_attr_rule_scoping_and_escape(sites, attr_vocab):
+    # a **splat is dynamic — not the literal rule's business
+    assert not _run(
+        'ledger.event("solver.epoch", **series)', sites, attr_vocab=attr_vocab
+    )
+    # unrelated receivers with the same method names are not emit sites
+    assert not _run(
+        "m.span(1, 2)\nq.event(name, weird_key=1)",
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    # the visible escape hatch
+    assert not _run(
+        'ledger.event("x.y", oneoff_key=1)  # lint: allow-attr',
+        sites,
+        attr_vocab=attr_vocab,
+    )
+    # rule off entirely when no vocabulary is supplied
+    assert not _run('ledger.event("x.y", bogus_key=1)', sites)
 
 
 # ------------------------------------------------- seeded: obs-gating

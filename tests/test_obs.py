@@ -387,3 +387,162 @@ def test_solver_obs_numerics_bit_identical(tmp_path):
     ledger.stop_run()
     np.testing.assert_array_equal(np.asarray(m0.weights), np.asarray(m1.weights))
     np.testing.assert_array_equal(np.asarray(g0.means), np.asarray(g1.means))
+
+
+# ------------------------------------------- ledger rotation (ISSUE 9)
+
+
+def test_ledger_rotation_bounds_disk(tmp_path):
+    """A size-capped RunLedger rotates the active file into numbered
+    segments and prunes past keep-N — a long-lived serve --watch process
+    under KEYSTONE_OBS_DIR cannot fill the disk."""
+    rot0 = metrics.REGISTRY.counter_value("obs.ledger_rotations")
+    led = ledger.RunLedger(str(tmp_path), max_bytes=2000, keep_segments=2)
+    for i in range(400):
+        led.event("rotation.filler", seconds=float(i))
+    led.close()
+    segments = sorted(
+        p for p in os.listdir(tmp_path) if ".jsonl." in p
+    )
+    assert len(segments) == 2, segments  # oldest pruned down to keep-N
+    # every suffix is numeric and monotonically increasing
+    suffixes = [int(p.rsplit(".", 1)[1]) for p in segments]
+    assert suffixes == sorted(suffixes)
+    rotations = metrics.REGISTRY.counter_value("obs.ledger_rotations") - rot0
+    assert rotations > 2  # more rotations happened than segments kept
+    # the active file plus every kept segment is valid JSONL
+    for name in segments + [os.path.basename(led.path)]:
+        for line in open(os.path.join(tmp_path, name)):
+            json.loads(line)
+    # each segment stayed near the cap (one event of slack)
+    for name in segments:
+        assert os.path.getsize(os.path.join(tmp_path, name)) < 2000 + 500
+
+
+def test_ledger_reopen_resumes_rotation_state(tmp_path):
+    """Reopening an EXISTING run id (a restarted serve --watch process)
+    must resume the byte count from the active file and the segment
+    numbering past the highest kept suffix — restarting both at zero
+    would overwrite a retained segment on the first rotation."""
+    led = ledger.RunLedger(
+        str(tmp_path), run_id="stable", max_bytes=1500, keep_segments=4
+    )
+    for i in range(120):
+        led.event("rotation.filler", seconds=float(i))
+    led.close()
+    before = sorted(p for p in os.listdir(tmp_path) if ".jsonl." in p)
+    assert before  # at least one rotation happened
+    sizes = {
+        p: os.path.getsize(os.path.join(tmp_path, p)) for p in before
+    }
+    led2 = ledger.RunLedger(
+        str(tmp_path), run_id="stable", max_bytes=1500, keep_segments=4
+    )
+    assert led2._segment == max(int(p.rsplit(".", 1)[1]) for p in before)
+    assert led2._bytes > 0  # counted the existing active file
+    for i in range(120):
+        led2.event("rotation.filler", seconds=float(i))
+    led2.close()
+    after = sorted(p for p in os.listdir(tmp_path) if ".jsonl." in p)
+    # the first process's segments were continued past, never replaced
+    for p in before:
+        if p in after:  # not pruned by keep-N
+            assert os.path.getsize(os.path.join(tmp_path, p)) == sizes[p]
+    assert len(after) > len(before) or set(after) != set(before)
+
+
+def test_ledger_rotation_env_knobs(tmp_path, monkeypatch):
+    """KEYSTONE_OBS_MAX_BYTES / KEYSTONE_OBS_KEEP_SEGMENTS configure the
+    env-activated ledger (the zero-code route)."""
+    monkeypatch.setenv(ledger.ENV_MAX_BYTES, "1500")
+    monkeypatch.setenv(ledger.ENV_KEEP_SEGMENTS, "1")
+    led = ledger.RunLedger(str(tmp_path))
+    assert led.max_bytes == 1500 and led.keep_segments == 1
+    for i in range(200):
+        led.event("rotation.filler", seconds=float(i))
+    led.close()
+    segments = [p for p in os.listdir(tmp_path) if ".jsonl." in p]
+    assert len(segments) == 1
+    # unset = unbounded (the historical default)
+    monkeypatch.delenv(ledger.ENV_MAX_BYTES)
+    led2 = ledger.RunLedger(str(tmp_path))
+    assert led2.max_bytes is None
+    led2.close()
+
+
+# ------------------------- per-metric buckets + windowed histograms
+
+
+def test_register_buckets_gives_ms_resolution():
+    """Registered bounds apply to new histograms of that name and ride
+    into the Prometheus rendering; unregistered names keep defaults."""
+    metrics.register_buckets("bucketed.latency_seconds", metrics.LATENCY_MS_BUCKETS)
+    metrics.observe("bucketed.latency_seconds", 0.003)
+    metrics.observe("plain.latency_seconds", 0.003)
+    text = metrics.REGISTRY.to_prometheus_text()
+    assert 'bucketed_latency_seconds_bucket{le="0.0025"} 0' in text
+    assert 'bucketed_latency_seconds_bucket{le="0.005"} 1' in text
+    # the default grid has no 0.0025 bound
+    assert 'plain_latency_seconds_bucket{le="0.0025"}' not in text
+    assert 'plain_latency_seconds_bucket{le="0.005"} 1' in text
+
+
+def test_register_buckets_preserves_kind_conflict_check():
+    metrics.register_buckets("conflicted.seconds", (0.1, 1.0))
+    with pytest.raises(metrics.MetricKindError):
+        metrics.inc("conflicted.seconds")
+    # and the registration (plus its histogram-kind claim) survives reset
+    metrics.reset()
+    with pytest.raises(metrics.MetricKindError):
+        metrics.REGISTRY.set_gauge("conflicted.seconds", 1.0)
+    assert metrics.REGISTRY.bucket_bounds("conflicted.seconds") == (0.1, 1.0)
+
+
+def test_windowed_histogram_expires_old_intervals():
+    """The ring covers only the window: samples older than
+    window_seconds stop influencing the merged percentiles."""
+    t = [0.0]
+    wh = metrics.WindowedHistogram(
+        "windowed.latency_seconds",
+        window_seconds=10.0,
+        intervals=5,
+        bounds=metrics.LATENCY_MS_BUCKETS,
+        clock=lambda: t[0],
+    )
+    for _ in range(50):
+        wh.observe(4.0)  # slow epoch
+    t[0] = 1.0
+    for _ in range(50):
+        wh.observe(0.002)
+    m = wh.merged()
+    assert m.count == 100
+    assert wh.percentile(99) > 1.0  # the slow epoch dominates p99
+    t[0] = 12.0  # the slow interval has aged out of the window
+    for _ in range(50):
+        wh.observe(0.002)
+    assert wh.merged().count == 50
+    assert wh.percentile(99) < 0.01
+    # the cumulative registry series kept everything (feeds /metrics)
+    snap = metrics.snapshot()["histograms"]["windowed.latency_seconds"]
+    assert snap["count"] == 150
+
+
+def test_windowed_histogram_percentiles_and_fraction():
+    t = [0.0]
+    wh = metrics.WindowedHistogram(
+        "pct.latency_seconds",
+        window_seconds=60.0,
+        intervals=6,
+        bounds=metrics.LATENCY_MS_BUCKETS,
+        clock=lambda: t[0],
+    )
+    assert wh.percentile(99) is None  # empty window
+    for v in (0.001, 0.002, 0.003, 0.004, 0.100):
+        wh.observe(v)
+    p50 = wh.percentile(50)
+    assert 0.001 <= p50 <= 0.01
+    assert wh.percentile(99) <= 0.100
+    frac = wh.fraction_above(0.010)
+    assert 0.1 <= frac <= 0.3  # 1 of 5 samples above 10 ms
+    s = wh.summary()
+    assert s["count"] == 5 and s["max"] == 0.100

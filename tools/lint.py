@@ -42,7 +42,16 @@ Rules:
   block_weighted_ls, lbfgs): a host read of a device value there
   stalls the async dispatch pipeline the fit-path dataflow relies on
   (double-buffered staging + donated epoch carries).  A deliberate,
-  obs-gated read takes a trailing ``# lint: allow-host-sync``.
+  obs-gated read takes a trailing ``# lint: allow-host-sync``;
+- ``attr``         — literal keyword attribute keys at span/event emit
+  sites (``ledger.span/event(...)``, flight-recorder
+  ``rec.annotate/finish/batch/batch_update/ops(...)``) must be
+  snake_case members of the registered vocabulary
+  (``keystone_tpu/obs/ledger.py``'s ``ATTR_VOCABULARY``, parsed from
+  the AST like the fault-site registry): a typo'd key vanishes
+  silently into the JSONL/ring stream and every reader (obs_report,
+  trace_report, jq) quietly reads nothing.  One-off escape:
+  ``# lint: allow-attr``.
 
 Escape hatch: a trailing ``# lint: allow-<rule>`` comment allowlists
 one line, visibly.
@@ -65,6 +74,23 @@ from typing import Dict, List, Optional, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TARGET = os.path.join(REPO_ROOT, "keystone_tpu")
 FAULTS_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "faults.py")
+OBS_LEDGER_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "obs", "ledger.py")
+
+#: span/event attribute keys must be snake_case (and registered)
+ATTR_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: ledger emit methods (receiver must look like the ledger module or a
+#: bound active-ledger variable) and flight-recorder emit methods
+#: (receiver must look like a recorder binding) whose literal keyword
+#: names the ``attr`` rule checks against the registered vocabulary
+_LEDGER_EMITS = frozenset({"span", "event"})
+_LEDGER_RECEIVERS = frozenset({"ledger", "led", "_ledger"})
+_RECORDER_EMITS = frozenset({"annotate", "finish", "batch", "batch_update", "ops"})
+_RECORDER_RECEIVERS = frozenset({"rec", "recorder"})
+#: named parameters of recorder emit methods that are API control
+#: flags, not stream attributes — exempt from the vocabulary so the
+#: vocabulary documents ONLY what actually appears in the stream
+_RECORDER_CONTROL_KWARGS = frozenset({"only_live"})
 
 #: registry-convention metric names: subsystem.name[.more], lowercase
 METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
@@ -141,6 +167,26 @@ def load_registered_sites(faults_path: str = FAULTS_PATH) -> frozenset:
                             and isinstance(e.value, str)
                         )
     raise RuntimeError(f"could not locate SITES registry in {faults_path}")
+
+
+def load_attr_vocabulary(ledger_path: str = OBS_LEDGER_PATH) -> frozenset:
+    """Parse ``ATTR_VOCABULARY = {...}`` out of obs/ledger.py WITHOUT
+    importing the package (the :func:`load_registered_sites`
+    discipline)."""
+    with open(ledger_path) as f:
+        tree = ast.parse(f.read(), filename=ledger_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "ATTR_VOCABULARY":
+                    if isinstance(node.value, ast.Set):
+                        return frozenset(
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+    raise RuntimeError(f"could not locate ATTR_VOCABULARY in {ledger_path}")
 
 
 def _allowed(lines: List[str], lineno: int, rule: str) -> bool:
@@ -313,11 +359,14 @@ def lint_source(
     metric_kinds: Dict[str, Tuple[str, str, int]],
     supervised: Optional[bool] = None,
     solver_scoped: Optional[bool] = None,
+    attr_vocab: Optional[frozenset] = None,
 ) -> List[Violation]:
     """Lint one file's source.  ``metric_kinds`` accumulates
     name → (kind, path, line) across files for the metric-kind rule.
     ``supervised`` overrides the path-based wall-clock scoping, and
-    ``solver_scoped`` the host-sync scoping (tests)."""
+    ``solver_scoped`` the host-sync scoping (tests).  ``attr_vocab``:
+    the registered span/event attribute vocabulary — None skips the
+    ``attr`` rule (``lint_paths`` loads it from obs/ledger.py)."""
     out: List[Violation] = []
     lines = source.splitlines()
     try:
@@ -435,6 +484,39 @@ def lint_source(
                             "instrument kinds are exclusive per name",
                         )
                     )
+        # ---- attr: span/event attribute keys from the registered vocab
+        if attr_vocab is not None and isinstance(func, ast.Attribute):
+            recv = func.value
+            is_emit = isinstance(recv, ast.Name) and (
+                (func.attr in _LEDGER_EMITS and recv.id in _LEDGER_RECEIVERS)
+                or (
+                    func.attr in _RECORDER_EMITS
+                    and recv.id in _RECORDER_RECEIVERS
+                )
+            )
+            if is_emit:
+                recorder_emit = func.attr in _RECORDER_EMITS
+                for kw in node.keywords:
+                    if kw.arg is None:  # **attrs splat: dynamic, not ours
+                        continue
+                    if recorder_emit and kw.arg in _RECORDER_CONTROL_KWARGS:
+                        continue  # API flag, never lands in the stream
+                    if (
+                        ATTR_KEY_RE.match(kw.arg)
+                        and kw.arg in attr_vocab
+                    ) or _allowed(lines, kw.value.lineno, "attr"):
+                        continue
+                    out.append(
+                        Violation(
+                            rel_path,
+                            kw.value.lineno,
+                            "attr",
+                            f"span/event attribute key {kw.arg!r} is not a "
+                            "snake_case member of the registered vocabulary "
+                            "(obs/ledger.ATTR_VOCABULARY) — a typo'd key "
+                            "vanishes silently from every trace reader",
+                        )
+                    )
         # ---- wall-clock: time.time() in supervised modules
         if (
             supervised
@@ -528,9 +610,15 @@ def lint_source(
     return out
 
 
-def lint_paths(paths: List[str], sites: Optional[frozenset] = None) -> List[Violation]:
+def lint_paths(
+    paths: List[str],
+    sites: Optional[frozenset] = None,
+    attr_vocab: Optional[frozenset] = None,
+) -> List[Violation]:
     if sites is None:
         sites = load_registered_sites()
+    if attr_vocab is None:
+        attr_vocab = load_attr_vocabulary()
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -547,7 +635,9 @@ def lint_paths(paths: List[str], sites: Optional[frozenset] = None) -> List[Viol
         rel = os.path.relpath(path, REPO_ROOT)
         with open(path) as f:
             source = f.read()
-        violations.extend(lint_source(rel, source, sites, metric_kinds))
+        violations.extend(
+            lint_source(rel, source, sites, metric_kinds, attr_vocab=attr_vocab)
+        )
     return violations
 
 
