@@ -165,6 +165,19 @@ FLEET_MAX_BATCH = 32
 FLEET_QUEUE_BOUND = 256
 FLEET_DEADLINE_MS = 1500.0
 FLEET_BATCH_DELAY_MS = 40.0
+
+# --- hedging leg (ISSUE 10): the same open-loop workload against a
+# 2-replica fleet with ONE injected straggler (replica 0 stalls every
+# flush), hedging on vs off, order-alternated in one process (the
+# run_overhead_pair discipline — below the collapse knee, so the A/B is
+# measurable).  The acceptance claim: hedging cuts p99 (queued flushes
+# escape the straggler's queue) at ≤ 5% achieved-QPS cost — losers are
+# claim-skips, not duplicate device work.
+HEDGE_LEGS = int(os.environ.get("BENCH_HEDGE_LEGS", "1"))
+HEDGE_QPS = float(os.environ.get("BENCH_HEDGE_QPS", "250"))
+HEDGE_ROUNDS = int(os.environ.get("BENCH_HEDGE_ROUNDS", "4"))
+HEDGE_STRAGGLER_MS = 60.0
+HEDGE_FLOOR_MS = 10.0
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -705,6 +718,24 @@ def main():
         print(json.dumps(rep))
         return
 
+    if "--leg-serve-hedge" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                serve_bench.run_straggler_ab(
+                    qps=HEDGE_QPS,
+                    duration=SERVE_DURATION_S,
+                    rounds=HEDGE_ROUNDS,
+                    replicas=2,
+                    max_batch=SERVE_MAX_BATCH,
+                    straggler_ms=HEDGE_STRAGGLER_MS,
+                    hedge_ms=HEDGE_FLOOR_MS,
+                )
+            )
+        )
+        return
+
     if "--leg-solver-scale" in sys.argv:
         print(json.dumps(measure_solver_at_scale()))
         return
@@ -872,6 +903,16 @@ def main():
         else None
     )
 
+    # hedging leg (ISSUE 10): the straggler A/B — hedging on vs off
+    # against an injected per-replica stall; needs 2 host devices
+    hedge_leg = (
+        subprocess_leg(
+            "--leg-serve-hedge", required=("hedging",), env=fleet_env
+        )
+        if HEDGE_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1009,6 +1050,10 @@ def main():
                     float(fv["achieved_qps"]) / single, 2
                 )
         out["serve_fleet"] = fv
+    if hedge_leg:
+        # p99_ratio < 1 = hedging rescued the straggler's queue;
+        # qps_cost <= 0.05 = the acceptance budget
+        out["serve_hedge"] = hedge_leg
     if fit_scale_legs:
         fss = [float(lg["fit_seconds"]) for lg in fit_scale_legs]
         out["fit_at_scale"] = {

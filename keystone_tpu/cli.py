@@ -128,6 +128,49 @@ def _serve_main(argv) -> int:
         "responses still echo a request id (client log correlation); "
         "nothing records or resolves it server-side",
     )
+    ap.add_argument(
+        "--no-supervise",
+        action="store_true",
+        help="disable the replica supervisor (self-healing: dead/wedged "
+        "worker detection, in-place restart, quarantine after repeated "
+        "deaths).  On by default.",
+    )
+    ap.add_argument(
+        "--heartbeat-s",
+        type=float,
+        default=30.0,
+        help="wedge budget: a replica worker holding one flush longer "
+        "than this is declared wedged and restarted — size it above "
+        "the slowest honest apply",
+    )
+    ap.add_argument(
+        "--restart-limit",
+        type=int,
+        default=3,
+        help="supervisor restarts allowed per replica within "
+        "--restart-window-s before the slot is quarantined",
+    )
+    ap.add_argument(
+        "--restart-window-s",
+        type=float,
+        default=60.0,
+        help="the sliding window the restart budget counts over",
+    )
+    ap.add_argument(
+        "--hedge-ms",
+        type=float,
+        default=None,
+        help="hedged dispatch (off by default): re-enqueue a batch "
+        "still unflushed after max(this, 3x the EWMA batch time) on a "
+        "second replica; first claim wins, the loser is cancelled "
+        "without device work.  Needs --replicas >= 2.",
+    )
+    ap.add_argument(
+        "--no-bisect",
+        action="store_true",
+        help="disable batch-failure bisection (poison-request "
+        "isolation + content quarantine).  On by default.",
+    )
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument(
@@ -177,6 +220,12 @@ def _serve_main(argv) -> int:
         recorder=not args.no_recorder,
         slo_ms=args.slo_ms,
         slo_target=args.slo_target,
+        supervise=not args.no_supervise,
+        heartbeat_s=args.heartbeat_s,
+        restart_limit=args.restart_limit,
+        restart_window_s=args.restart_window_s,
+        hedge_ms=args.hedge_ms,
+        bisect=not args.no_bisect,
     )
     watcher = None
     if args.watch is not None:

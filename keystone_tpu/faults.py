@@ -19,6 +19,11 @@ Named **sites** are threaded through the codebase::
     executor.stage      GraphExecutor stage execution (inside retry scope)
     serve.enqueue       serve.PipelineService.submit (admission path)
     serve.batch         serve micro-batch flush (batcher worker thread)
+    serve.worker        serve replica worker loop, per popped flush —
+                        ``raise`` CRASHES the worker thread (the
+                        in-hand flush is requeued for the supervisor's
+                        restart), ``hang`` wedges it; this is how chaos
+                        plans kill a live worker, not just one flush
 
 A **plan** activates faults at sites, either via the ``inject`` context
 manager (tests) or the ``KEYSTONE_FAULTS`` environment variable — the
@@ -38,7 +43,13 @@ Plan grammar: ``site:token:token;site:token...`` where tokens are
   (halve the site's file), ``exit`` / ``exit=CODE`` (``os._exit`` — the
   kill-worker action), and the **latency actions** ``delay=SECONDS``
   (stall the operation, then let it proceed) and ``hang`` (stall far
-  past any deadline — ``KEYSTONE_HANG_SECONDS``, default 3600 s).
+  past any deadline — ``KEYSTONE_HANG_SECONDS``, default 3600 s);
+- context matches: ``ctx.<key>=<value>`` restricts the spec to calls
+  whose site context carries that value (string-compared), e.g.
+  ``serve.replica:ctx.replica=0:delay=0.05`` stalls replica 0's
+  flushes only — the straggler leg of ``tools/serve_bench.py`` and
+  single-replica chaos plans ride this.  Non-matching calls do not
+  advance the spec's triggers (``after=N`` counts matching calls).
   Latency actions are valid at every site; the stalls ride
   ``utils.guard.interruptible_sleep``, so a watchdog
   (``guard.run_with_deadline``) that gives up on the hung operation
@@ -79,6 +90,7 @@ SITES = {
     "serve.batch",
     "serve.replica",
     "serve.swap",
+    "serve.worker",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")
@@ -147,6 +159,7 @@ class SiteSpec:
         times: Optional[int] = None,
         exit_code: int = 42,
         delay_seconds: float = 0.0,
+        match: Optional[Dict[str, str]] = None,
     ):
         self.site = site
         self.action = action
@@ -157,7 +170,15 @@ class SiteSpec:
         self.times = None if times is None else int(times)
         self.exit_code = int(exit_code)
         self.delay_seconds = float(delay_seconds)
+        #: ctx.<key>=<value> clauses: the spec applies only to calls
+        #: whose fault_point context matches every entry (str-compared)
+        self.match = dict(match) if match else None
         self.reset()
+
+    def matches(self, ctx: Dict) -> bool:
+        if not self.match:
+            return True
+        return all(str(ctx.get(k)) == v for k, v in self.match.items())
 
     def reset(self) -> None:
         self.calls = 0
@@ -255,6 +276,13 @@ def parse_plan(text: str) -> FaultPlan:
                 kwargs["p"] = float(val)
             elif key == "seed":
                 kwargs["seed"] = int(val)
+            elif key.startswith("ctx."):
+                if not val:
+                    raise FaultPlanError(
+                        f"context match needs a value (ctx.replica=0), "
+                        f"got {tok!r} in clause {clause!r}"
+                    )
+                kwargs.setdefault("match", {})[key[4:]] = val
             else:
                 raise FaultPlanError(
                     f"bad fault token {tok!r} in clause {clause!r}"
@@ -373,6 +401,8 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
         return
     for plan in reversed(plans):  # innermost inject() wins
         for spec in plan.for_site(site):
+            if not spec.matches(ctx):
+                continue  # triggers advance on MATCHING calls only
             with _LOCK:
                 fire = spec.should_fire(phase)
                 if fire:

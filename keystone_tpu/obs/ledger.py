@@ -81,11 +81,13 @@ ATTR_VOCABULARY = {
     "checkpoint_save_seconds",
     "chunk_seconds",
     "degraded",
+    "depth",
     "epoch",
     "epoch_seconds",
     "error",
     "failed_attempt_seconds",
     "from_state",
+    "from_replica",
     "grad_norm",
     "instances",
     "it",
@@ -101,6 +103,7 @@ ATTR_VOCABULARY = {
     "pause_seconds",
     "pid",
     "pinned_bytes",
+    "poisons",
     "predicted_seconds",
     "prime_seconds",
     "queue_depth",
@@ -110,6 +113,7 @@ ATTR_VOCABULARY = {
     "replicas",
     "request_id",
     "request_ids",
+    "restarts",
     "retries",
     "rows",
     "rule",
@@ -123,6 +127,7 @@ ATTR_VOCABULARY = {
     "substitute",
     "tag",
     "to_state",
+    "to_replica",
     "version",
     "waited_seconds",
 }

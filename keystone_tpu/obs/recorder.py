@@ -59,7 +59,9 @@ from typing import Dict, List, Optional
 from keystone_tpu.obs.ledger import _json_safe
 
 #: terminal outcomes that pin a trace into the long-retention ring
-PINNED_OUTCOMES = frozenset({"shed", "rejected", "error", "degraded"})
+#: ("poison": a request isolated by batch bisection — exactly the trace
+#: an operator wants long after the happy-path flood evicted its peers)
+PINNED_OUTCOMES = frozenset({"shed", "rejected", "error", "degraded", "poison"})
 
 #: recompute the rolling-p99 slow threshold every this many finishes
 #: (amortizes the sort; a per-finish sort would blow the overhead budget)

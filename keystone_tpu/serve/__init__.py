@@ -10,7 +10,12 @@ whether or not a service exists in the process (pinned by
 tests/test_serve.py).
 """
 
-from keystone_tpu.serve.fleet import Replica, ReplicaPool  # noqa: F401
+from keystone_tpu.serve.fleet import (  # noqa: F401
+    FleetUnavailable,
+    Replica,
+    ReplicaPool,
+    ReplicaSupervisor,
+)
 from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
 from keystone_tpu.serve.registry import (  # noqa: F401
     ModelRegistry,
@@ -20,18 +25,22 @@ from keystone_tpu.serve.registry import (  # noqa: F401
 from keystone_tpu.serve.service import (  # noqa: F401
     Overloaded,
     PipelineService,
+    PoisonRequest,
     ServiceClosed,
     default_buckets,
     serve,
 )
 
 __all__ = [
+    "FleetUnavailable",
     "HttpFrontend",
     "ModelRegistry",
     "Overloaded",
     "PipelineService",
+    "PoisonRequest",
     "Replica",
     "ReplicaPool",
+    "ReplicaSupervisor",
     "RegistryError",
     "RegistryWatcher",
     "ServiceClosed",

@@ -17,10 +17,19 @@ story.  This module is that layer:
   ``<service>.replica.<i>``) charged by flush outcomes.
 - **ReplicaPool** — the router.  ``dispatch`` picks the replica with the
   fewest outstanding flushes whose breaker admits work (a tripped
-  replica is routed *around* until its half-open probe); when every
-  breaker refuses, the least-loaded replica serves anyway (degraded
-  service beats refusing the whole fleet — counted as
-  ``serve.router_forced``).
+  replica is routed *around* until its half-open probe); when NO
+  replica can serve — every slot quarantined/dead, every routable
+  breaker open — it FAILS FAST with :class:`FleetUnavailable` (503 +
+  derived ``Retry-After`` at HTTP, non-200 ``/healthz``) instead of
+  force-routing into the dead pool; the supervisor's first successful
+  restart (or a breaker's half-open probe) re-admits traffic.
+- **ReplicaSupervisor** — the self-healing loop: dead workers (thread
+  exited — e.g. an injected ``serve.worker`` crash) and wedged workers
+  (flush held past the heartbeat budget) are restarted in place —
+  re-clone + re-place from the pool's source, re-prime, rejoin the
+  router with queued work transferred — and a slot that keeps dying is
+  quarantined (``serve.replica_restarts`` / ``serve.quarantined``
+  metrics, ``replica.restart`` ledger + recorder ops spans).
 - **Blue/green swap** — ``stage()`` builds a full staged generation of
   replicas for a new model version on the same devices (the caller
   primes their padding-bucket programs while the old generation keeps
@@ -50,7 +59,8 @@ import logging
 import pickle
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
 
 from keystone_tpu.faults import fault_point
 from keystone_tpu.obs import ledger, metrics
@@ -61,6 +71,26 @@ logger = logging.getLogger(__name__)
 #: replica breakers default to a short reset so a swapped-in healthy
 #: model is probed within seconds, not the 30 s stage-retry default
 DEFAULT_REPLICA_BREAKER_RESET = 5.0
+
+#: how long a replica worker may go between heartbeats with a flush in
+#: hand before the supervisor declares it wedged.  Generous by default:
+#: priming at construction keeps in-band compiles rare, but a legit
+#: apply longer than this budget WILL be treated as a wedge — size it
+#: above the slowest honest flush.
+DEFAULT_HEARTBEAT_SECONDS = 30.0
+
+
+class FleetUnavailable(RuntimeError):
+    """Every replica is quarantined, dead, or breaker-open: the fleet
+    cannot serve right now.  Deliberately NOT an ``OSError`` — retrying
+    into a dead pool is futile; recovery is the supervisor's restart or
+    a breaker's half-open probe, both time-based.  The HTTP front end
+    maps this to 503 with a ``Retry-After`` derived from
+    :meth:`ReplicaPool.retry_after_unavailable`."""
+
+    def __init__(self, message: str, retry_after_seconds: float = 1.0):
+        super().__init__(message)
+        self.retry_after_seconds = float(retry_after_seconds)
 
 
 def _place_on_device(obj, device, _seen=None, _depth=0):
@@ -151,6 +181,7 @@ class Replica:
         version: str = "v0",
         breaker: Optional[guard.CircuitBreaker] = None,
         pool_name: str = "serve",
+        heartbeat_timeout: float = DEFAULT_HEARTBEAT_SECONDS,
     ):
         self.index = int(index)
         self.applier = applier
@@ -166,10 +197,45 @@ class Replica:
         self.outstanding = 0
         self.flushes = 0
         self.errors = 0
+        #: supervision state: the worker beats once per loop iteration
+        #: (and on enqueue, so a just-woken idle worker is never stale);
+        #: ``inflight`` is the flush the worker currently holds —
+        #: inflight + expired heartbeat = wedged.  ``dead`` marks a
+        #: crashed worker (its thread exited without retirement);
+        #: ``quarantined`` takes the replica out of routing entirely
+        #: until a swap installs a fresh generation.
+        self.heartbeat = guard.Heartbeat(heartbeat_timeout)
+        self.inflight = None
+        self.dead = False
+        self.dead_error: Optional[str] = None
+        self.quarantined = False
+        #: how many times this SLOT has been restarted (carried onto
+        #: replacements by the supervisor, so /statusz shows history)
+        self.restarts = 0
         self._q: list = []
         self._cond = threading.Condition()
         self._worker: Optional[threading.Thread] = None
         self._retired = False
+
+    def is_dead(self) -> bool:
+        """A worker that exited WITHOUT being retired: either the crash
+        handler flagged it, or the thread is gone (killed by an
+        uncontained error)."""
+        if self.dead:
+            return True
+        w = self._worker
+        return (
+            w is not None
+            and w.ident is not None
+            and not w.is_alive()
+            and not self._retired
+        )
+
+    def routable(self) -> bool:
+        """May the router consider this replica at all (breaker state
+        aside)?  Quarantined, dead, and retired replicas are not
+        eligible — their queues are not being drained."""
+        return not (self.quarantined or self.dead or self._retired)
 
     # ------------------------------------------------------------ apply
     def apply(self, ds, deadline=None, prime: bool = False):
@@ -199,12 +265,41 @@ class Replica:
                     item = self._q.pop(0)
                 if item is _SENTINEL:
                     return
+                self.inflight = item
+                self.heartbeat.beat()
                 try:
+                    # the worker-level fault site: a ``raise`` here is a
+                    # WORKER CRASH (the thread dies; the in-hand flush is
+                    # requeued at the front so the supervisor's
+                    # replacement serves it — zero futures lost), and a
+                    # ``hang`` wedges the worker (inflight set, heartbeat
+                    # going stale) for the supervisor to detect.
+                    fault_point("serve.worker", replica=self.index)
                     runner(self, item)
-                except BaseException:  # runner owns failure delivery
-                    logger.exception(
-                        "replica %d flush runner raised", self.index
+                except BaseException as e:
+                    # anything escaping here is a worker crash from
+                    # BEFORE the runner claimed the flush (the injected
+                    # serve.worker fault, or a pre-claim bug — the
+                    # runner fails its own riders for post-claim
+                    # escapes), so the front-requeue is always safe:
+                    # the supervisor's replacement worker pops it with
+                    # the claim intact and serves it.  The thread
+                    # exits; the supervisor detects the death via
+                    # is_dead().
+                    with self._cond:
+                        self._q.insert(0, item)
+                    self.inflight = None
+                    self.dead_error = f"{type(e).__name__}: {e}"
+                    self.dead = True
+                    logger.error(
+                        "replica %d worker crashed: %s",
+                        self.index,
+                        self.dead_error,
                     )
+                    return
+                finally:
+                    self.inflight = None
+                    self.heartbeat.beat()
 
         self._worker = threading.Thread(
             target=loop,
@@ -216,7 +311,25 @@ class Replica:
     def enqueue(self, batch) -> None:
         with self._cond:
             self._q.append(batch)
+            # beat on enqueue: an idle worker's last beat may be long
+            # ago — without this, work arriving after an idle stretch
+            # reads as "outstanding + stale heartbeat" for the instant
+            # before the worker wakes, a false wedge
+            self.heartbeat.beat()
             self._cond.notify()
+
+    def drain_queue(self) -> List:
+        """Atomically take every queued (non-sentinel) flush, retire the
+        worker (the sentinel makes a merely-wedged worker exit when it
+        unsticks), and return the flushes for the caller to transfer or
+        fail.  The supervisor's restart/quarantine path."""
+        with self._cond:
+            left = [b for b in self._q if b is not _SENTINEL]
+            self._q.clear()
+            self._retired = True
+            self._q.append(_SENTINEL)
+            self._cond.notify()
+        return left
 
     def retire(self) -> None:
         """Queue the stop sentinel BEHIND any already-dispatched flushes
@@ -249,6 +362,9 @@ class Replica:
             "outstanding": self.outstanding,
             "flushes": self.flushes,
             "errors": self.errors,
+            "dead": self.is_dead(),
+            "quarantined": self.quarantined,
+            "restarts": self.restarts,
         }
 
 
@@ -270,6 +386,7 @@ class ReplicaPool:
         version: str = "v0",
         name: str = "serve",
         dispatch_window: int = 2,
+        heartbeat_s: float = DEFAULT_HEARTBEAT_SECONDS,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -279,6 +396,19 @@ class ReplicaPool:
             )
         self.name = name
         self._lock = threading.Lock()
+        #: the fitted pipeline (or applier) the CURRENT generation was
+        #: built from — the supervisor re-clones replacement replicas
+        #: from it, so an in-place restart serves the same version the
+        #: crashed worker did.  stage()/commit() move it with the
+        #: generation.
+        self._source = pipeline
+        self._staged_source = None
+        self._heartbeat_s = float(heartbeat_s)
+        #: sticky hint set when dispatch finds the whole fleet
+        #: unavailable, cleared by the next availability recheck or a
+        #: restart/commit — lets submit-side admission refuse fast
+        #: (one attribute read) without polling breakers per request
+        self._known_unavailable = False
         #: flow control between the batcher and the replica queues:
         #: ``dispatch`` blocks while EVERY replica already holds
         #: ``dispatch_window`` outstanding flushes (one computing + one
@@ -312,24 +442,36 @@ class ReplicaPool:
         local = jax.local_devices()
         return [local[i % len(local)] for i in range(n)]
 
+    def _build_one(
+        self, source, index: int, device, version, n: int,
+        force_clone: bool = False,
+    ) -> Replica:
+        """One replica for slot ``index``: the direct-wrap fast path for
+        a 1-replica deviceless pool, the clone+place path otherwise —
+        shared by initial build, staged generations, and the
+        supervisor's in-place restarts (which pass ``force_clone``: the
+        replaced worker may still be EXECUTING inside the old applier,
+        and two threads must never share transformer instances / jit
+        caches)."""
+        if device is None and n == 1 and not force_clone:
+            applier = _as_applier(source)
+        else:
+            applier = _as_applier(_clone_and_place(source, device))
+        return Replica(
+            index,
+            applier,
+            device=device,
+            version=version,
+            pool_name=self.name,
+            heartbeat_timeout=self._heartbeat_s,
+        )
+
     def _build(self, pipeline, n: int, devices, version) -> List[Replica]:
         devs = self._devices_for(n, devices)
-        out = []
-        for i, dev in enumerate(devs):
-            if dev is None and n == 1:
-                applier = _as_applier(pipeline)
-            else:
-                applier = _as_applier(_clone_and_place(pipeline, dev))
-            out.append(
-                Replica(
-                    i,
-                    applier,
-                    device=dev,
-                    version=version,
-                    pool_name=self.name,
-                )
-            )
-        return out
+        return [
+            self._build_one(pipeline, i, dev, version, n)
+            for i, dev in enumerate(devs)
+        ]
 
     @property
     def size(self) -> int:
@@ -348,35 +490,67 @@ class ReplicaPool:
             r.start(runner, obs_context)
 
     def dispatch(self, batch) -> Replica:
-        """Route one batch: least outstanding work first, skipping
+        """Route one batch: least outstanding work first among
+        ROUTABLE replicas (not quarantined/dead/retired), skipping
         replicas whose breaker refuses (``allow()`` on the chosen
-        replica doubles as the half-open probe admission).  All-open
-        falls back to the least-loaded replica — refusing the entire
-        fleet would turn one bad model generation into a total outage,
-        and the probe path needs traffic to ever close a breaker.
+        replica doubles as the half-open probe admission).
 
-        Blocks while every replica is at the dispatch window — the
-        backpressure that makes submit-side admission control real (the
-        bound is per-replica occupancy, so it is soft in the degraded
-        all-breakers-open case where routing ignores load)."""
+        When NO replica can serve — all quarantined/dead, or every
+        routable breaker refusing — raises :class:`FleetUnavailable`
+        instead of force-routing into the dead pool: the batcher fails
+        the batch fast (503 at HTTP, with a derived ``Retry-After``),
+        ``/healthz`` turns non-200, and traffic is re-admitted by the
+        supervisor's first successful restart or a breaker's half-open
+        probe (a fresh replacement carries a CLOSED breaker).
+
+        Blocks while every routable replica is at the dispatch window —
+        the backpressure that makes submit-side admission control real."""
         with self._cond:
-            while (
-                not self._draining
-                and self.replicas
-                and min(r.outstanding for r in self.replicas) >= self._window
-            ):
-                # timed: a commit/complete notify can land between the
-                # predicate and the wait on another generation's list
-                self._cond.wait(0.05)
-            order = sorted(self.replicas, key=lambda r: (r.outstanding, r.index))
-            chosen = None
-            for r in order:
-                if r.breaker.allow():
-                    chosen = r
+            while True:
+                if self._draining:
+                    # shutdown: park the batch in SOME queue so close()
+                    # collects it as abandoned and fails its futures —
+                    # eligibility no longer matters
+                    order = sorted(
+                        self.replicas, key=lambda r: (r.outstanding, r.index)
+                    )
+                    if not order:
+                        raise FleetUnavailable("replica pool is empty")
+                    chosen = order[0]
                     break
-            if chosen is None:
-                chosen = order[0]
-                metrics.inc("serve.router_forced")
+                routable = [r for r in self.replicas if r.routable()]
+                if not routable:
+                    self._known_unavailable = True
+                    raise FleetUnavailable(
+                        f"fleet {self.name!r}: every replica is "
+                        "quarantined or dead; awaiting supervisor restart",
+                        retry_after_seconds=self._retry_after_for(routable),
+                    )
+                if min(r.outstanding for r in routable) >= self._window:
+                    # timed: a commit/complete notify can land between
+                    # the predicate and the wait on another generation
+                    self._cond.wait(0.05)
+                    continue
+                order = sorted(routable, key=lambda r: (r.outstanding, r.index))
+                chosen = None
+                for r in order:
+                    if r.breaker.allow():
+                        chosen = r
+                        break
+                if chosen is None:
+                    self._known_unavailable = True
+                    eta = self._retry_after_for(routable)
+                    raise FleetUnavailable(
+                        f"fleet {self.name!r}: every replica breaker is "
+                        f"open; next half-open probe in {eta:.1f}s",
+                        retry_after_seconds=eta,
+                    )
+                break
+            self._known_unavailable = False
+            try:
+                batch.primary = chosen.index
+            except AttributeError:
+                pass  # raw batches (tests) need no hedge bookkeeping
             chosen.outstanding += 1
             metrics.set_gauge(
                 "serve.replica_outstanding",
@@ -393,6 +567,102 @@ class ReplicaPool:
             chosen.enqueue(batch)
         return chosen
 
+    def hedge_dispatch(
+        self,
+        batch,
+        exclude_index: Optional[int] = None,
+        respect_window: bool = True,
+    ):
+        """Best-effort second dispatch of an already-routed batch onto a
+        DIFFERENT replica (the hedging path): least-outstanding routable
+        replica other than ``exclude_index`` with window headroom and an
+        admitting breaker.  Never blocks and never raises — returns the
+        chosen replica, or None when no second replica can take it (the
+        hedge is simply skipped).  ``respect_window=False`` is the
+        supervisor's redistribution mode: stranded work from a healed/
+        quarantined slot lands on a survivor even when the survivors
+        are momentarily at the dispatch window — extra queueing beats
+        failing admitted requests a living fleet could serve."""
+        with self._cond:
+            if self._draining:
+                return None
+            cands = sorted(
+                (
+                    r
+                    for r in self.replicas
+                    if r.index != exclude_index
+                    and r.routable()
+                    and (not respect_window or r.outstanding < self._window)
+                ),
+                key=lambda r: (r.outstanding, r.index),
+            )
+            chosen = None
+            for r in cands:
+                if r.breaker.allow():
+                    chosen = r
+                    break
+            if chosen is None:
+                return None
+            chosen.outstanding += 1
+            metrics.set_gauge(
+                "serve.replica_outstanding",
+                chosen.outstanding,
+                replica=chosen.index,
+            )
+            chosen.enqueue(batch)
+        return chosen
+
+    # ------------------------------------------------------ availability
+    def _compute_available(self) -> bool:
+        with self._lock:
+            replicas = list(self.replicas)
+        # breaker.state() (not allow()): read-only resolution, so an
+        # availability poll can never consume a half-open probe slot
+        return any(
+            r.routable() and r.breaker.state() != guard.OPEN for r in replicas
+        )
+
+    def available(self) -> bool:
+        """Can the fleet accept traffic right now?  One attribute read
+        on the happy path (the per-submit admission check); the full
+        breaker scan runs only while the router has flagged the fleet
+        down (and clears the flag as soon as a breaker's half-open
+        window or a restart re-admits)."""
+        if not self._known_unavailable:
+            return True
+        if self._compute_available():
+            self._known_unavailable = False
+            return True
+        return False
+
+    def available_now(self) -> bool:
+        """The FULL availability scan, flag refreshed from the result —
+        for low-rate health surfaces (``/healthz``, ``/statusz``) that
+        must see an all-dead fleet even before any dispatch tried (and
+        whose verdict then primes the cheap admission check)."""
+        ok = self._compute_available()
+        self._known_unavailable = not ok
+        return ok
+
+    @staticmethod
+    def _retry_after_for(replicas: List[Replica]) -> float:
+        """The soonest half-open probe among these replicas' breakers,
+        else 1 s (the supervisor restart path has no fixed ETA).  Takes
+        no pool lock — callable from inside dispatch."""
+        etas = [
+            e
+            for e in (r.breaker.seconds_until_probe() for r in replicas)
+            if e > 0.0
+        ]
+        return min(etas) if etas else 1.0
+
+    def retry_after_unavailable(self) -> float:
+        """Seconds until the fleet could plausibly serve again — what an
+        unavailable 503's ``Retry-After`` should carry."""
+        with self._lock:
+            replicas = [r for r in self.replicas if r.routable()]
+        return self._retry_after_for(replicas)
+
     def complete(self, replica: Replica, ok: Optional[bool]) -> None:
         """Account one finished flush: outstanding/queue-share updates
         plus the breaker charge.  ``ok=True`` records a success (closes
@@ -408,21 +678,28 @@ class ReplicaPool:
             replica.flushes += 1
             if ok is False:
                 replica.errors += 1
-            metrics.set_gauge(
-                "serve.replica_outstanding",
-                replica.outstanding,
-                replica=replica.index,
-            )
+            # gauge writes only for replicas still IN the routing list:
+            # a swapped-out/healed slot's late-finishing worker would
+            # otherwise clobber its replacement's series for the same
+            # index with a stale count
+            live = replica in self.replicas
+            if live:
+                metrics.set_gauge(
+                    "serve.replica_outstanding",
+                    replica.outstanding,
+                    replica=replica.index,
+                )
             metrics.inc("serve.replica_flushes", replica=replica.index)
             if ok is False:
                 metrics.inc("serve.replica_errors", replica=replica.index)
-            total = sum(r.flushes for r in self.replicas) or 1
-            for r in self.replicas:
-                metrics.set_gauge(
-                    "serve.replica_queue_share",
-                    r.flushes / total,
-                    replica=r.index,
-                )
+            if live:
+                total = sum(r.flushes for r in self.replicas) or 1
+                for r in self.replicas:
+                    metrics.set_gauge(
+                        "serve.replica_queue_share",
+                        r.flushes / total,
+                        replica=r.index,
+                    )
         if ok is True:
             replica.breaker.record_success()
         elif ok is False:
@@ -437,6 +714,9 @@ class ReplicaPool:
         devices = [r.device for r in self.replicas]
         n = len(devices)
         if n == 1 and devices[0] is None:
+            # staged single-replica generations still clone: the OLD
+            # generation keeps serving the caller's applier while the
+            # staged one primes, so they must not share jit caches
             staged = [
                 Replica(
                     0,
@@ -444,19 +724,15 @@ class ReplicaPool:
                     device=None,
                     version=version,
                     pool_name=self.name,
+                    heartbeat_timeout=self._heartbeat_s,
                 )
             ]
         else:
             staged = [
-                Replica(
-                    i,
-                    _as_applier(_clone_and_place(pipeline, dev)),
-                    device=dev,
-                    version=version,
-                    pool_name=self.name,
-                )
+                self._build_one(pipeline, i, dev, version, n)
                 for i, dev in enumerate(devices)
             ]
+        self._staged_source = pipeline
         if self._runner is not None:
             for r in staged:
                 r.start(self._runner, self._obs_ctx)
@@ -473,6 +749,14 @@ class ReplicaPool:
             if not refused:
                 old, self.replicas = self.replicas, staged
                 self.version = version
+                if self._staged_source is not None:
+                    # the supervisor's restart source moves with the
+                    # generation: replacements serve what the fleet does
+                    self._source = self._staged_source
+                    self._staged_source = None
+                # a fresh generation is healthy by construction: clear
+                # the unavailability hint so admission re-opens
+                self._known_unavailable = False
                 pause = time.perf_counter() - t0
                 # the fresh generation has zero outstanding work: wake a
                 # batcher blocked on the old generation's window
@@ -487,9 +771,98 @@ class ReplicaPool:
             raise RuntimeError(
                 f"replica pool {self.name!r} is closing; swap commit refused"
             )
+        for r in staged:
+            # a swap is the operator's quarantine reset: the fresh
+            # generation's slots start clean
+            metrics.set_gauge("serve.quarantined", 0.0, replica=r.index)
         for r in old:
             r.retire()
         return pause
+
+    # ---------------------------------------------------------- healing
+    def build_replacement(self, old: Replica) -> Replica:
+        """A fresh replica for ``old``'s slot: re-cloned and re-placed
+        from the pool's current source, worker started, NOT yet routed
+        (the caller primes it, then :meth:`adopt_replacement` installs
+        it).  The replacement carries the slot's restart count and a
+        fresh CLOSED breaker — a successful restart re-admits traffic."""
+        with self._lock:
+            n = len(self.replicas)
+            source, version = self._source, self.version
+        fresh = self._build_one(
+            source, old.index, old.device, version, n, force_clone=True
+        )
+        fresh.restarts = old.restarts + 1
+        if self._runner is not None:
+            fresh.start(self._runner, self._obs_ctx)
+        return fresh
+
+    def adopt_replacement(self, old: Replica, fresh: Replica):
+        """Swap ``fresh`` into ``old``'s routing slot under the router
+        lock, transferring old's queued flushes (its in-hand crash
+        requeue included) so no admitted work is dropped.  Returns None
+        on success.  When the slot is gone (a blue/green swap or a
+        close() raced the restart) the replacement is retired and the
+        drained flushes are RETURNED to the caller — re-enqueueing them
+        into ``old`` would strand them forever: a swap-retired replica
+        is never joined, and close() may already be past its join."""
+        with self._cond:
+            # drain UNDER the router lock: a wedged replica is still
+            # routable() until this very swap, and dispatch/hedge both
+            # select-and-enqueue while holding this lock — drained
+            # outside it, a concurrent dispatch could enqueue a batch
+            # into old AFTER the drain (behind the sentinel, in a
+            # replica about to vanish from the list) and its riders
+            # would hang forever.  Replica._cond nests inside the pool
+            # lock here exactly as in dispatch's chosen.enqueue().
+            moved = old.drain_queue()
+            if self._draining:
+                # close() is tearing the pool down: installing the
+                # replacement now would leak its worker past close()'s
+                # snapshot
+                adopted = False
+                i = -1
+            else:
+                try:
+                    i = self.replicas.index(old)
+                except ValueError:
+                    adopted = False
+                else:
+                    adopted = True
+            if adopted:
+                self.replicas[i] = fresh
+                for item in moved:
+                    fresh.enqueue(item)
+                fresh.outstanding = len(moved)
+                metrics.set_gauge(
+                    "serve.replica_outstanding",
+                    fresh.outstanding,
+                    replica=fresh.index,
+                )
+                metrics.set_gauge(
+                    "serve.quarantined", 0.0, replica=fresh.index
+                )
+                self._known_unavailable = False
+                self._cond.notify_all()
+        if not adopted:
+            fresh.retire()
+            return moved
+        old.retire()
+        return None
+
+    def quarantine_replica(self, replica: Replica) -> List:
+        """Mark a replica quarantined (out of routing until a swap
+        installs a fresh generation), drain its queue, and return the
+        stranded flushes for the caller to re-dispatch or fail."""
+        with self._cond:
+            replica.quarantined = True
+            if not any(r.routable() for r in self.replicas):
+                # the LAST routable replica just left: admission must
+                # refuse immediately, not on the next failed dispatch
+                self._known_unavailable = True
+            self._cond.notify_all()
+        metrics.set_gauge("serve.quarantined", 1.0, replica=replica.index)
+        return replica.drain_queue()
 
     # ------------------------------------------------------------ close
     def begin_drain(self) -> None:
@@ -522,3 +895,265 @@ class ReplicaPool:
         with self._lock:
             replicas = list(self.replicas)
         return [r.status() for r in replicas]
+
+
+class ReplicaSupervisor:
+    """The self-healing loop: detect dead or wedged replica workers and
+    restart them in place, quarantining a slot that keeps dying.
+
+    Detection, once per ``interval`` seconds:
+
+    - **dead** — the worker thread exited without being retired (an
+      injected ``serve.worker`` crash, or any error that escaped the
+      runner's own failure delivery).  The crash handler requeued the
+      in-hand flush, so a restart loses nothing.
+    - **wedged** — the thread is alive but has held one flush past the
+      replica's heartbeat budget (``guard.Heartbeat``): a hung apply,
+      an injected ``hang``.  The thread cannot be killed; the wedged
+      replica is swapped out of routing, its QUEUED flushes transfer to
+      the replacement, and its in-hand flush's riders are failed (typed
+      :class:`FleetUnavailable`) so their callers unblock — if the hang
+      ever finishes, late delivery is tolerated and discarded.
+
+    Healing: re-clone + re-place a replacement from the pool's current
+    source (:meth:`ReplicaPool.build_replacement`), prime its padding
+    buckets via the service, then swap it into the routing slot under
+    the router lock (queued work transfers; the replacement's fresh
+    CLOSED breaker re-admits traffic).  ``restart_limit`` restarts
+    within ``restart_window`` seconds quarantine the slot instead —
+    the fleet keeps serving on the survivors, and a blue/green swap
+    resets quarantine.
+
+    Every restart/quarantine is visible: ``serve.replica_restarts`` /
+    ``serve.quarantined{replica=i}`` metrics, a ``replica.restart``
+    ledger span, and a flight-recorder ops span (``/tracez``,
+    ``/statusz``)."""
+
+    def __init__(
+        self,
+        service,
+        interval: float = 0.5,
+        restart_limit: int = 3,
+        restart_window: float = 60.0,
+    ):
+        self.service = service
+        self.interval = max(0.05, float(interval))
+        self.restart_limit = max(1, int(restart_limit))
+        self.restart_window = float(restart_window)
+        self.restarts_total = 0
+        self.quarantined_total = 0
+        self.last_restart: Optional[dict] = None
+        self._history: Dict[int, deque] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop,
+            daemon=True,
+            name=f"{service.name}-supervisor",
+        )
+
+    def start(self) -> "ReplicaSupervisor":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def status(self) -> dict:
+        return {
+            "interval_seconds": self.interval,
+            "restart_limit": self.restart_limit,
+            "restart_window_seconds": self.restart_window,
+            "restarts": self.restarts_total,
+            "quarantined": self.quarantined_total,
+            "last_restart": self.last_restart,
+        }
+
+    # ------------------------------------------------------------ sweep
+    def _loop(self) -> None:
+        ledger.restore_context(self.service._obs_ctx)
+        while not self._stop.wait(self.interval):
+            try:
+                self.check_now()
+            except Exception:  # the healer must never die of a heal
+                logger.exception("replica supervisor sweep failed")
+
+    def check_now(self) -> int:
+        """One detection sweep (the loop body; callable from tests).
+        Returns how many replicas were healed or quarantined."""
+        pool = self.service._pool
+        with pool._lock:
+            replicas = list(pool.replicas)
+        healed = 0
+        for r in replicas:
+            if r.quarantined or r._retired:
+                continue
+            dead = r.is_dead()
+            wedged = (
+                not dead
+                and r.inflight is not None
+                and r.heartbeat.expired()
+            )
+            if not (dead or wedged):
+                continue
+            self._heal(r, "dead" if dead else "wedged")
+            healed += 1
+        return healed
+
+    # ------------------------------------------------------------- heal
+    def _budget_exhausted(self, index: int) -> bool:
+        hist = self._history.setdefault(index, deque())
+        now = time.monotonic()
+        while hist and now - hist[0] > self.restart_window:
+            hist.popleft()
+        return len(hist) >= self.restart_limit
+
+    def _heal(self, replica: Replica, reason: str) -> None:
+        svc = self.service
+        pool = svc._pool
+        if self._budget_exhausted(replica.index):
+            self._quarantine(replica, reason)
+            return
+        self._history[replica.index].append(time.monotonic())
+        # a wedged worker's in-hand flush: grab it BEFORE the swap so
+        # its riders can be failed (their callers are blocked on it)
+        stuck = replica.inflight if reason == "wedged" else None
+        t0 = time.monotonic()
+        with ledger.span(
+            "replica.restart", replica=replica.index, reason=reason
+        ):
+            fresh = pool.build_replacement(replica)
+            try:
+                svc.prime_replacement(fresh)
+            except BaseException as e:
+                # a replacement that cannot prime must not join the
+                # router; leave the slot as-is — the budget entry above
+                # converges repeated failures onto quarantine
+                fresh.retire()
+                metrics.inc(
+                    "serve.replica_restart_failures", replica=replica.index
+                )
+                logger.error(
+                    "replica %d restart failed to prime: %s: %s",
+                    replica.index,
+                    type(e).__name__,
+                    e,
+                )
+                return
+            leftover = pool.adopt_replacement(replica, fresh)
+        if leftover is not None:
+            # a swap/close raced the restart: the slot is gone, but the
+            # drained flushes are admitted work — redistribute them to
+            # the (new-generation) survivors rather than stranding them.
+            # The wedged in-hand flush is NOT in that queue (it was
+            # popped) and no future sweep revisits the vanished slot:
+            # abandon it here too, or its riders hang forever.
+            self._redistribute(leftover, replica, reason)
+            if stuck is not None:
+                self._abandon(stuck, replica, reason)
+            return
+        took = time.monotonic() - t0
+        self.restarts_total += 1
+        metrics.inc("serve.replica_restarts", replica=replica.index)
+        self.last_restart = {
+            "replica": replica.index,
+            "reason": reason,
+            "seconds": round(took, 3),
+            "restarts_in_window": len(self._history[replica.index]),
+            "error": replica.dead_error,
+        }
+        rec = getattr(svc, "recorder", None)
+        if rec is not None:
+            rec.ops(
+                "replica.restart",
+                replica=replica.index,
+                reason=reason,
+                seconds=round(took, 3),
+                restarts=len(self._history[replica.index]),
+                error=replica.dead_error,
+            )
+        logger.warning(
+            "restarted %s replica %d in %.2fs (%d restart(s) in window)",
+            reason,
+            replica.index,
+            took,
+            len(self._history[replica.index]),
+        )
+        if stuck is not None:
+            self._abandon(stuck, replica, reason)
+
+    def _quarantine(self, replica: Replica, reason: str) -> None:
+        svc = self.service
+        stranded = svc._pool.quarantine_replica(replica)
+        self.quarantined_total += 1
+        ledger.event(
+            "replica.quarantine",
+            replica=replica.index,
+            reason=reason,
+            restarts=len(self._history.get(replica.index, ())),
+        )
+        rec = getattr(svc, "recorder", None)
+        if rec is not None:
+            rec.ops(
+                "replica.quarantine",
+                replica=replica.index,
+                reason=reason,
+                restarts=len(self._history.get(replica.index, ())),
+            )
+        logger.error(
+            "quarantined replica %d after %d restarts within %.0fs (%s)",
+            replica.index,
+            len(self._history.get(replica.index, ())),
+            self.restart_window,
+            reason,
+        )
+        self._redistribute(stranded, replica, "quarantined")
+        stuck = replica.inflight
+        if stuck is not None:
+            self._abandon(stuck, replica, reason)
+
+    def _redistribute(self, flushes: List, replica: Replica, why: str) -> None:
+        """Re-dispatch flushes stranded on a healed/quarantined/raced
+        slot onto the survivors.  A copy that is no longer QUEUED is
+        skipped entirely — its claimed winner (a hedge twin, the old
+        worker itself) owns delivery, and failing its riders here would
+        503 requests another replica is about to answer.  Window limits
+        are ignored: extra queueing on a living survivor beats failing
+        admitted work.  Only when NO routable replica exists do the
+        riders fail typed."""
+        svc = self.service
+        for flush in flushes:
+            unflushed = getattr(flush, "unflushed", None)
+            if unflushed is not None and not unflushed():
+                continue  # claimed/done/aborted elsewhere: not ours
+            target = svc._pool.hedge_dispatch(
+                flush, exclude_index=None, respect_window=False
+            )
+            if target is None:
+                # abort BEFORE failing: left QUEUED, a still-pending
+                # hedge timer could resurrect the flush onto a later-
+                # healed replica and spend device time on riders
+                # already answered 503
+                getattr(flush, "abort", lambda: False)()
+                svc.fail_flush(
+                    flush,
+                    FleetUnavailable(
+                        f"replica {replica.index} {why} and no routable "
+                        "survivor could absorb its queue"
+                    ),
+                )
+
+    def _abandon(self, flush, replica: Replica, reason: str) -> None:
+        """Fail a wedged worker's in-hand flush so its callers unblock.
+        ``abort()`` stops an unclaimed flush from ever running; a
+        CLAIMED one may still finish inside the wedged thread — late
+        delivery into already-failed futures is tolerated/discarded."""
+        aborted = getattr(flush, "abort", lambda: False)()
+        self.service.fail_flush(
+            flush,
+            FleetUnavailable(
+                f"replica {replica.index} {reason}; flush abandoned "
+                f"({'never ran' if aborted else 'outcome unknown'})"
+            ),
+        )

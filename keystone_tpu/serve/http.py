@@ -9,12 +9,20 @@ Endpoints (JSON unless noted):
   with a ``Retry-After`` hint), **504** when the request was shed past
   its deadline (``DeadlineExceeded``; a request that COMPLETES late
   still answers 200 — the ``serve.deadline_miss`` counter records it),
-  **400** on malformed bodies, **503** on service shutdown.
+  **400** on malformed bodies, **422** when the request's CONTENT
+  breaks the model (``PoisonRequest`` — bisection-isolated or
+  quarantine-cache matched; retrying it unchanged will fail again),
+  **503** on service shutdown AND on a fleet with no serving replica
+  (``FleetUnavailable`` — every replica quarantined/dead/breaker-open;
+  carries a ``Retry-After`` derived from the soonest breaker probe).
 - ``GET /healthz`` — liveness + queue depth + the live model version +
-  per-replica status (version, breaker state, outstanding flushes), so
-  a load balancer can see a HALF-sick fleet — one replica's breaker
-  open, a replica still serving the old version mid-swap — not just
-  process liveness.
+  per-replica status (version, breaker state, outstanding flushes,
+  dead/quarantined/restart counts), so a load balancer can see a
+  HALF-sick fleet — one replica's breaker open, a replica still
+  serving the old version mid-swap — not just process liveness.
+  Answers **503** (with ``Retry-After``) while the fleet is
+  unavailable, so the process leaves rotation until the supervisor's
+  first successful restart re-admits traffic.
 - ``GET /replicas`` — the per-replica status list alone.
 - ``POST /swap`` — admin: blue/green hot-swap the serving model from
   the attached :class:`~keystone_tpu.serve.registry.ModelRegistry`
@@ -88,7 +96,13 @@ import numpy as np
 
 from keystone_tpu.obs import metrics
 from keystone_tpu.obs.recorder import new_request_id
-from keystone_tpu.serve.service import Overloaded, PipelineService, ServiceClosed
+from keystone_tpu.serve.fleet import FleetUnavailable
+from keystone_tpu.serve.service import (
+    Overloaded,
+    PipelineService,
+    PoisonRequest,
+    ServiceClosed,
+)
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
@@ -127,10 +141,20 @@ class _Handler(BaseHTTPRequestHandler):
         path, query = parts.path, parse_qs(parts.query)
         if path == "/healthz":
             svc = self.service
+            # an unavailable fleet (every replica quarantined/dead/
+            # breaker-open) answers non-200 so a load balancer takes the
+            # process out of rotation; the supervisor's first successful
+            # restart flips it back
+            available = svc.available
+            code = 200 if available or svc.closed else 503
             self._send(
-                200,
+                code,
                 {
-                    "status": "closed" if svc.closed else "ok",
+                    "status": (
+                        "closed"
+                        if svc.closed
+                        else ("ok" if available else "unavailable")
+                    ),
                     "queue_depth": svc.queue_depth,
                     "queue_bound": svc.queue_bound,
                     "max_batch": svc.max_batch,
@@ -138,6 +162,21 @@ class _Handler(BaseHTTPRequestHandler):
                     "version": svc.version,
                     "replicas": svc.replica_statuses(),
                 },
+                headers=(
+                    ()
+                    if code == 200
+                    else (
+                        (
+                            "Retry-After",
+                            str(
+                                max(
+                                    1,
+                                    math.ceil(svc.unavailable_retry_after()),
+                                )
+                            ),
+                        ),
+                    )
+                ),
             )
         elif path == "/replicas":
             self._send(200, {"replicas": self.service.replica_statuses()})
@@ -271,6 +310,17 @@ class _Handler(BaseHTTPRequestHandler):
                 headers=hdrs + (("Retry-After", str(max(1, math.ceil(hint)))),),
             )
             return
+        except PoisonRequest as e:
+            # the request's CONTENT breaks the model (bisection-isolated
+            # or quarantine-cache matched): the client's fault — 422,
+            # not 500, and retrying it unchanged will fail again
+            self._send_poison(e, id_body, hdrs)
+            return
+        except FleetUnavailable as e:
+            # no replica can serve: fail fast with the derived retry
+            # hint (breaker probe ETA / supervisor restart)
+            self._send_unavailable(e, id_body, hdrs)
+            return
         except ServiceClosed as e:
             self._send(503, {"error": str(e), **id_body}, headers=hdrs)
             return
@@ -294,6 +344,12 @@ class _Handler(BaseHTTPRequestHandler):
         except guard.DeadlineExceeded as e:
             self._send(504, {"error": str(e), **id_body}, headers=hdrs)
             return
+        except PoisonRequest as e:  # isolated mid-flight by bisection
+            self._send_poison(e, id_body, hdrs)
+            return
+        except FleetUnavailable as e:  # batch failed fast after admission
+            self._send_unavailable(e, id_body, hdrs)
+            return
         except Exception as e:
             self._send(
                 500,
@@ -302,6 +358,31 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         self._send(200, {"predictions": preds, **id_body}, headers=hdrs)
+
+    def _send_poison(self, e, id_body, hdrs):
+        """422: the request's content breaks the model (PoisonRequest,
+        at admission via the quarantine cache or mid-flight via
+        bisection) — one response shape for both paths."""
+        self._send(422, {"error": str(e), **id_body}, headers=hdrs)
+
+    def _send_unavailable(self, e, id_body, hdrs):
+        """503 + derived Retry-After for FleetUnavailable, whether it
+        was raised at admission or delivered through the future."""
+        self._send(
+            503,
+            {
+                "error": str(e),
+                "retry_after_seconds": e.retry_after_seconds,
+                **id_body,
+            },
+            headers=hdrs
+            + (
+                (
+                    "Retry-After",
+                    str(max(1, math.ceil(e.retry_after_seconds))),
+                ),
+            ),
+        )
 
     def _do_swap(self):
         """Admin blue/green swap from the attached registry.  Codes:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import random
 import re
 import threading
 from typing import List, Optional, Tuple
@@ -83,10 +84,12 @@ class ModelRegistry:
                 out.append((int(m.group(1)), name))
         return [name for _, name in sorted(out)]
 
-    def current(self) -> Optional[str]:
+    def current(self, strict: bool = False) -> Optional[str]:
         """The version id ``CURRENT`` points at (None: nothing
-        published, or an unreadable/corrupt pointer — the watcher treats
-        both as "no news")."""
+        published).  An unreadable/corrupt pointer is "no news" by
+        default; ``strict=True`` re-raises it instead — the watcher
+        uses strict mode so a corrupt registry counts as a poll ERROR
+        (and backs off) rather than being silently polled forever."""
         path = self._current_path()
         if not os.path.exists(path):
             return None
@@ -95,6 +98,8 @@ class ModelRegistry:
             with open(path) as f:
                 v = f.read().strip()
         except (OSError, durable.CorruptStateError) as e:
+            if strict:
+                raise
             logger.warning("unreadable CURRENT pointer in %s: %s", self.root, e)
             return None
         return v or None
@@ -217,7 +222,12 @@ class RegistryWatcher:
     ``cli.py serve --watch N`` runs one of these; tests drive it with a
     sub-second interval.  One failed poll/load/swap is logged and
     counted (``serve.watch_errors``) — the fleet keeps serving the
-    version it has."""
+    version it has.  CONSECUTIVE failures back off exponentially
+    (jittered ±50%, capped at ``max_backoff_seconds``) instead of
+    hammering a corrupt registry at the fixed interval — and so a
+    thundering herd of watchers over shared storage decorrelates; the
+    live wait is exported as the ``serve.watch_backoff_seconds`` gauge
+    (0 while healthy).  The first successful poll resets the cadence."""
 
     def __init__(
         self,
@@ -225,11 +235,17 @@ class RegistryWatcher:
         registry: ModelRegistry,
         poll_seconds: float = 5.0,
         on_swap=None,
+        max_backoff_seconds: float = 300.0,
     ):
         self.service = service
         self.registry = registry
         self.poll_seconds = max(0.05, float(poll_seconds))
+        self.max_backoff_seconds = max(
+            self.poll_seconds, float(max_backoff_seconds)
+        )
         self.on_swap = on_swap
+        self._consecutive_errors = 0
+        self._rng = random.Random()  # jitter only; no determinism contract
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve-registry-watch"
@@ -239,41 +255,74 @@ class RegistryWatcher:
         self._thread.start()
         return self
 
+    def next_wait(self) -> float:
+        """The wait before the next poll: the configured interval while
+        healthy, ``min(cap, interval·2^errors)`` jittered to 50–150%
+        after consecutive failures (never below the base interval)."""
+        if self._consecutive_errors <= 0:
+            metrics.set_gauge("serve.watch_backoff_seconds", 0.0)
+            return self.poll_seconds
+        # exponent clamped: 2.0**1024 raises OverflowError, and a
+        # registry broken for days would otherwise kill the watcher
+        # thread from next_wait (outside the loop's try)
+        backoff = min(
+            self.max_backoff_seconds,
+            self.poll_seconds * (2.0 ** min(self._consecutive_errors, 32)),
+        )
+        wait = min(
+            self.max_backoff_seconds,
+            max(self.poll_seconds, backoff * (0.5 + self._rng.random())),
+        )
+        metrics.set_gauge("serve.watch_backoff_seconds", wait)
+        return wait
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_seconds):
+        while not self._stop.wait(self.next_wait()):
             try:
-                cur = self.registry.current()
-                if not cur or cur == self.service.version:
-                    continue
-                fitted, ver = self.registry.load(cur)
-                info = self.service.swap(fitted, version=ver)
-                metrics.inc("serve.watch_swaps")
-                logger.info(
-                    "watcher swapped in %s (pause %.1f ms)",
-                    ver,
-                    1000.0 * info["pause_seconds"],
-                )
-                rec = getattr(self.service, "recorder", None)
-                if rec is not None:
-                    # control-plane moment in the flight recorder: a
-                    # watcher-driven rollout shows up in /tracez between
-                    # the request traces it interleaved with
-                    rec.ops(
-                        "serve.watch_swap",
-                        version=ver,
-                        pause_seconds=info["pause_seconds"],
-                    )
-                if self.on_swap is not None:
-                    self.on_swap(info)
+                self._poll_once()
+                self._consecutive_errors = 0
             except Exception as e:
+                self._consecutive_errors += 1
                 metrics.inc("serve.watch_errors")
-                logger.warning("registry watch iteration failed: %s", e)
+                logger.warning(
+                    "registry watch iteration failed (%d consecutive): %s",
+                    self._consecutive_errors,
+                    e,
+                )
                 rec = getattr(self.service, "recorder", None)
                 if rec is not None:
                     rec.ops(
                         "serve.watch_error",
                         error=f"{type(e).__name__}: {e}",
+                        n=self._consecutive_errors,
                     )
+
+    def _poll_once(self) -> None:
+        # strict: a corrupt CURRENT pointer is a poll error (backoff),
+        # not silent "no news" forever
+        cur = self.registry.current(strict=True)
+        if not cur or cur == self.service.version:
+            return
+        fitted, ver = self.registry.load(cur)
+        info = self.service.swap(fitted, version=ver)
+        metrics.inc("serve.watch_swaps")
+        logger.info(
+            "watcher swapped in %s (pause %.1f ms)",
+            ver,
+            1000.0 * info["pause_seconds"],
+        )
+        rec = getattr(self.service, "recorder", None)
+        if rec is not None:
+            # control-plane moment in the flight recorder: a
+            # watcher-driven rollout shows up in /tracez between
+            # the request traces it interleaved with
+            rec.ops(
+                "serve.watch_swap",
+                version=ver,
+                pause_seconds=info["pause_seconds"],
+            )
+        if self.on_swap is not None:
+            self.on_swap(info)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()

@@ -87,6 +87,27 @@ also feed the cumulative ``/metrics`` series, plus an SLO error-budget
 burn rate against a configurable latency objective (``slo_ms``,
 defaulting to the service deadline).
 
+**Self-healing (ISSUE 10)** — three mechanisms close the loop between
+detection and recovery without an operator: (1) the
+:class:`~keystone_tpu.serve.fleet.ReplicaSupervisor` restarts dead or
+wedged replica workers in place (re-clone from the pool's source,
+re-prime, rejoin the router) and quarantines a slot that keeps dying;
+(2) a flush failing with a request-attributable error is **bisected**
+— recursively halved over the same padding buckets — until the poison
+request is isolated: it alone fails (typed :class:`PoisonRequest`,
+HTTP 422, recorder-pinned trace), innocent riders complete, and a
+content-keyed quarantine cache refuses the same payload at admission
+thereafter; (3) **hedged dispatch** (opt-in ``hedge_ms``) re-enqueues
+a batch still stuck in a straggling replica's queue onto a second
+replica — first claim wins, the loser is cancelled without device work
+and charged breaker-neutral.  When the WHOLE fleet is down (every
+replica quarantined/dead/breaker-open) the service fails fast instead
+of force-routing: submits raise
+:class:`~keystone_tpu.serve.fleet.FleetUnavailable` (503 + derived
+``Retry-After`` at HTTP, non-200 ``/healthz``) until the supervisor's
+first successful restart — or a breaker's half-open probe — re-admits
+traffic.
+
 The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 ``python -m keystone_tpu.cli serve``; the load generator is
 ``tools/serve_bench.py``.
@@ -94,11 +115,13 @@ The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 
 from __future__ import annotations
 
+import hashlib
+import heapq
 import itertools
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError
 from typing import List, Optional, Sequence, Tuple
 
@@ -107,7 +130,11 @@ import numpy as np
 from keystone_tpu.faults import fault_point
 from keystone_tpu.obs import ledger, metrics
 from keystone_tpu.obs.recorder import FlightRecorder, new_request_id
-from keystone_tpu.serve.fleet import ReplicaPool
+from keystone_tpu.serve.fleet import (
+    FleetUnavailable,
+    ReplicaPool,
+    ReplicaSupervisor,
+)
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
@@ -138,6 +165,58 @@ class Overloaded(RuntimeError):
 class ServiceClosed(RuntimeError):
     """The service is shut down (or shutting down) and accepts no new
     requests."""
+
+
+class PoisonRequest(ValueError):
+    """THIS request's content makes the model fail — isolated by batch
+    bisection (the request alone reproduces the error), or matched
+    against the quarantine cache of previously-isolated content.  A
+    ``ValueError`` on purpose: it is the CLIENT's fault (the HTTP layer
+    answers 422, and it does not burn the server's SLO error budget),
+    and retrying it unchanged will fail again."""
+
+
+#: bound on the content-keyed poison quarantine cache (LRU eviction)
+_POISON_CACHE_CAP = 512
+
+#: quarantine entries expire after this long: _poison_suspect is a
+#: type-level heuristic, and a transient third-party RuntimeError
+#: (e.g. an XLA RESOURCE_EXHAUSTED during the singleton re-run) could
+#: misclassify an innocent payload — a TTL bounds that blast radius to
+#: minutes (a real poison resubmitted later just re-bisects, one extra
+#: isolation per TTL window)
+_POISON_TTL_S = 600.0
+
+#: hedge delay = max(configured floor, this multiple of the EWMA batch
+#: time) — the cheap stand-in for a tail quantile: for exponential-ish
+#: flush times 3× the mean sits near p95, so hedges fire on genuine
+#: stragglers, not on every flush
+_HEDGE_EWMA_MULT = 3.0
+
+
+def _content_key(arr: np.ndarray) -> bytes:
+    """The quarantine-cache key: a BLAKE2b digest of the request's
+    dtype + shape + bytes.  Content-keyed, not id-keyed: the same bad
+    payload resubmitted (or replayed by a retrying client) short-
+    circuits at admission without touching a device."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.digest()
+
+
+def _poison_suspect(exc: BaseException) -> bool:
+    """Is this apply failure plausibly caused by a request's CONTENT
+    (worth bisecting), as opposed to infrastructure?  The repo-wide
+    convention makes this a type test: every infrastructure failure
+    rides ``OSError`` (``FaultInjected``, ``DeadlineExceeded``, real
+    I/O), breaker refusals are ``CircuitOpenError``, and resource
+    exhaustion is ``MemoryError`` — everything else (the ``ValueError``
+    /``FloatingPointError``/XLA-check family) is content-shaped."""
+    return not isinstance(
+        exc, (OSError, MemoryError, guard.CircuitOpenError)
+    )
 
 
 def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
@@ -172,6 +251,115 @@ class _Request:
         self.request_id = request_id
 
 
+class _Flush:
+    """One formed micro-batch in flight through the router.
+
+    The claim state machine is what makes hedging and worker-crash
+    requeues safe: a flush may sit in TWO replica queues (hedged) or be
+    re-run after a crash requeue, but ``claim()`` admits exactly ONE
+    runner — every other popper sees the claim spent and skips without
+    device work (the hedge loser's "cancellation").  ``abort()`` stops a
+    never-claimed flush from running at all (a wedged worker's in-hand
+    batch whose riders the supervisor already failed)."""
+
+    QUEUED, RUNNING, DONE, ABORTED = "queued", "running", "done", "aborted"
+
+    __slots__ = ("riders", "bid", "primary", "hedged", "_state", "_lock")
+
+    def __init__(self, riders: list, bid: str):
+        self.riders = riders
+        self.bid = bid
+        #: index of the replica the router first dispatched to (set by
+        #: ReplicaPool.dispatch under the router lock)
+        self.primary: Optional[int] = None
+        self.hedged = False
+        self._state = _Flush.QUEUED
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def unflushed(self) -> bool:
+        """Still waiting in a queue — the hedge monitor's fire test."""
+        return self._state == _Flush.QUEUED
+
+    def claim(self) -> bool:
+        """First caller wins the right to run this flush."""
+        with self._lock:
+            if self._state != _Flush.QUEUED:
+                return False
+            self._state = _Flush.RUNNING
+            return True
+
+    def done(self) -> None:
+        with self._lock:
+            if self._state == _Flush.RUNNING:
+                self._state = _Flush.DONE
+
+    def abort(self) -> bool:
+        """Spend the claim without running (supervisor abandonment).
+        True when the flush had never been claimed — its riders can be
+        failed knowing no result will ever race the failure."""
+        with self._lock:
+            if self._state == _Flush.QUEUED:
+                self._state = _Flush.ABORTED
+                return True
+            return False
+
+
+class _HedgeMonitor:
+    """A single timer thread watching dispatched-but-unflushed flushes:
+    when one is still queued after its hedge delay, re-enqueue it on a
+    second replica (``ReplicaPool.hedge_dispatch``).  First popper wins
+    the claim; the loser skips without device work and is charged
+    breaker-NEUTRAL.  One heap, one thread, regardless of QPS."""
+
+    def __init__(self, service: "PipelineService"):
+        self._svc = service
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"{service.name}-hedge"
+        )
+        self._thread.start()
+
+    def schedule(self, flush: _Flush, delay_s: float) -> None:
+        with self._cond:
+            heapq.heappush(
+                self._heap,
+                (time.monotonic() + max(0.0, delay_s), next(self._seq), flush),
+            )
+            self._cond.notify()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify()
+        self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopping:
+                    if not self._heap:
+                        self._cond.wait()
+                    else:
+                        wait = self._heap[0][0] - time.monotonic()
+                        if wait <= 0.0:
+                            break
+                        self._cond.wait(wait)
+                if self._stopping:
+                    return
+                _, _, flush = heapq.heappop(self._heap)
+            try:
+                self._svc._hedge_fire(flush)
+            except Exception:  # a failed hedge must never kill the timer
+                logger.exception("hedge dispatch failed")
+
+
 class PipelineService:
     """A frozen fitted pipeline behind a micro-batching request queue.
 
@@ -198,6 +386,13 @@ class PipelineService:
         recorder=True,
         slo_ms: Optional[float] = None,
         slo_target: float = 0.99,
+        supervise: bool = True,
+        heartbeat_s: float = 30.0,
+        supervise_interval_s: float = 0.5,
+        restart_limit: int = 3,
+        restart_window_s: float = 60.0,
+        hedge_ms: Optional[float] = None,
+        bisect: bool = True,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -209,6 +404,7 @@ class PipelineService:
             devices=devices,
             version=version,
             name=name,
+            heartbeat_s=heartbeat_s,
         )
         #: the flight recorder: True (default) = a fresh bounded
         #: recorder, False/None = tracing fully off (request ids stay
@@ -274,6 +470,12 @@ class PipelineService:
         #: never the whole batch it would have ridden in
         self._item_shape: Optional[tuple] = None
         self._dtype = None
+        #: batch-failure bisection (poison-request isolation) on the
+        #: flush error path; the quarantine cache short-circuits repeat
+        #: offenders at admission (content-keyed, LRU-bounded)
+        self._bisect = bool(bisect)
+        self._poison_cache: "OrderedDict[bytes, float]" = OrderedDict()
+        self._poison_lock = threading.Lock()
         if example is not None:
             ex = np.asarray(example)
             self._item_shape = tuple(ex.shape)
@@ -284,6 +486,32 @@ class PipelineService:
             target=self._loop, daemon=True, name=f"{name}-batcher"
         )
         self._worker.start()
+        #: hedged dispatch: re-enqueue a still-unflushed batch on a
+        #: second replica after max(hedge_ms, 3×EWMA).  None (default)
+        #: = off — no monitor thread, the PR-9 dispatch path unchanged.
+        #: Needs a second replica to hedge onto.
+        #: hedge_ms=0 is a MEANINGFUL floor (delay = pure 3×EWMA);
+        #: only None disables hedging
+        self._hedge_floor_s = (
+            None if hedge_ms is None else max(0.0, float(hedge_ms)) / 1000.0
+        )
+        self._hedge = (
+            _HedgeMonitor(self)
+            if self._hedge_floor_s is not None and self._pool.size > 1
+            else None
+        )
+        #: the self-healing supervisor: detects dead/wedged replica
+        #: workers, restarts them in place, quarantines repeat offenders
+        self.supervisor = (
+            ReplicaSupervisor(
+                self,
+                interval=supervise_interval_s,
+                restart_limit=restart_limit,
+                restart_window=restart_window_s,
+            ).start()
+            if supervise
+            else None
+        )
 
     # ------------------------------------------------------------ priming
     def prime(self, replicas=None) -> None:
@@ -302,6 +530,46 @@ class PipelineService:
             for bucket in self.buckets:
                 zeros = np.zeros((bucket,) + self._item_shape, self._dtype)
                 self._apply_rows(zeros, deadline=None, replica=replica, prime=True)
+
+    def prime_replacement(self, replica) -> None:
+        """Prime one not-yet-routed replica's bucket programs — the
+        supervisor's restart path (``prime()`` for a single replica,
+        tolerating a service that has not yet learned its item shape)."""
+        if self._item_shape is not None:
+            self.prime(replicas=[replica])
+
+    def fail_flush(self, flush, exc: BaseException) -> None:
+        """Fail every still-unresolved rider of a flush (the supervisor's
+        abandonment path, and the batcher's fleet-unavailable path)."""
+        for req in flush.riders:
+            self._fail(req, exc, batch=flush.bid)
+
+    # ------------------------------------------------------------ hedging
+    def _hedge_delay_s(self) -> float:
+        """The re-dispatch delay: the configured floor, lifted to a
+        ~p95-ish EWMA multiple once real batch samples exist."""
+        return max(self._hedge_floor_s or 0.0, _HEDGE_EWMA_MULT * self._ewma_batch_s)
+
+    def _hedge_fire(self, flush: _Flush) -> None:
+        """Timer callback: the flush is still sitting in its primary
+        replica's queue past the hedge delay — enqueue it on a second
+        replica.  Whichever replica pops it first claims it; the other
+        skips without device work."""
+        if not flush.unflushed() or flush.hedged:
+            return
+        flush.hedged = True  # at most one hedge per flush
+        rep = self._pool.hedge_dispatch(flush, exclude_index=flush.primary)
+        if rep is None:
+            return  # no second replica free: the hedge is skipped
+        metrics.inc("serve.hedges")
+        rec = self.recorder
+        if rec is not None:
+            rec.ops(
+                "serve.hedge",
+                batch=flush.bid,
+                from_replica=flush.primary,
+                to_replica=rep.index,
+            )
 
     # ------------------------------------------------------------- submit
     def submit(self, x, deadline=None, request_id: Optional[str] = None) -> Future:
@@ -352,6 +620,43 @@ class PipelineService:
             for _ in xs:
                 fault_point("serve.enqueue")
             arrs = [np.asarray(x) for x in xs]
+            # the poison quarantine cache: content previously isolated
+            # by bisection is refused BEFORE it reaches a device (and
+            # before it can fail a co-batched flush again).  Zero cost
+            # until something has actually been quarantined.
+            if self._poison_cache:
+                # digests computed OUTSIDE the lock: hashing payloads is
+                # the expensive part, and serializing every submitter
+                # thread on it would tax exactly the high-QPS path
+                keys = [_content_key(a) for a in arrs]
+                now = time.monotonic()
+                with self._poison_lock:
+                    hit = False
+                    for k in keys:
+                        t = self._poison_cache.get(k)
+                        if t is None:
+                            continue
+                        if now - t > _POISON_TTL_S:
+                            del self._poison_cache[k]  # expired: amnesty
+                        else:
+                            hit = True
+                            break
+                if hit:
+                    metrics.inc("serve.poison_blocked", len(arrs))
+                    raise PoisonRequest(
+                        "request content matches a previously-isolated "
+                        "poison payload; refused at admission"
+                    )
+            # fleet-unavailable fail-fast: every replica quarantined/
+            # dead/breaker-open answers 503 at once instead of queueing
+            # work the router will refuse.  One attribute read while the
+            # fleet is healthy.
+            if not self._pool.available():
+                metrics.inc("serve.unavailable", len(arrs))
+                raise FleetUnavailable(
+                    f"service {self.name!r}: no replica can serve",
+                    retry_after_seconds=self._pool.retry_after_unavailable(),
+                )
             with self._cond:
                 if self._closing:
                     raise ServiceClosed(f"service {self.name!r} is closed")
@@ -404,11 +709,12 @@ class PipelineService:
             # terminal outcome at admission: the trace (if any) must not
             # dangle open — a rejected request is as explainable as a
             # shed one.  Finished OUTSIDE the queue lock.
-            outcome = (
-                "rejected"
-                if isinstance(e, (Overloaded, ServiceClosed))
-                else "error"
-            )
+            if isinstance(e, PoisonRequest):
+                outcome = "poison"
+            elif isinstance(e, (Overloaded, ServiceClosed, FleetUnavailable)):
+                outcome = "rejected"
+            else:
+                outcome = "error"
             # rejected/errored admissions burn the SLO error budget too
             # (waited ~0: admission answers immediately) — EXCEPT client
             # faults (shape mismatch, malformed payloads: the 400
@@ -451,10 +757,26 @@ class PipelineService:
 
     def replica_statuses(self) -> list:
         """Per-replica status dicts (index, device, model version,
-        breaker state, outstanding flushes) — the fleet view ``/healthz``
-        and ``/replicas`` expose so a load balancer can see a half-sick
+        breaker state, outstanding flushes, dead/quarantined/restart
+        supervision state) — the fleet view ``/healthz`` and
+        ``/replicas`` expose so a load balancer can see a half-sick
         fleet, not just process liveness."""
         return self._pool.statuses()
+
+    @property
+    def available(self) -> bool:
+        """False when NO replica can serve (all quarantined, dead, or
+        breaker-open): submits raise :class:`FleetUnavailable`,
+        ``/predict`` answers 503, and ``/healthz`` turns non-200 until
+        a supervisor restart or half-open probe re-admits traffic.
+        Runs the FULL scan (this backs low-rate health surfaces);
+        the per-submit admission check stays one attribute read."""
+        return not self._closed and self._pool.available_now()
+
+    def unavailable_retry_after(self) -> float:
+        """The ``Retry-After`` an unavailable 503 should carry: the
+        soonest breaker half-open probe among routable replicas."""
+        return self._pool.retry_after_unavailable()
 
     def retry_after_hint(self) -> float:
         """Estimated seconds until the queue drains — what a 429 should
@@ -504,6 +826,7 @@ class PipelineService:
             "window_seconds": self._lat_win.window_seconds,
             "latency_ms": self._ms(lat),
             "batch_ms": self._ms(bat),
+            "available": self.available,
             "counters": {
                 name.split(".", 1)[1]: reg.counter_total(name)
                 for name in (
@@ -513,9 +836,19 @@ class PipelineService:
                     "serve.rejected",
                     "serve.deadline_miss",
                     "serve.batch_errors",
+                    "serve.replica_restarts",
+                    "serve.bisections",
+                    "serve.poison",
+                    "serve.poison_blocked",
+                    "serve.hedges",
+                    "serve.hedge_wins",
+                    "serve.unavailable",
                 )
             },
             "replicas": self.replica_statuses(),
+            "supervisor": (
+                None if self.supervisor is None else self.supervisor.status()
+            ),
             "recorder": None if rec is None else rec.stats(),
         }
         if self._slo_s is not None:
@@ -637,6 +970,13 @@ class PipelineService:
                     )
                 metrics.set_gauge("serve.queue_depth", 0)
             self._cond.notify_all()
+        # stop the healers first: a supervisor restarting (or a hedge
+        # monitor re-enqueueing into) a pool that close() is tearing
+        # down would race the retirement below
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self._hedge is not None:
+            self._hedge.stop()
         # wait out an in-flight swap: with _closing set no NEW swap can
         # start, and an in-flight one either commits into the still-live
         # pool (its generation is then retired below) or fails on its
@@ -682,9 +1022,12 @@ class PipelineService:
                 metrics.set_gauge("serve.queue_depth", 0)
         # retire the replica workers: each drains its already-routed
         # flushes first, so drained == every admitted future resolved.
-        # A wedged replica worker hands back its abandoned batches.
-        for abandoned in self._pool.close(timeout=timeout):
-            for req in abandoned:
+        # A wedged replica worker hands back its abandoned flushes
+        # (already-delivered hedge-loser copies fail no one: _fail
+        # skips resolved futures).
+        for flush in self._pool.close(timeout=timeout):
+            flush.abort()
+            for req in flush.riders:
                 self._fail(
                     req,
                     ServiceClosed(
@@ -708,10 +1051,21 @@ class PipelineService:
         what lets N replicas serve N flushes concurrently."""
         ledger.restore_context(self._obs_ctx)
         while True:
-            batch = self._next_batch()
-            if batch is None:
+            flush = self._next_batch()
+            if flush is None:
                 return
-            self._pool.dispatch(batch)
+            try:
+                self._pool.dispatch(flush)
+            except FleetUnavailable as e:
+                # fail fast: no replica can take this flush — resolve
+                # its riders NOW (503 at HTTP) instead of parking them
+                # behind a pool the router refuses
+                flush.abort()
+                self.fail_flush(flush, e)
+                continue
+            hedge = self._hedge
+            if hedge is not None:
+                hedge.schedule(flush, self._hedge_delay_s())
 
     def _next_batch(self):
         """Block until a flush is due; pop and return it (None = shut
@@ -735,24 +1089,36 @@ class PipelineService:
             k = min(len(self._q), self.max_batch)
             batch = [self._q.popleft() for _ in range(k)]
             metrics.set_gauge("serve.queue_depth", len(self._q))
-            return batch
+            return _Flush(batch, f"b{next(self._batch_seq)}")
 
     def _fail(self, req, exc, **attrs) -> None:
         """Deliver an exception to a request, tolerating a caller that
         already cancelled its future — an InvalidStateError here would
         kill the batcher thread and brick the whole service.  Also the
         trace terminal for failure paths: the outcome is ``shed`` for a
-        deadline shed, ``error`` otherwise, finished only if the trace
-        is still live (an already-finalized id is left alone).  The
-        trace is finalized BEFORE the future is delivered, so a caller
-        woken by ``.result()`` can immediately resolve its id via
-        ``/requestz`` without racing the finalization."""
-        self._fail_win.observe(time.monotonic() - req.t_submit)
+        deadline shed, ``poison`` for an isolated poison request,
+        ``error`` otherwise, finished only if the trace is still live
+        (an already-finalized id is left alone).  The trace is finalized
+        BEFORE the future is delivered, so a caller woken by
+        ``.result()`` can immediately resolve its id via ``/requestz``
+        without racing the finalization.  An already-resolved future
+        (a hedge loser's copy, a supervisor-abandoned flush whose hung
+        runner delivered after all) is skipped entirely — no double
+        terminal, no phantom SLO burn."""
+        if req.future.done():
+            return
+        # client faults (shape mismatch, poison content — the 4xx
+        # family) do not burn the server's SLO error budget
+        if not isinstance(exc, (TypeError, ValueError)):
+            self._fail_win.observe(time.monotonic() - req.t_submit)
         rid = req.request_id
         if rid is not None:
-            outcome = (
-                "shed" if isinstance(exc, guard.DeadlineExceeded) else "error"
-            )
+            if isinstance(exc, guard.DeadlineExceeded):
+                outcome = "shed"
+            elif isinstance(exc, PoisonRequest):
+                outcome = "poison"
+            else:
+                outcome = "error"
             rec = self.recorder
             if rec is not None:
                 rec.finish(
@@ -771,17 +1137,51 @@ class PipelineService:
         except InvalidStateError:
             pass
 
-    def _run_flush(self, replica, batch) -> None:
-        """One routed flush, on ``replica``'s worker thread: shed, pad,
-        apply, resolve futures, account the outcome to the router and
-        the replica's breaker."""
+    def _run_flush(self, replica, flush) -> None:
+        """One routed flush, on ``replica``'s worker thread: claim it
+        (exactly one runner per flush — the hedging/crash-requeue
+        guarantee), then shed, pad, apply, resolve futures, account the
+        outcome to the router and the replica's breaker.  An unclaimed
+        pop is a hedge loser (or a supervisor-aborted flush): cancelled
+        without device work, charged breaker-NEUTRAL."""
+        if not flush.claim():
+            if flush.state != _Flush.ABORTED:
+                # the other replica won the hedge race — this copy is
+                # the cancelled loser (no device work was wasted)
+                metrics.inc("serve.hedge_cancelled")
+                rec = self.recorder
+                if rec is not None:
+                    rec.ops(
+                        "serve.hedge",
+                        batch=flush.bid,
+                        replica=replica.index,
+                        outcome="cancelled",
+                    )
+            self._pool.complete(replica, ok=None)
+            return
+        if flush.hedged and replica.index != flush.primary:
+            metrics.inc("serve.hedge_wins")
         ok: Optional[bool] = False
         try:
-            ok = self._run_batch(batch, replica)
+            ok = self._run_batch(flush, replica)
+        except BaseException as e:
+            # an escape past _run_batch's own containment (a delivery-
+            # layer bug): the claim is SPENT, so a worker-crash requeue
+            # could never run this flush again — fail the unresolved
+            # riders here, while we still own them.  Escapes reaching
+            # the worker loop are therefore all PRE-claim, where the
+            # crash handler's front-requeue is always safe.
+            logger.exception(
+                "flush %s delivery escaped containment on replica %d",
+                flush.bid,
+                replica.index,
+            )
+            self.fail_flush(flush, e)
         finally:
+            flush.done()
             self._pool.complete(replica, ok=ok)
 
-    def _run_batch(self, batch, replica) -> Optional[bool]:
+    def _run_batch(self, flush, replica) -> Optional[bool]:
         """Returns False exactly when the replica's APPLY failed — the
         outcome that should charge its breaker toward open.  True means
         the apply succeeded (charges a success, closes a half-open
@@ -789,8 +1189,9 @@ class PipelineService:
         on the device, so the breaker is not charged either way: a sick
         replica whose inflated EWMA sheds every rider must not keep
         "passing" its half-open probes with zero device work."""
+        batch = flush.riders
+        bid = flush.bid
         rec = self.recorder
-        bid = f"b{next(self._batch_seq)}"
         now = time.monotonic()
         if rec is not None:
             riders = [r.request_id for r in batch if r.request_id is not None]
@@ -904,6 +1305,11 @@ class PipelineService:
             )
             if rec is not None:
                 rec.batch_update(bid, error=f"{type(e).__name__}: {e}")
+            if self._bisect and _poison_suspect(e):
+                # a request-attributable failure: bisect the batch to
+                # isolate the poison rider(s) — innocent co-batched
+                # riders complete, the poison fails typed + quarantined
+                return self._bisect_flush(live, replica, bid, batch_deadline, e)
             for req in live:
                 self._fail(req, e, batch=bid, replica=replica.index)
             return False
@@ -933,13 +1339,30 @@ class PipelineService:
                 seconds=round(dt, 6),
                 degraded=degraded,
             )
+        self._deliver_completed(
+            live, out, replica, bid, dt, t0, degraded=degraded
+        )
+        return True
+
+    def _deliver_completed(
+        self, reqs, out, replica, bid, dt, t0, degraded=False
+    ) -> None:
+        """Resolve completed riders: latency/outcome accounting, trace
+        terminals, then the result delivery — shared by the flush happy
+        path and bisection's innocent-rider completions.  A rider whose
+        future is already resolved (a supervisor-abandoned flush whose
+        hung runner finished after all) is skipped: no double terminal,
+        no double metrics, and the late ``set_result`` is swallowed."""
+        rec = self.recorder
         outcome = "degraded" if degraded else "completed"
         done_t = time.monotonic()
         # one ledger-activation check per FLUSH, not per rider: the
         # inert-path cost of N module-frontend calls is real at serving
         # rates (part of the recorder overhead budget)
         led_on = ledger.active() is not None
-        for i, req in enumerate(live):
+        for i, req in enumerate(reqs):
+            if req.future.done():
+                continue
             self._lat_win.observe(done_t - req.t_submit)
             late = req.deadline is not None and req.deadline.expired()
             if late:
@@ -968,8 +1391,123 @@ class PipelineService:
                         seconds=round(done_t - req.t_submit, 6),
                         queue_wait_seconds=round(t0 - req.t_submit, 6),
                     )
-            req.future.set_result(out[i])
-        return True
+            try:
+                req.future.set_result(out[i])
+            except InvalidStateError:
+                pass  # a racing cancel/abandonment got there first
+
+    # ---------------------------------------------------------- bisection
+    def _bisect_flush(
+        self, live, replica, bid, batch_deadline, first_error
+    ) -> Optional[bool]:
+        """Isolate poison rider(s) in a failed flush by recursive
+        halving, re-using the padding buckets: each failing group is
+        split and both halves re-applied; a failing SINGLETON is the
+        poison — it alone fails (typed :class:`PoisonRequest`, content
+        quarantined), every innocent rider completes.  Depth is
+        structurally bounded by ⌈log2(rows)⌉ halvings; at most two
+        applies run per level.  Returns the flush's breaker charge:
+        True when only poison failures occurred (the replica is
+        healthy), False when infrastructure failed a re-run too."""
+        metrics.inc("serve.bisections")
+        deepest = 0
+        applies = 0
+        poisons = 0
+        infra_failed = False
+        t_bisect0 = time.monotonic()
+
+        def fail_poison(req, cause):
+            nonlocal poisons
+            poisons += 1
+            metrics.inc("serve.poison")
+            key = _content_key(req.x)
+            with self._poison_lock:
+                self._poison_cache[key] = time.monotonic()
+                self._poison_cache.move_to_end(key)
+                while len(self._poison_cache) > _POISON_CACHE_CAP:
+                    self._poison_cache.popitem(last=False)
+            self._fail(
+                req,
+                PoisonRequest(
+                    "request content fails the model "
+                    f"({type(cause).__name__}: {cause}); isolated by "
+                    "batch bisection and quarantined"
+                ),
+                batch=bid,
+                replica=replica.index,
+            )
+
+        def run_group(reqs, depth):
+            nonlocal deepest, applies, infra_failed
+            deepest = max(deepest, depth)
+            try:
+                applies += 1
+                t0 = time.monotonic()
+                out = self._apply_rows(
+                    np.stack([req.x for req in reqs]),
+                    deadline=batch_deadline,
+                    replica=replica,
+                )
+            except BaseException as ge:
+                if not _poison_suspect(ge):
+                    # infrastructure failed the RE-RUN: this group's
+                    # riders get the real error, and the replica is
+                    # charged (it could not complete clean work)
+                    infra_failed = True
+                    for req in reqs:
+                        self._fail(req, ge, batch=bid, replica=replica.index)
+                    return
+                if len(reqs) == 1:
+                    fail_poison(reqs[0], ge)
+                    return
+                mid = (len(reqs) + 1) // 2
+                run_group(reqs[:mid], depth + 1)
+                run_group(reqs[mid:], depth + 1)
+                return
+            self._deliver_completed(
+                reqs, out, replica, bid, time.monotonic() - t0, t0
+            )
+
+        if len(live) == 1:
+            fail_poison(live[0], first_error)
+        else:
+            mid = (len(live) + 1) // 2
+            run_group(live[:mid], 1)
+            run_group(live[mid:], 1)
+        took = time.monotonic() - t_bisect0
+        if ledger.active() is not None:
+            ledger.event(
+                "serve.bisect",
+                batch=bid,
+                replica=replica.index,
+                rows=len(live),
+                depth=deepest,
+                n=applies,
+                seconds=round(took, 6),
+            )
+        rec = self.recorder
+        if rec is not None:
+            rec.batch_update(bid, depth=deepest, poisons=poisons)
+            rec.ops(
+                "serve.bisect",
+                batch=bid,
+                replica=replica.index,
+                rows=len(live),
+                depth=deepest,
+                poisons=poisons,
+                seconds=round(took, 6),
+            )
+        logger.warning(
+            "bisected a poisoned flush of %d on replica %d: %d poison "
+            "request(s) isolated in %d applies (depth %d, %.3fs)",
+            len(live),
+            replica.index,
+            poisons,
+            applies,
+            deepest,
+            took,
+        )
+        return False if infra_failed else True
 
     # -------------------------------------------------------------- apply
     def _bucket_for(self, k: int) -> int:
@@ -1022,6 +1560,13 @@ def serve(
     recorder=True,
     slo_ms: Optional[float] = None,
     slo_target: float = 0.99,
+    supervise: bool = True,
+    heartbeat_s: float = 30.0,
+    supervise_interval_s: float = 0.5,
+    restart_limit: int = 3,
+    restart_window_s: float = 60.0,
+    hedge_ms: Optional[float] = None,
+    bisect: bool = True,
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -1061,6 +1606,25 @@ def serve(
     - ``slo_ms`` / ``slo_target`` — the latency objective behind
       ``GET /statusz``'s error-budget burn rate (default objective:
       ``deadline_ms``; no deadline, no SLO section).
+    - ``supervise`` (default ON) — the self-healing
+      :class:`~keystone_tpu.serve.fleet.ReplicaSupervisor`: dead/wedged
+      replica workers are restarted in place (re-clone + re-place from
+      the pool's source, buckets re-primed, router rejoined);
+      ``restart_limit`` restarts within ``restart_window_s`` seconds
+      quarantine the slot.  ``heartbeat_s`` is the wedge budget — a
+      worker holding one flush longer than this is declared wedged, so
+      size it above the slowest honest apply.
+    - ``hedge_ms`` — hedged dispatch (default OFF): a batch still
+      unflushed after max(``hedge_ms``, 3× the EWMA batch time) is
+      re-enqueued on a second replica; whichever replica claims it
+      first runs it, the loser is cancelled without device work and
+      charged breaker-neutral.
+    - ``bisect`` (default ON) — batch-failure bisection: a flush that
+      fails with a request-attributable error is recursively halved to
+      isolate the poison request, which alone fails (typed
+      :class:`PoisonRequest`, HTTP 422) while innocent co-batched
+      riders complete; the content-keyed quarantine cache then refuses
+      repeat offenders at admission.
     """
     return PipelineService(
         pipeline,
@@ -1078,4 +1642,11 @@ def serve(
         recorder=recorder,
         slo_ms=slo_ms,
         slo_target=slo_target,
+        supervise=supervise,
+        heartbeat_s=heartbeat_s,
+        supervise_interval_s=supervise_interval_s,
+        restart_limit=restart_limit,
+        restart_window_s=restart_window_s,
+        hedge_ms=hedge_ms,
+        bisect=bisect,
     )

@@ -23,6 +23,7 @@ from keystone_tpu.utils.guard import (  # noqa: F401
     CircuitOpenError,
     Deadline,
     DeadlineExceeded,
+    Heartbeat,
     run_with_deadline,
 )
 

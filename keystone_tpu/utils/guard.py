@@ -142,6 +142,38 @@ class Deadline:
         return f"Deadline(remaining={self.remaining():.3f}s)"
 
 
+class Heartbeat:
+    """A renewable :class:`Deadline`: ``beat()`` pushes the expiry
+    ``timeout`` seconds into the future, ``expired()`` reports whether
+    the holder has gone silent past it.  The liveness half of the
+    supervision contract — a worker thread beats once per loop
+    iteration, and a supervisor that finds the heartbeat expired while
+    work is outstanding knows the worker is wedged (stuck inside one
+    operation), as opposed to dead (thread exited), which plain thread
+    liveness already shows.  Thread-safe: one writer (the worker), any
+    number of readers (the supervisor)."""
+
+    __slots__ = ("timeout", "_deadline")
+
+    def __init__(self, timeout: float):
+        self.timeout = float(timeout)
+        self._deadline = Deadline.after(self.timeout)
+
+    def beat(self) -> None:
+        # a fresh Deadline object per beat: assignment is atomic, so
+        # readers never observe a half-updated expiry (no lock needed)
+        self._deadline = Deadline.after(self.timeout)
+
+    def expired(self) -> bool:
+        return self._deadline.expired()
+
+    def remaining(self) -> float:
+        return self._deadline.remaining()
+
+    def __repr__(self):
+        return f"Heartbeat(timeout={self.timeout}, remaining={self.remaining():.3f}s)"
+
+
 def as_deadline(value) -> Optional[Deadline]:
     """Coerce a user-facing budget (None, seconds, or a Deadline) into
     an Optional[Deadline] — the one conversion every ``deadline=`` API
@@ -398,6 +430,19 @@ class CircuitBreaker:
             ):
                 tr = self._to(OPEN)
         self._report(tr)
+
+    def seconds_until_probe(self) -> float:
+        """Seconds until this breaker would admit traffic again: 0 for
+        closed/half-open, else the remaining open window before the
+        half-open probe.  Read-only — unlike :meth:`allow` it neither
+        consumes the probe slot nor transitions state, so availability
+        checks (a 503's derived ``Retry-After``) can poll it freely."""
+        with self._lock:
+            if self._state != OPEN or self._opened_at is None:
+                return 0.0
+            return max(
+                0.0, self.reset_timeout - (self._clock() - self._opened_at)
+            )
 
 
 # process-wide per-key registry (the executor's per-node breakers;

@@ -179,21 +179,48 @@ def test_router_failover_when_breaker_opens():
     assert sum(s["flushes"] for s in statuses[1:]) >= 6
 
 
-def test_all_breakers_open_degrades_to_least_loaded():
-    """Every breaker refusing must NOT refuse the fleet: the router
-    forces the least-loaded replica (counted) — degraded service beats
-    a total outage, and probes need traffic to ever close a breaker."""
+def test_all_breakers_open_fails_fast_then_probe_readmits():
+    """Every breaker refusing = the fleet FAILS FAST (ISSUE 10): the
+    batch's riders resolve with a typed ``FleetUnavailable`` (503 at
+    HTTP) instead of being force-routed into the dead pool — and once a
+    breaker's half-open window elapses, the probe re-admits traffic and
+    the fleet recovers without an operator."""
+    from keystone_tpu.serve import FleetUnavailable
+    from keystone_tpu.utils import guard as _guard
+
     x = _rows(4, seed=3)
     ref = np.asarray(_pipeline()(Dataset(x)).get().array)
-    before = metrics.REGISTRY.counter_value("serve.router_forced")
-    with _service(2, "fleet_forced", max_wait_ms=1.0) as svc:
+    with _service(2, "fleet_failfast", max_wait_ms=1.0) as svc:
+        # short reset so the half-open probe is test-speed
         for rep in svc._pool.replicas:
+            rep.breaker = _guard.CircuitBreaker(
+                f"fleet_failfast.replica.{rep.index}", reset_timeout=0.3
+            )
             while rep.breaker.state() != "open":
                 rep.breaker.record_failure()
         futs = svc.submit_many(x)
-        got = np.stack([f.result(timeout=30) for f in futs])
-    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
-    assert metrics.REGISTRY.counter_value("serve.router_forced") > before
+        errs = [f.exception(timeout=30) for f in futs]
+        assert all(isinstance(e, FleetUnavailable) for e in errs), errs
+        # the health surface agrees while the fleet is down
+        assert svc.available is False
+        assert svc.status()["available"] is False
+        # admission now refuses up front (the primed fast path)
+        with pytest.raises(FleetUnavailable):
+            svc.submit_many(x)
+        # ... until the half-open window elapses: the probe is admitted
+        # and a healthy apply closes the breaker — traffic flows again
+        time.sleep(0.4)
+        deadline = time.monotonic() + 30.0
+        got = None
+        while got is None and time.monotonic() < deadline:
+            try:
+                futs = svc.submit_many(x)
+                got = np.stack([f.result(timeout=30) for f in futs])
+            except FleetUnavailable:
+                time.sleep(0.1)
+        assert got is not None, "probe never re-admitted traffic"
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert svc.available is True
 
 
 def test_replica_chaos_one_flush_fails_service_survives():

@@ -1,0 +1,610 @@
+"""Self-healing serving fleet (ISSUE 10): replica supervision (dead/
+wedged worker restart + quarantine), batch-failure bisection with
+poison-request quarantine, hedged dispatch, fleet-unavailable
+fail-fast, registry-watcher backoff, and the chaos soak.
+
+All tier-1 except the long soak (slow): conftest forces 8 host-platform
+devices, so multi-replica pools run in-process on CPU.
+"""
+
+import math
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.models.linear import LinearMapper
+from keystone_tpu.obs import metrics
+from keystone_tpu.ops.stats import NormalizeRows
+from keystone_tpu.serve import (
+    FleetUnavailable,
+    PoisonRequest,
+    serve,
+    serve_http,
+)
+from keystone_tpu.utils import guard
+from keystone_tpu.workflow import Pipeline
+from keystone_tpu.workflow.transformer import Transformer
+
+pytestmark = pytest.mark.serve
+
+DIM = 6
+MARK = np.float32(123.0)
+
+
+class PoisonGate(Transformer):
+    """Host stage that raises when a row's first element is the marker —
+    a deterministic, content-attributable (request-shaped) failure the
+    bisection machinery must isolate.  Host-side (sequential) so the
+    error raises cleanly on the flush thread, outside any XLA program."""
+
+    is_host = True
+    parallel_host = False
+
+    def params(self):
+        return ()
+
+    def apply_one(self, x):
+        x = np.asarray(x)
+        if x[0] == MARK:
+            raise ValueError("poison marker row")
+        return x
+
+
+def _pipeline(scale: float = 2.0, poison_gate: bool = True) -> Pipeline:
+    w = jnp.asarray(np.eye(DIM, dtype=np.float32) * scale)
+    head = Pipeline.of(PoisonGate()) if poison_gate else Pipeline.of(NormalizeRows())
+    if poison_gate:
+        return head | NormalizeRows() | LinearMapper(w)
+    return head | LinearMapper(w)
+
+
+def _poison_row() -> np.ndarray:
+    row = np.ones(DIM, np.float32)
+    row[0] = MARK
+    return row
+
+
+def _rows(k: int, seed: int = 0) -> np.ndarray:
+    return (
+        np.random.default_rng(seed).normal(size=(k, DIM)).astype(np.float32)
+    )
+
+
+def _counter(name: str) -> float:
+    return metrics.REGISTRY.counter_total(name)
+
+
+# ---------------------------------------------------------------- units
+def test_heartbeat_renewal_and_expiry():
+    hb = guard.Heartbeat(0.1)
+    assert not hb.expired()
+    time.sleep(0.15)
+    assert hb.expired()
+    hb.beat()
+    assert not hb.expired()
+    assert hb.remaining() > 0.0
+
+
+def test_breaker_seconds_until_probe():
+    clock = [0.0]
+    b = guard.CircuitBreaker("selfheal.probe", threshold=1, reset_timeout=10.0, clock=lambda: clock[0])
+    assert b.seconds_until_probe() == 0.0
+    b.record_failure()
+    assert b.state() == "open"
+    assert b.seconds_until_probe() == pytest.approx(10.0)
+    clock[0] = 4.0
+    assert b.seconds_until_probe() == pytest.approx(6.0)
+    clock[0] = 10.0
+    assert b.state() == "half_open"
+    assert b.seconds_until_probe() == 0.0
+
+
+def test_fault_plan_ctx_match_grammar():
+    """``ctx.<key>=<value>`` clauses restrict a spec to matching site
+    contexts, and non-matching calls do not advance its triggers."""
+    plan = faults.parse_plan("serve.replica:ctx.replica=1:raise:times=2")
+    (spec,) = plan.specs
+    assert spec.match == {"replica": "1"}
+    assert spec.matches({"replica": 1})
+    assert not spec.matches({"replica": 0})
+    with faults.inject("serve.replica:ctx.replica=1:raise:times=1") as p:
+        faults.fault_point("serve.replica", replica=0)  # no match, no count
+        assert p.specs[0].calls == 0
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("serve.replica", replica=1)
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("serve.replica:ctx.replica=")
+    assert "serve.worker" in faults.SITES
+
+
+# ------------------------------------------------------------ bisection
+def test_bisection_isolates_poison_innocents_complete():
+    """One poison rider in a full batch: bisection fails IT alone
+    (typed), every innocent completes with the right value, and the
+    quarantine cache short-circuits the same content at admission."""
+    svc = serve(
+        _pipeline(),
+        max_batch=8,
+        max_wait_ms=40.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_bisect",
+        supervise=False,
+    )
+    try:
+        x = _rows(7, seed=1)
+        b0 = _counter("serve.bisections")
+        futs = svc.submit_many(list(x) + [_poison_row()])
+        excs = [f.exception(timeout=60) for f in futs]
+        assert excs[:7] == [None] * 7, excs
+        assert isinstance(excs[7], PoisonRequest), excs[7]
+        # innocents got REAL results (norm == 2 fingerprint)
+        for f in futs[:7]:
+            assert np.linalg.norm(np.asarray(f.result())) == pytest.approx(
+                2.0, rel=1e-4
+            )
+        assert _counter("serve.bisections") == b0 + 1
+        # the same content is refused at admission now — no device time
+        pb0 = _counter("serve.poison_blocked")
+        with pytest.raises(PoisonRequest):
+            svc.submit(_poison_row())
+        assert _counter("serve.poison_blocked") == pb0 + 1
+    finally:
+        svc.close()
+
+
+def test_bisection_infra_errors_are_not_bisected():
+    """An OSError-family flush failure (injected fault) fails the whole
+    batch exactly as before — bisection only fires on content-shaped
+    errors."""
+    svc = serve(
+        _pipeline(),
+        max_batch=4,
+        max_wait_ms=20.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_infra",
+        supervise=False,
+    )
+    try:
+        b0 = _counter("serve.bisections")
+        with faults.inject("serve.batch:raise:times=1"):
+            futs = svc.submit_many(_rows(4, seed=2))
+            errs = [f.exception(timeout=30) for f in futs]
+        assert all(isinstance(e, faults.FaultInjected) for e in errs), errs
+        assert _counter("serve.bisections") == b0
+    finally:
+        svc.close()
+
+
+def test_poison_http_422_and_pinned_trace():
+    """HTTP contract: a poison request answers 422 (not 500) with its
+    request id, and its trace is pinned with outcome ``poison``."""
+    svc = serve(
+        _pipeline(),
+        max_batch=4,
+        max_wait_ms=5.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_http",
+        supervise=False,
+    )
+    front = serve_http(svc, port=0)
+    try:
+        import json
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        url = f"http://127.0.0.1:{front.port}"
+        body = json.dumps({"instance": _poison_row().tolist()}).encode()
+        req = Request(
+            url + "/predict",
+            data=body,
+            headers={"X-Request-Id": "poison-1"},
+            method="POST",
+        )
+        with pytest.raises(HTTPError) as ei:
+            urlopen(req, timeout=60)
+        assert ei.value.code == 422
+        payload = json.loads(ei.value.read())
+        assert payload["request_id"] == "poison-1"
+        assert "poison" in payload["error"]
+        # the trace is pinned and resolvable with the poison outcome
+        trace = json.loads(
+            urlopen(url + "/requestz/poison-1", timeout=30).read()
+        )
+        assert trace["outcome"] == "poison"
+        assert "poison-1" in [
+            t["request_id"]
+            for t in svc.recorder.tracez(filter="poison", limit=50)
+        ]
+        # an innocent request still answers 200
+        ok = json.loads(
+            urlopen(
+                Request(
+                    url + "/predict",
+                    data=json.dumps(
+                        {"instance": _rows(1, seed=3)[0].tolist()}
+                    ).encode(),
+                    method="POST",
+                ),
+                timeout=60,
+            ).read()
+        )
+        assert "predictions" in ok
+    finally:
+        front.stop()
+        svc.close()
+
+
+# ----------------------------------------------------------- supervisor
+def test_acceptance_crash_plus_poison_chaos():
+    """The ISSUE-10 chaos acceptance scenario: a seeded plan crashes one
+    replica worker mid-load while one poison request rides a full batch.
+    The supervisor restarts the crashed replica (visible in /statusz
+    and as a recorder ops span), bisection isolates the poison within
+    <= ceil(log2(max_batch)) halving levels, every innocent co-batched
+    rider completes, and ZERO futures are lost."""
+    max_batch = 8
+    svc = serve(
+        _pipeline(),
+        max_batch=max_batch,
+        max_wait_ms=30.0,
+        queue_bound=512,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_accept",
+        replicas=2,
+        supervise_interval_s=0.1,
+    )
+    try:
+        r0 = _counter("serve.replica_restarts")
+        futs = []
+        with faults.inject("serve.worker:raise:after=2:times=1"):
+            for wave in range(3):
+                batch = list(_rows(max_batch - 1, seed=wave))
+                if wave == 1:
+                    # the poison rides co-batched with innocents
+                    batch.append(_poison_row())
+                futs.extend(svc.submit_many(batch))
+                time.sleep(0.05)
+            excs = [f.exception(timeout=120) for f in futs]
+        # zero futures lost: every single one resolved...
+        assert all(f.done() for f in futs)
+        poisons = [e for e in excs if isinstance(e, PoisonRequest)]
+        others = [
+            e for e in excs if e is not None and not isinstance(e, PoisonRequest)
+        ]
+        # ...the poison alone failed (typed), every innocent completed
+        assert len(poisons) == 1, excs
+        assert others == [], others
+        # the supervisor restarted the crashed replica, visibly
+        assert _counter("serve.replica_restarts") >= r0 + 1
+        status = svc.status()  # what GET /statusz serves
+        assert status["supervisor"]["restarts"] >= 1
+        assert status["supervisor"]["last_restart"]["reason"] == "dead"
+        assert any(s["restarts"] > 0 for s in status["replicas"])
+        # the aggregate bisect/restart ops spans are emitted on the
+        # worker thread AFTER future delivery — poll briefly rather
+        # than race them (per-REQUEST traces finalize before delivery;
+        # the ops ring is the aggregate view)
+        deadline = time.monotonic() + 10.0
+        restarts = bisects = []
+        while (not restarts or not bisects) and time.monotonic() < deadline:
+            ops = svc.recorder.ops_spans(limit=50)
+            restarts = [o for o in ops if o["name"] == "replica.restart"]
+            bisects = [o for o in ops if o["name"] == "serve.bisect"]
+            if not restarts or not bisects:
+                time.sleep(0.05)
+        assert restarts and restarts[0]["reason"] == "dead"
+        # bisection bound: depth <= ceil(log2(max_batch))
+        assert bisects, svc.recorder.ops_spans(limit=50)
+        assert bisects[0]["depth"] <= math.ceil(math.log2(max_batch))
+    finally:
+        svc.close()
+
+
+def test_wedged_worker_restarted_queued_work_survives():
+    """A wedged worker (stall injected in the worker loop, heartbeat
+    expired with a flush in hand) is swapped out: its QUEUED flushes
+    transfer to the replacement and complete; the in-hand flush's
+    riders fail typed (callers unblock) instead of hanging."""
+    svc = serve(
+        _pipeline(poison_gate=False),
+        max_batch=2,
+        max_wait_ms=2.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_wedge",
+        replicas=1,
+        heartbeat_s=0.3,
+        supervise_interval_s=0.1,
+    )
+    try:
+        x = _rows(2, seed=5)
+        with faults.inject("serve.worker:delay=1.0:times=1"):
+            stuck = svc.submit_many(x)  # first flush: wedges the worker
+            time.sleep(0.1)
+            queued = svc.submit_many(x)  # second flush: queued behind it
+            # the supervisor declares the wedge and heals
+            errs = [f.exception(timeout=30) for f in stuck]
+            assert all(isinstance(e, FleetUnavailable) for e in errs), errs
+            got = [f.result(timeout=30) for f in queued]
+        assert len(got) == 2
+        st = svc.status()
+        assert st["supervisor"]["restarts"] >= 1
+        assert st["supervisor"]["last_restart"]["reason"] == "wedged"
+    finally:
+        svc.close()
+
+
+def test_quarantine_after_restart_budget_and_swap_readmits():
+    """Restart budget exhausted -> the slot is quarantined (gauge set,
+    recorder ops span); with every replica quarantined the fleet fails
+    fast: submit raises typed, /healthz answers 503 with Retry-After,
+    and a blue/green swap() re-admits traffic."""
+    import json
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    svc = serve(
+        _pipeline(poison_gate=False),
+        max_batch=4,
+        max_wait_ms=2.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_quar",
+        replicas=1,
+        restart_limit=1,
+        restart_window_s=60.0,
+        supervise_interval_s=0.1,
+    )
+    front = serve_http(svc, port=0)
+    try:
+        url = f"http://127.0.0.1:{front.port}"
+        x = _rows(2, seed=6)
+        q0 = _counter("serve.replica_restarts")
+        with faults.inject("serve.worker:raise:times=2"):
+            # first crash -> restart (budget spent); second -> quarantine
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    for f in svc.submit_many(x):
+                        f.exception(timeout=15)
+                except Exception:
+                    pass  # refusals while crashing/healing are expected
+                if svc._pool.replicas[0].quarantined:
+                    break
+                time.sleep(0.05)
+        assert svc._pool.replicas[0].quarantined, svc.replica_statuses()
+        assert _counter("serve.replica_restarts") >= q0 + 1
+        assert (
+            metrics.REGISTRY.gauge_value("serve.quarantined", replica=0) == 1.0
+        )
+        assert any(
+            o["name"] == "replica.quarantine"
+            for o in svc.recorder.ops_spans(limit=50)
+        )
+        # the whole fleet is down: typed refusal + non-200 healthz
+        assert svc.available is False
+        with pytest.raises(FleetUnavailable):
+            svc.submit_many(x)
+        with pytest.raises(HTTPError) as ei:
+            urlopen(url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert json.loads(ei.value.read())["status"] == "unavailable"
+        # a blue/green swap is the quarantine reset: traffic flows again
+        svc.swap(_pipeline(3.0, poison_gate=False), version="healed")
+        assert svc.available is True
+        got = [f.result(timeout=30) for f in svc.submit_many(x)]
+        assert np.linalg.norm(np.asarray(got[0])) == pytest.approx(
+            3.0, rel=1e-4
+        )
+        health = json.loads(urlopen(url + "/healthz", timeout=30).read())
+        assert health["status"] == "ok"
+    finally:
+        front.stop()
+        svc.close()
+
+
+# -------------------------------------------------------------- hedging
+def test_hedge_rescues_straggler_single_resolution():
+    """A straggling worker's queued flush is hedged onto the healthy
+    replica and completes fast; every rider resolves EXACTLY once (the
+    loser pop is a claim-skip), the loser reaches the recorder as
+    ``cancelled`` (not error), and the loser replica's breaker is
+    charged neutrally."""
+    svc = serve(
+        _pipeline(poison_gate=False),
+        max_batch=4,
+        max_wait_ms=2.0,
+        queue_bound=256,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_hedge",
+        replicas=2,
+        hedge_ms=20.0,
+        supervise=False,
+    )
+    try:
+        h0 = _counter("serve.hedges")
+        c0 = _counter("serve.hedge_cancelled")
+        x = _rows(4, seed=7)
+        with faults.inject("serve.worker:ctx.replica=0:delay=0.3"):
+            futs = []
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.6:
+                futs.extend(svc.submit_many(x))
+                time.sleep(0.01)
+            got = [f.result(timeout=60) for f in futs]
+        assert len(got) == len(futs)
+        assert _counter("serve.hedges") > h0
+        # every fired hedge eventually resolves its LOSER copy as a
+        # cancelled claim-skip once the stalled worker pops it late
+        deadline = time.monotonic() + 15.0
+        while (
+            _counter("serve.hedge_cancelled") <= c0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert _counter("serve.hedge_cancelled") > c0
+        losers = [
+            o
+            for o in svc.recorder.ops_spans(limit=100)
+            if o["name"] == "serve.hedge" and o.get("outcome") == "cancelled"
+        ]
+        assert losers, svc.recorder.ops_spans(limit=20)
+        # loser pops charged NEUTRALLY: no replica accumulated errors
+        # and every breaker stayed closed throughout
+        statuses = svc.replica_statuses()
+        assert sum(s["errors"] for s in statuses) == 0, statuses
+        assert all(s["breaker"] == "closed" for s in statuses), statuses
+    finally:
+        svc.close()
+
+
+def test_hedging_disabled_is_pr9_dispatch_path():
+    """hedge_ms=None (the default): no hedge monitor thread exists, no
+    hedge metric moves, and the dispatch path serves identically to the
+    PR-9 fleet — the opt-out really is the old path."""
+    before_threads = {t.name for t in threading.enumerate()}
+    svc = serve(
+        _pipeline(poison_gate=False),
+        max_batch=4,
+        max_wait_ms=2.0,
+        queue_bound=64,
+        example=np.zeros(DIM, np.float32),
+        name="selfheal_nohedge",
+        replicas=2,
+        supervise=False,
+    )
+    try:
+        assert svc._hedge is None
+        assert not any(
+            "selfheal_nohedge-hedge" in t.name for t in threading.enumerate()
+        )
+        h0 = _counter("serve.hedges")
+        x = _rows(4, seed=8)
+        ref = None
+        for _ in range(4):
+            got = np.stack(
+                [f.result(timeout=30) for f in svc.submit_many(x)]
+            )
+            if ref is None:
+                ref = got
+            np.testing.assert_array_equal(got, ref)
+        assert _counter("serve.hedges") == h0
+    finally:
+        svc.close()
+    # no thread leaked relative to the baseline set
+    leaked = {
+        t.name
+        for t in threading.enumerate()
+        if "hedge" in t.name and t.name not in before_threads
+    }
+    assert not leaked, leaked
+
+
+# ------------------------------------------------------ watcher backoff
+class _FlakyRegistry:
+    """current() raises until told otherwise — the backoff driver."""
+
+    def __init__(self):
+        self.fail = True
+        self.polls = 0
+
+    def current(self, strict=False):
+        self.polls += 1
+        if self.fail:
+            raise OSError("registry storage down")
+        return None  # healthy, nothing new
+
+
+def test_watcher_backs_off_on_consecutive_errors():
+    from keystone_tpu.serve.registry import RegistryWatcher
+
+    class _Svc:
+        version = "v0"
+        recorder = None
+
+    reg = _FlakyRegistry()
+    w = RegistryWatcher(_Svc(), reg, poll_seconds=0.1, max_backoff_seconds=2.0)
+    # unit: the wait schedule grows exponentially, jittered, capped
+    assert w.next_wait() == pytest.approx(0.1)
+    w._consecutive_errors = 1
+    w1 = w.next_wait()
+    assert 0.1 <= w1 <= 0.3
+    w._consecutive_errors = 3
+    w3 = w.next_wait()
+    assert 0.4 <= w3 <= 1.2
+    w._consecutive_errors = 30
+    assert w.next_wait() <= 2.0  # capped
+    assert metrics.REGISTRY.gauge_value("serve.watch_backoff_seconds") > 0.0
+    w._consecutive_errors = 0
+    assert w.next_wait() == pytest.approx(0.1)
+    assert metrics.REGISTRY.gauge_value("serve.watch_backoff_seconds") == 0.0
+    # integration: errors accumulate consecutively, a success resets
+    e0 = _counter("serve.watch_errors")
+    w = RegistryWatcher(_Svc(), reg, poll_seconds=0.02, max_backoff_seconds=0.2)
+    w.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while w._consecutive_errors < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w._consecutive_errors >= 3
+        assert _counter("serve.watch_errors") >= e0 + 3
+        reg.fail = False
+        deadline = time.monotonic() + 10.0
+        while w._consecutive_errors != 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w._consecutive_errors == 0
+    finally:
+        w.stop()
+
+
+def test_watcher_strict_current_counts_corrupt_pointer(tmp_path):
+    """A corrupt CURRENT pointer is a poll ERROR for the watcher (it
+    backs off) while the plain deploy path still treats it as no-news."""
+    from keystone_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish(_pipeline(poison_gate=False))
+    # damage CURRENT in place: checksum sidecar no longer matches
+    with open(reg._current_path(), "r+b") as f:
+        f.seek(0)
+        f.write(b"vXXXX")
+    assert reg.current() is None  # lenient: no news
+    with pytest.raises(Exception):
+        reg.current(strict=True)  # watcher mode: a real error
+
+
+# ----------------------------------------------------------------- soak
+@pytest.mark.soak
+@pytest.mark.chaos
+def test_soak_short_deterministic():
+    """The tier-1 soak gate: a short seeded randomized multi-site chaos
+    loop against a live 2-replica fleet — zero hung/lost futures and a
+    fleet that still serves a clean wave afterwards."""
+    from tools.chaos import run_soak
+
+    report = run_soak(seconds=1.2, seed=0, replicas=2, wave=16)
+    assert report["hung"] == 0, report
+    assert report["healthy_after_soak"], report
+    assert report["ok"], report
+    assert report["iterations"] >= 1
+
+
+@pytest.mark.soak
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_soak_long():
+    """The tier-2 soak: a longer randomized window, same invariants."""
+    from tools.chaos import run_soak
+
+    report = run_soak(seconds=20.0, seed=1, replicas=2, wave=48)
+    assert report["hung"] == 0, report
+    assert report["healthy_after_soak"], report
+    assert report["ok"], report

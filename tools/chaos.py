@@ -33,6 +33,15 @@ escaping fault/exception; 2 = the workload completed but a site named
 in the plan never injected (``not-exercised`` — a typo'd trigger or a
 workload that never reaches the site must not read as a green chaos
 run).
+
+**Soak mode** (``--soak SECONDS [--seed N] [--soak-replicas R]``)
+stands up a live replica fleet (supervisor + hedging on) and loops
+seeded randomized multi-site plans over the ``serve.*`` sites — worker
+crashes, flush failures, injected delays — submitting a request wave
+under each plan and requiring EVERY future to resolve (result or typed
+error).  Exit 1 on any hung/lost request, or on a fleet that cannot
+serve a clean wave once the soak ends.  The plan sequence is
+deterministic in the seed, so a failing soak replays exactly.
 """
 
 from __future__ import annotations
@@ -42,6 +51,8 @@ import importlib
 import json
 import os
 import sys
+import time
+from concurrent.futures import TimeoutError as _FutTimeout
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -137,6 +148,146 @@ def _stream(tmp, restarts):
 WORKLOADS = {"bcd": _bcd, "ooc": _ooc, "lbfgs": _lbfgs, "stream": _stream}
 
 
+# --------------------------------------------------------------- soak
+#: the serve-path sites a soak plan draws from, with the actions each
+#: may carry (worker crashes exercise the supervisor; delays exercise
+#: hedging/shedding; raises exercise failure containment + bisection
+#: charging).  `hang` is deliberately absent: an un-deadlined hang is
+#: an hour-long stall, which is a test of the clock, not the fleet.
+_SOAK_MENU = (
+    ("serve.enqueue", ("raise",)),
+    ("serve.batch", ("raise", "delay")),
+    ("serve.replica", ("raise", "delay")),
+    ("serve.worker", ("raise", "delay")),
+)
+
+
+def _soak_plan(rng) -> str:
+    """One randomized (but seed-deterministic) multi-site plan clause
+    set in the KEYSTONE_FAULTS grammar."""
+    n_sites = rng.randint(1, 3)
+    picks = rng.sample(range(len(_SOAK_MENU)), n_sites)
+    clauses = []
+    for i in picks:
+        site, actions = _SOAK_MENU[i]
+        action = actions[rng.randrange(len(actions))]
+        times = rng.randint(1, 3)
+        after = rng.randint(0, 4)
+        if action == "delay":
+            delay = round(rng.uniform(0.005, 0.05), 4)
+            clauses.append(f"{site}:delay={delay}:after={after}:times={times}")
+        else:
+            clauses.append(f"{site}:raise:after={after}:times={times}")
+    return ";".join(clauses)
+
+
+def run_soak(
+    seconds: float,
+    seed: int = 0,
+    replicas: int = 2,
+    wave: int = 48,
+    result_timeout: float = 30.0,
+) -> dict:
+    """Loop seeded randomized multi-site fault plans against a LIVE
+    serving fleet; every submitted future must resolve (a completed
+    result or a typed failure) — a future that never resolves is a
+    LOST/HUNG request, the one outcome the self-healing layer must
+    never produce.  Returns the report dict; the CLI exits non-zero on
+    any hung request (or a fleet that cannot serve a clean wave at the
+    end)."""
+    import random as _random
+
+    import numpy as np
+
+    from keystone_tpu import faults
+    from keystone_tpu.utils import guard as _guard
+
+    from tools import serve_bench
+
+    rng = _random.Random(seed)
+    svc, item_shape = serve_bench.build_service(
+        dim=8,
+        max_batch=8,
+        max_wait_ms=2.0,
+        queue_bound=256,
+        deadline_ms=None,
+        replicas=replicas,
+        # soak services heal aggressively: short heartbeat, fast sweep,
+        # a restart budget the whole soak cannot exhaust
+        supervise_interval_s=0.1,
+        heartbeat_s=5.0,
+        restart_limit=10_000,
+        hedge_ms=25.0,
+    )
+    payload = np.random.default_rng(seed).normal(
+        size=(wave,) + tuple(item_shape)
+    ).astype(np.float32)
+    report = {
+        "seconds": seconds,
+        "seed": seed,
+        "replicas": replicas,
+        "iterations": 0,
+        "submitted": 0,
+        "completed": 0,
+        "failed_typed": 0,
+        "rejected": 0,
+        "hung": 0,
+        "plans": [],
+    }
+    try:
+        end = time.monotonic() + float(seconds)
+        while time.monotonic() < end:
+            plan = _soak_plan(rng)
+            report["iterations"] += 1
+            report["plans"].append(plan)
+            futs = []
+            with faults.inject(plan):
+                for i in range(wave):
+                    try:
+                        futs.append(svc.submit(payload[i]))
+                    except Exception:
+                        report["rejected"] += 1
+                    report["submitted"] += 1
+                # resolve INSIDE the plan window: mid-flight faults on
+                # in-flight futures are the point of the soak
+                for f in futs:
+                    try:
+                        f.result(timeout=result_timeout)
+                        report["completed"] += 1
+                    except _FutTimeout:
+                        report["hung"] += 1
+                    except Exception:
+                        report["failed_typed"] += 1
+        # the exit gate: after the last plan, a clean wave must serve —
+        # a fleet that "survived" the soak but can no longer serve is a
+        # failure (give healing a moment to finish)
+        clean_ok = 0
+        deadline = time.monotonic() + result_timeout
+        while clean_ok < wave and time.monotonic() < deadline:
+            clean_ok = 0
+            futs = []
+            for i in range(wave):
+                try:
+                    futs.append(svc.submit(payload[i]))
+                except Exception:
+                    pass  # still healing: retry the wave below
+            for f in futs:
+                try:
+                    f.result(timeout=result_timeout)
+                    clean_ok += 1
+                except Exception:
+                    pass
+            if clean_ok < wave:
+                _guard.interruptible_sleep(0.2)
+        report["clean_wave_completed"] = clean_ok
+        report["clean_wave_size"] = wave
+        report["healthy_after_soak"] = clean_ok == wave
+    finally:
+        svc.close()
+    report["ok"] = report["hung"] == 0 and report["healthy_after_soak"]
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run a workload under a KEYSTONE_FAULTS plan and "
@@ -144,9 +295,33 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--plan",
-        required=True,
+        default=None,
         help="fault plan, KEYSTONE_FAULTS grammar "
-        "(e.g. 'ckpt.save:after=1:corrupt;blockstore.read:p=0.1:seed=7')",
+        "(e.g. 'ckpt.save:after=1:corrupt;blockstore.read:p=0.1:seed=7'). "
+        "Required unless --soak is given.",
+    )
+    ap.add_argument(
+        "--soak",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="soak mode: loop seeded randomized multi-site plans "
+        "(serve.* sites) against a live replica fleet for SECONDS; "
+        "exits non-zero on any lost/hung future or a fleet that cannot "
+        "serve a clean wave afterwards.  Ignores --plan/--workload.",
+    )
+    ap.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="soak plan-generator seed (deterministic plan sequence)",
+    )
+    ap.add_argument(
+        "--soak-replicas",
+        type=int,
+        default=2,
+        help="fleet size for the soak service (pair with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N on CPU)",
     )
     ap.add_argument(
         "--workload",
@@ -199,6 +374,15 @@ def main(argv=None) -> int:
         "retry/bad-batch quota instead of blocking the iterator",
     )
     args = ap.parse_args(argv)
+
+    if args.soak is not None:
+        report = run_soak(
+            args.soak, seed=args.seed, replicas=args.soak_replicas
+        )
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    if args.plan is None:
+        ap.error("--plan is required (unless --soak)")
 
     if args.stage_deadline is not None:
         os.environ["KEYSTONE_STAGE_DEADLINE"] = str(args.stage_deadline)
@@ -314,6 +498,15 @@ def main(argv=None) -> int:
                 out[key[len(prefix) : -1]] = int(v)
         return out
 
+    def _gauges_labeled(snapshot, name, label):
+        """{label_value: gauge} for one gauge family in a snapshot."""
+        out = {}
+        prefix = name + "{" + label + "="
+        for key, v in (snapshot.get("gauges") or {}).items():
+            if key.startswith(prefix) and key.endswith("}"):
+                out[key[len(prefix) : -1]] = v
+        return out
+
     report = {
         "plan": args.plan,
         "workload": args.workload,
@@ -331,11 +524,20 @@ def main(argv=None) -> int:
         },
         # the deadline/watchdog/breaker layer's outcomes (utils/guard.py)
         # — how injected latency was absorbed, from the same registry
-        # the per-site counts come from
+        # the per-site counts come from — plus the serve fleet's
+        # self-healing outcomes (supervisor restarts, quarantines,
+        # batch bisections) when the workload ran a service
         "guard": {
             "deadline_exceeded": _labeled("guard.deadline_exceeded", "site"),
             "breaker_opens": _labeled("breaker.opens", "key"),
             "degraded": _labeled("executor.degraded", "node"),
+            "replica_restarts": _labeled("serve.replica_restarts", "replica"),
+            "quarantined": _gauges_labeled(snap, "serve.quarantined", "replica"),
+            "bisections": int(
+                (snap.get("counters") or {}).get("serve.bisections", 0)
+            ),
+            "poison": int((snap.get("counters") or {}).get("serve.poison", 0)),
+            "hedges": int((snap.get("counters") or {}).get("serve.hedges", 0)),
         },
     }
     if led is not None:

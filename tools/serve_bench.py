@@ -31,6 +31,10 @@ Usage (CPU-safe; any laptop)::
     # gains the swap pause + prime time and per-replica occupancy
     ... --replicas 4 --swap-mid-run
 
+    # inject a straggler (replica 0 stalls every flush 40 ms) and hedge
+    # around it: queued flushes escape onto a healthy replica
+    ... --replicas 2 --straggler-ms 40 --hedge-ms 10
+
 The default workload is a small synthetic two-stage pipeline
 (NormalizeRows → LinearMapper) so the tool measures the serving layer
 itself; ``--model`` swaps in a real fitted pipeline whose input is a
@@ -72,16 +76,20 @@ def build_service(
     max_batch: int = 32,
     max_wait_ms: float = 2.0,
     queue_bound: int = 128,
-    deadline_ms: float = 250.0,
+    deadline_ms: float | None = 250.0,
     model: str | None = None,
     seed: int = 0,
     replicas: int = 1,
     recorder: bool = True,
+    **serve_kw,
 ):
     """A primed service over the synthetic two-stage pipeline (or a
     saved fitted model); returns ``(service, item_shape)``.
     ``recorder=False`` runs the PR-5 untraced path — the on/off pair is
-    how the bench pins the flight recorder's overhead budget."""
+    how the bench pins the flight recorder's overhead budget.  Extra
+    keywords (``hedge_ms``, ``supervise``, ``heartbeat_s``, ...) pass
+    through to :func:`keystone_tpu.serve.serve` — the hedging A/B and
+    the chaos soak ride this."""
     import numpy as np
 
     from keystone_tpu.serve import serve
@@ -103,6 +111,7 @@ def build_service(
         name="serve_bench",
         replicas=replicas,
         recorder=recorder,
+        **serve_kw,
     )
     return svc, item_shape
 
@@ -122,6 +131,8 @@ def run_bench(
     deadline_ms: float | None = None,
     batch_delay_ms: float = 0.0,
     swap_pipeline=None,
+    straggler_ms: float = 0.0,
+    straggler_replica: int = 0,
 ) -> dict:
     """Offer ``qps`` requests/sec for ``duration`` seconds (groups of
     ``burst`` arrivals at the same mean rate), wait for the tail to
@@ -130,7 +141,10 @@ def run_bench(
     laptop can exercise overload deterministically).  ``swap_pipeline``:
     blue/green hot-swap this fitted pipeline in at the midpoint of the
     offer window; the report gains the swap info (pause, prime time) so
-    the round artifact records what a live rollout costs under load."""
+    the round artifact records what a live rollout costs under load.
+    ``straggler_ms`` > 0 makes ONE replica (``straggler_replica``) stall
+    every flush apply via a context-matched ``serve.replica`` plan —
+    the deterministic straggler the hedging A/B measures against."""
     import contextlib
 
     import numpy as np
@@ -167,10 +181,21 @@ def run_bench(
     interval = burst / qps
     futs = []
 
+    clauses = []
+    if batch_delay_ms > 0:
+        clauses.append(f"serve.batch:delay={batch_delay_ms / 1000.0}")
+    if straggler_ms > 0:
+        # serve.worker, not serve.replica: the stall lands in the worker
+        # loop BEFORE the flush is claimed, so the batch stays
+        # "still-unflushed" for the whole stall — the exact failure mode
+        # hedged dispatch exists to rescue (a claimed flush mid-apply is
+        # beyond any hedge that avoids duplicate device work)
+        clauses.append(
+            f"serve.worker:ctx.replica={int(straggler_replica)}"
+            f":delay={straggler_ms / 1000.0}"
+        )
     plan = (
-        faults.inject(f"serve.batch:delay={batch_delay_ms / 1000.0}")
-        if batch_delay_ms > 0
-        else contextlib.nullcontext()
+        faults.inject(";".join(clauses)) if clauses else contextlib.nullcontext()
     )
     swap_info: dict = {}
     swap_thread = None
@@ -243,6 +268,13 @@ def run_bench(
         "burst": burst,
         "deadline_ms": deadline_ms,
         "batch_delay_ms": batch_delay_ms,
+        "straggler_ms": straggler_ms,
+        "hedges": int(
+            c1.get("serve.hedges", 0.0) - c0.get("serve.hedges", 0.0)
+        ),
+        "hedge_wins": int(
+            c1.get("serve.hedge_wins", 0.0) - c0.get("serve.hedge_wins", 0.0)
+        ),
         "n_requests": n_arrivals,
         "completed": completed,
         "shed": outcomes["shed"],
@@ -378,6 +410,100 @@ def run_overhead_pair(
     return out
 
 
+def run_straggler_ab(
+    qps: float = 300.0,
+    duration: float = 2.0,
+    rounds: int = 4,
+    replicas: int = 2,
+    max_batch: int = 16,
+    deadline_ms: float = 2000.0,
+    straggler_ms: float = 40.0,
+    hedge_ms: float = 10.0,
+    dim: int = 64,
+) -> dict:
+    """The hedging acceptance pin: the SAME workload with ONE injected
+    straggler replica (every flush on replica 0 stalls ``straggler_ms``)
+    against two fleets in one process — hedging ON vs OFF — order-
+    alternated across ``rounds`` with a discarded warmup, exactly the
+    ``run_overhead_pair`` discipline.  Hedging must cut p99 (queued
+    flushes escape the straggler's queue onto a healthy replica) at
+    ≤ 5% achieved-QPS cost — hedge losers are claim-skips, not
+    duplicated device work.  Reports per-mode medians plus
+    ``p99_ratio`` (hedged/unhedged, want < 1) and ``qps_cost``
+    (1 − hedged/unhedged QPS, want ≤ 0.05)."""
+    import statistics
+
+    services = {}
+    for mode, hedge in (("hedged", hedge_ms), ("unhedged", None)):
+        svc, item_shape = build_service(
+            dim=dim,
+            max_batch=max_batch,
+            queue_bound=256,
+            deadline_ms=deadline_ms,
+            replicas=replicas,
+            hedge_ms=hedge,
+            # the straggler is an INJECTED stall, not a wedge: keep the
+            # supervisor from "healing" the leg out from under the A/B
+            supervise=False,
+        )
+        services[mode] = (svc, item_shape)
+    samples = {"hedged": [], "unhedged": []}
+    try:
+        for rnd in range(max(2, int(rounds)) + 1):
+            order = (
+                ("hedged", "unhedged") if rnd % 2 == 0 else ("unhedged", "hedged")
+            )
+            for mode in order:
+                svc, item_shape = services[mode]
+                rep = run_bench(
+                    svc,
+                    item_shape,
+                    qps=qps,
+                    duration=duration if rnd > 0 else 0.5,
+                    deadline_ms=deadline_ms,
+                    straggler_ms=straggler_ms,
+                )
+                if rnd > 0:  # round 0 is the discarded warmup
+                    samples[mode].append(rep)
+    finally:
+        for svc, _ in services.values():
+            svc.close()
+
+    def med(mode: str, key: str):
+        vals = [r[key] for r in samples[mode] if r.get(key) is not None]
+        return round(float(statistics.median(vals)), 2) if vals else None
+
+    out = {
+        "offered_qps": qps,
+        "duration_s": duration,
+        "rounds": len(samples["hedged"]),
+        "replicas": replicas,
+        "straggler_ms": straggler_ms,
+        "hedge_ms": hedge_ms,
+    }
+    for mode in ("hedged", "unhedged"):
+        out[mode] = {
+            k: med(mode, k)
+            for k in ("achieved_qps", "p50_ms", "p95_ms", "p99_ms", "max_ms")
+        }
+    out["hedged"]["hedges"] = sum(r["hedges"] for r in samples["hedged"])
+    out["hedged"]["hedge_wins"] = sum(
+        r["hedge_wins"] for r in samples["hedged"]
+    )
+    hedging = {}
+    on_p99, off_p99 = out["hedged"].get("p99_ms"), out["unhedged"].get("p99_ms")
+    if on_p99 and off_p99:
+        hedging["p99_ratio"] = round(on_p99 / off_p99, 3)
+    on_q, off_q = (
+        out["hedged"].get("achieved_qps"),
+        out["unhedged"].get("achieved_qps"),
+    )
+    if on_q and off_q:
+        hedging["qps_cost"] = round(1.0 - on_q / off_q, 4)
+    out["hedging"] = hedging
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="open-loop load generator for keystone_tpu.serve"
@@ -424,6 +550,28 @@ def main(argv=None) -> int:
         "on-vs-off pair pins the recorder overhead budget (p99/QPS "
         "within 5%%)",
     )
+    ap.add_argument(
+        "--straggler-ms",
+        type=float,
+        default=0.0,
+        help="stall ONE replica's worker loop (--straggler-replica) "
+        "this long per flush via a context-matched serve.worker plan "
+        "(pre-claim, so the stalled batch stays hedgeable) — the "
+        "deterministic straggler for hedging A/Bs",
+    )
+    ap.add_argument(
+        "--straggler-replica",
+        type=int,
+        default=0,
+        help="which replica index the straggler plan targets",
+    )
+    ap.add_argument(
+        "--hedge-ms",
+        type=float,
+        default=None,
+        help="enable hedged dispatch with this floor delay (needs "
+        "--replicas >= 2); pair with --straggler-ms to see the p99 win",
+    )
     args = ap.parse_args(argv)
 
     svc, item_shape = build_service(
@@ -436,6 +584,7 @@ def main(argv=None) -> int:
         model=args.model,
         replicas=args.replicas,
         recorder=not args.no_recorder,
+        hedge_ms=args.hedge_ms,
     )
     swap_pipeline = None
     if args.swap_mid_run:
@@ -457,6 +606,8 @@ def main(argv=None) -> int:
             deadline_ms=args.deadline_ms,
             batch_delay_ms=args.batch_delay_ms,
             swap_pipeline=swap_pipeline,
+            straggler_ms=args.straggler_ms,
+            straggler_replica=args.straggler_replica,
         )
     finally:
         svc.close()
