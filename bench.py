@@ -178,6 +178,15 @@ HEDGE_QPS = float(os.environ.get("BENCH_HEDGE_QPS", "250"))
 HEDGE_ROUNDS = int(os.environ.get("BENCH_HEDGE_ROUNDS", "4"))
 HEDGE_STRAGGLER_MS = 60.0
 HEDGE_FLOOR_MS = 10.0
+
+# --- AOT artifact legs (ISSUE 11): cold-start-to-first-prediction and
+# supervisor restart-to-rejoin, each as an artifact-vs-compile A/B over
+# an identical published registry version — every sample in a fresh
+# subprocess with a fresh (empty) persistent compile cache, so the
+# delta IS the pre-lowered executable tier, not leftover process
+# warmth.  Both arms' first predictions must match bit-for-bit.
+ARTIFACT_LEGS = int(os.environ.get("BENCH_ARTIFACT_LEGS", "1"))
+ARTIFACT_AB_ROUNDS = int(os.environ.get("BENCH_ARTIFACT_ROUNDS", "2"))
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -736,6 +745,23 @@ def main():
         )
         return
 
+    if "--leg-serve-artifacts" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                {
+                    "cold_start": serve_bench.run_cold_start_ab(
+                        rounds=ARTIFACT_AB_ROUNDS
+                    ),
+                    "restart": serve_bench.run_restart_ab(
+                        rounds=ARTIFACT_AB_ROUNDS
+                    ),
+                }
+            )
+        )
+        return
+
     if "--leg-solver-scale" in sys.argv:
         print(json.dumps(measure_solver_at_scale()))
         return
@@ -913,6 +939,17 @@ def main():
         else None
     )
 
+    # AOT artifact legs (ISSUE 11): cold-start + restart-to-rejoin,
+    # artifact vs compile (the driver leg spawns its own per-arm
+    # subprocesses with fresh compile caches)
+    artifact_leg = (
+        subprocess_leg(
+            "--leg-serve-artifacts", required=("cold_start", "restart")
+        )
+        if ARTIFACT_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1054,6 +1091,14 @@ def main():
         # p99_ratio < 1 = hedging rescued the straggler's queue;
         # qps_cost <= 0.05 = the acceptance budget
         out["serve_hedge"] = hedge_leg
+    if artifact_leg:
+        # speedup > 1 on both legs = the artifact tier beats fresh
+        # compilation for cold start AND supervisor heal;
+        # predictions_match pins artifact-vs-compile bit-parity
+        for section in artifact_leg.values():
+            if isinstance(section, dict):
+                section.pop("samples", None)  # medians suffice in the artifact
+        out["serve_artifacts"] = artifact_leg
     if fit_scale_legs:
         fss = [float(lg["fit_seconds"]) for lg in fit_scale_legs]
         out["fit_at_scale"] = {

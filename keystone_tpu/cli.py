@@ -171,6 +171,14 @@ def _serve_main(argv) -> int:
         help="disable batch-failure bisection (poison-request "
         "isolation + content quarantine).  On by default.",
     )
+    ap.add_argument(
+        "--no-artifacts",
+        action="store_true",
+        help="skip the AOT artifact tier: ignore pre-lowered "
+        "executables published next to the model (the escape hatch "
+        "when a published artifact is suspected bad) — priming rides "
+        "the compile-cache/fresh-compile rungs of the ladder instead",
+    )
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument(
@@ -191,11 +199,16 @@ def _serve_main(argv) -> int:
     from keystone_tpu.serve import HttpFrontend, serve
 
     registry = None
+    artifacts = None
     if args.model_dir is not None:
         from keystone_tpu.serve import ModelRegistry
 
         registry = ModelRegistry(args.model_dir)
         fitted, version = registry.load()
+        if not args.no_artifacts:
+            # best-effort AOT tier: absent/corrupt artifacts mean this
+            # deploy compiles — never that it fails
+            artifacts = registry.load_artifacts(version)
         source = f"{args.model_dir} ({version})"
     else:
         from keystone_tpu.workflow import FittedPipeline
@@ -226,6 +239,7 @@ def _serve_main(argv) -> int:
         restart_window_s=args.restart_window_s,
         hedge_ms=args.hedge_ms,
         bisect=not args.no_bisect,
+        artifacts=artifacts,
     )
     watcher = None
     if args.watch is not None:
@@ -241,6 +255,7 @@ def _serve_main(argv) -> int:
         f"max_wait_ms={args.max_wait_ms}, queue_bound={args.queue_bound}"
         + (f", watching every {args.watch:g}s" if watcher else "")
         + (", tracing off" if args.no_recorder else ", tracing on")
+        + (", artifacts on" if artifacts else "")
         + ")",
         flush=True,
     )
@@ -253,6 +268,130 @@ def _serve_main(argv) -> int:
             watcher.stop()
         front.server.server_close()
         svc.close()
+    return 0
+
+
+def _export_main(argv) -> int:
+    """``export`` subcommand: freeze a saved fitted pipeline and write
+    its AOT artifacts — the whole frozen apply lowered at every padding
+    bucket and serialized with ``jax.export`` — either into a model
+    registry version dir (``--model-dir``: the next ``serve``/watcher
+    deploy of that version loads instead of compiling) or as a
+    standalone bundle directory (``--out``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.cli export",
+        description="freeze a saved model and publish pre-lowered AOT "
+        "apply executables (jax.export) so serve cold start, hot-swap, "
+        "and supervisor heals stop paying compile time",
+    )
+    ap.add_argument(
+        "--model",
+        default=None,
+        help="path to a FittedPipeline saved via save()/fit_or_load(); "
+        "with --model-dir the artifacts are published alongside it as "
+        "a NEW registry version",
+    )
+    ap.add_argument(
+        "--model-dir",
+        default=None,
+        metavar="DIR",
+        help="model registry root: with --model, publish model + "
+        "artifacts as a new version; without, export artifacts for the "
+        "registry's CURRENT version in place",
+    )
+    ap.add_argument(
+        "--example-shape",
+        required=True,
+        metavar="D0[,D1,...]",
+        help="per-datum input shape (e.g. '64' or '3,32,32') the "
+        "bucket programs are lowered for — must match what serve will "
+        "receive",
+    )
+    ap.add_argument(
+        "--dtype",
+        default="float32",
+        help="per-datum input dtype (default float32)",
+    )
+    ap.add_argument(
+        "--max-batch",
+        type=int,
+        default=32,
+        help="serve-side max_batch: buckets default to the same "
+        "powers-of-two-up-to-max-batch the service pads with",
+    )
+    ap.add_argument(
+        "--buckets",
+        default=None,
+        metavar="B0[,B1,...]",
+        help="explicit padding-bucket sizes (overrides --max-batch)",
+    )
+    ap.add_argument(
+        "--out",
+        default=None,
+        metavar="DIR",
+        help="write the bundle to this directory instead of a registry "
+        "(MANIFEST.json + one .hlo blob per bucket, BLAKE2b sidecars)",
+    )
+    args = ap.parse_args(argv)
+    if args.model is None and args.model_dir is None:
+        ap.error("pass --model and/or --model-dir")
+    if args.out is None and args.model_dir is None:
+        ap.error("pass --out or --model-dir (somewhere to write artifacts)")
+
+    import numpy as np
+
+    from keystone_tpu.serve.service import default_buckets
+
+    shape = tuple(int(d) for d in args.example_shape.split(","))
+    example = np.zeros(shape, np.dtype(args.dtype))
+    if args.buckets:
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+    else:
+        buckets = default_buckets(args.max_batch)
+
+    registry = None
+    version = None
+    if args.model is not None:
+        from keystone_tpu.workflow import FittedPipeline
+
+        fitted = FittedPipeline.load(args.model)
+    else:
+        from keystone_tpu.serve import ModelRegistry
+
+        registry = ModelRegistry(args.model_dir)
+        fitted, version = registry.load()
+    frozen = fitted.freeze()
+    bundle = frozen.export_artifacts(example=example, buckets=buckets)
+    n = len(bundle["blobs"])
+    if args.model_dir is not None:
+        from keystone_tpu.serve import ModelRegistry
+
+        registry = registry or ModelRegistry(args.model_dir)
+        if version is None:
+            version = registry.publish(fitted, artifacts=bundle)
+            print(
+                f"published {version} (+{n} AOT bucket programs) to "
+                f"{args.model_dir}"
+            )
+        else:
+            registry.publish_artifacts(version, bundle)
+            print(
+                f"wrote {n} AOT bucket programs for existing version "
+                f"{version} in {args.model_dir}"
+            )
+    if args.out is not None:
+        from keystone_tpu.serve.registry import write_artifact_bundle
+
+        write_artifact_bundle(args.out, bundle, describe="export bundle")
+        print(f"wrote bundle ({n} bucket programs) to {args.out}")
+    man = bundle["manifest"]
+    print(
+        f"buckets={man['buckets']} item_shape={tuple(man['item_shape'])} "
+        f"dtype={man['dtype']} jax={man['jax_version']} "
+        f"platforms={man['platforms']} signature={man['signature']}"
+    )
     return 0
 
 
@@ -362,6 +501,7 @@ def main(argv=None):
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
         print("       python -m keystone_tpu.cli serve --model model.pkl [flags]")
+        print("       python -m keystone_tpu.cli export --model model.pkl --example-shape D0[,D1,...] [flags]")
         print("       python -m keystone_tpu.cli check <PipelineName>|--model model.pkl [flags]")
         print("pipelines:")
         for name in _PIPELINE_MODULES:
@@ -377,6 +517,12 @@ def main(argv=None):
 
         enable_compilation_cache()
         return _serve_main(rest)
+    if name == "export":
+        _apply_platform_env()
+        from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        return _export_main(rest)
     if name not in _PIPELINE_MODULES:
         print(f"unknown pipeline {name!r}; use --list", file=sys.stderr)
         return 2

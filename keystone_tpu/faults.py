@@ -91,6 +91,7 @@ SITES = {
     "serve.replica",
     "serve.swap",
     "serve.worker",
+    "serve.artifact_load",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")

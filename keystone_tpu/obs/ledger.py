@@ -123,6 +123,7 @@ ATTR_VOCABULARY = {
     "sick",
     "site",
     "solver",
+    "source",
     "stats",
     "substitute",
     "tag",

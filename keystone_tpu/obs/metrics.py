@@ -320,6 +320,15 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get(_key(name, labels))
 
+    def histogram_value(self, name: str, **labels) -> Optional[dict]:
+        """One histogram series as its ``as_dict()`` summary, or None —
+        the point read for surfaces that need a couple of series
+        (``/statusz``'s prime-ladder block) without paying a full
+        ``snapshot()`` copy of every histogram per poll."""
+        with self._lock:
+            h = self._hists.get(_key(name, labels))
+            return None if h is None else h.as_dict()
+
     def remove_gauge(self, name: str, **labels) -> None:
         """Drop one gauge series (registry owners evicting dead keys —
         e.g. guard's breaker registry — keep export cardinality bounded

@@ -365,6 +365,9 @@ class Replica:
             "dead": self.is_dead(),
             "quarantined": self.quarantined,
             "restarts": self.restarts,
+            "artifact_buckets": getattr(
+                self.applier, "installed_buckets", lambda: 0
+            )(),
         }
 
 
@@ -387,6 +390,7 @@ class ReplicaPool:
         name: str = "serve",
         dispatch_window: int = 2,
         heartbeat_s: float = DEFAULT_HEARTBEAT_SECONDS,
+        artifacts: Optional[dict] = None,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -403,6 +407,23 @@ class ReplicaPool:
         #: generation.
         self._source = pipeline
         self._staged_source = None
+        #: the AOT artifact bundle for the current generation: every
+        #: replica built from _source (initial build AND the
+        #: supervisor's heal replacements) installs these pre-lowered
+        #: bucket programs instead of re-tracing.  Moves with the
+        #: generation at stage()/commit(), like _source.
+        self._artifacts = artifacts
+        self._staged_artifacts = None
+        self._staged_artifacts_set = False
+        #: deserialized AOT programs shared across replica builds AND
+        #: supervisor heals, keyed (bundle signature, entry, device):
+        #: the pre-lowered executable survives its worker's death, so a
+        #: heal re-installs in microseconds instead of re-deserializing
+        #: — compile time must not become recovery time.  Exported
+        #: programs are immutable pure functions: sharing across
+        #: generations is safe (unlike per-transformer jit caches,
+        #: which is why replicas clone).
+        self._artifact_programs: dict = {}
         self._heartbeat_s = float(heartbeat_s)
         #: sticky hint set when dispatch finds the whole fleet
         #: unavailable, cleared by the next availability recheck or a
@@ -444,7 +465,7 @@ class ReplicaPool:
 
     def _build_one(
         self, source, index: int, device, version, n: int,
-        force_clone: bool = False,
+        force_clone: bool = False, artifacts=_SENTINEL,
     ) -> Replica:
         """One replica for slot ``index``: the direct-wrap fast path for
         a 1-replica deviceless pool, the clone+place path otherwise —
@@ -452,11 +473,17 @@ class ReplicaPool:
         supervisor's in-place restarts (which pass ``force_clone``: the
         replaced worker may still be EXECUTING inside the old applier,
         and two threads must never share transformer instances / jit
-        caches)."""
+        caches).  ``artifacts`` (default: the pool's current bundle):
+        AOT bucket programs installed into the fresh applier — a failed
+        install NEVER fails the build; the replica compiles instead."""
         if device is None and n == 1 and not force_clone:
             applier = _as_applier(source)
         else:
             applier = _as_applier(_clone_and_place(source, device))
+        if artifacts is _SENTINEL:
+            artifacts = self._artifacts
+        if artifacts:
+            self._install_artifacts(applier, device, artifacts, source)
         return Replica(
             index,
             applier,
@@ -465,6 +492,47 @@ class ReplicaPool:
             pool_name=self.name,
             heartbeat_timeout=self._heartbeat_s,
         )
+
+    @staticmethod
+    def _source_signature(source) -> str:
+        """The pipeline hash install verification compares against —
+        computed from the pool's UNPLACED source (and cached on it), so
+        N replicas and every heal share one weight read instead of
+        re-hashing each clone."""
+        from keystone_tpu.utils.hashing import pipeline_fingerprint
+        from keystone_tpu.workflow.pipeline import FrozenApplier
+
+        if isinstance(source, FrozenApplier):
+            return source.fingerprint()
+        return pipeline_fingerprint(source)
+
+    def _install_artifacts(self, applier, device, artifacts, source) -> int:
+        """Install AOT bucket programs into one fresh applier —
+        artifact→compile degradation happens HERE: a corrupt/skewed
+        bundle (or an injected ``serve.artifact_load`` fault) is
+        counted and logged, and the replica serves via the compile
+        ladder."""
+        try:
+            fault_point("serve.artifact_load")
+            n = applier.install_artifacts(
+                artifacts,
+                device=device,
+                signature=self._source_signature(source),
+                program_cache=self._artifact_programs,
+            )
+        except Exception as e:
+            metrics.inc("serve.artifact_fallbacks")
+            logger.warning(
+                "pool %r: artifact install failed (%s: %s); replica "
+                "will compile",
+                self.name,
+                type(e).__name__,
+                e,
+            )
+            return 0
+        if n:
+            metrics.inc("serve.artifact_hits", n)
+        return n
 
     def _build(self, pipeline, n: int, devices, version) -> List[Replica]:
         devs = self._devices_for(n, devices)
@@ -476,6 +544,14 @@ class ReplicaPool:
     @property
     def size(self) -> int:
         return len(self.replicas)
+
+    @property
+    def has_artifacts(self) -> bool:
+        """Was an AOT artifact bundle configured for the live
+        generation?  (Install may still have fallen through per
+        replica — the per-replica ``artifact_buckets`` status and the
+        ``serve.artifact_*`` counters tell that story.)"""
+        return self._artifacts is not None
 
     # ----------------------------------------------------------- router
     def start(self, runner: Callable, obs_context=None) -> None:
@@ -706,21 +782,29 @@ class ReplicaPool:
             replica.breaker.record_failure()
 
     # ------------------------------------------------------------- swap
-    def stage(self, pipeline, version: str) -> List[Replica]:
+    def stage(
+        self, pipeline, version: str, artifacts: Optional[dict] = None
+    ) -> List[Replica]:
         """Build (and start) a full staged generation for ``version`` on
         the same devices as the current one.  Staged replicas accept
         priming applies but receive no routed traffic until
-        :meth:`commit` — the old generation keeps serving."""
+        :meth:`commit` — the old generation keeps serving.
+        ``artifacts``: the new version's AOT bundle — staged appliers
+        install it (so the caller's prime loads instead of compiling),
+        and :meth:`commit` makes it the pool's bundle for later heals."""
         devices = [r.device for r in self.replicas]
         n = len(devices)
         if n == 1 and devices[0] is None:
             # staged single-replica generations still clone: the OLD
             # generation keeps serving the caller's applier while the
             # staged one primes, so they must not share jit caches
+            applier = _as_applier(_clone_and_place(pipeline, None))
+            if artifacts:
+                self._install_artifacts(applier, None, artifacts, pipeline)
             staged = [
                 Replica(
                     0,
-                    _as_applier(_clone_and_place(pipeline, None)),
+                    applier,
                     device=None,
                     version=version,
                     pool_name=self.name,
@@ -729,10 +813,14 @@ class ReplicaPool:
             ]
         else:
             staged = [
-                self._build_one(pipeline, i, dev, version, n)
+                self._build_one(
+                    pipeline, i, dev, version, n, artifacts=artifacts
+                )
                 for i, dev in enumerate(devices)
             ]
         self._staged_source = pipeline
+        self._staged_artifacts = artifacts
+        self._staged_artifacts_set = True
         if self._runner is not None:
             for r in staged:
                 r.start(self._runner, self._obs_ctx)
@@ -754,6 +842,27 @@ class ReplicaPool:
                     # generation: replacements serve what the fleet does
                     self._source = self._staged_source
                     self._staged_source = None
+                if self._staged_artifacts_set:
+                    # the artifact bundle moves with the generation too
+                    # (None is meaningful: the new version may have no
+                    # artifacts, and heals must not install the OLD
+                    # version's programs into new-version replacements)
+                    new_sig = (
+                        (self._staged_artifacts or {})
+                        .get("manifest", {})
+                        .get("signature")
+                    )
+                    # prune the retired version's deserialized programs
+                    # (keyed by bundle signature, so the staged
+                    # generation's entries survive the prune)
+                    self._artifact_programs = {
+                        k: v
+                        for k, v in self._artifact_programs.items()
+                        if k[0] == new_sig
+                    }
+                    self._artifacts = self._staged_artifacts
+                    self._staged_artifacts = None
+                    self._staged_artifacts_set = False
                 # a fresh generation is healthy by construction: clear
                 # the unavailability hint so admission re-opens
                 self._known_unavailable = False
@@ -789,8 +898,10 @@ class ReplicaPool:
         with self._lock:
             n = len(self.replicas)
             source, version = self._source, self.version
+            artifacts = self._artifacts
         fresh = self._build_one(
-            source, old.index, old.device, version, n, force_clone=True
+            source, old.index, old.device, version, n, force_clone=True,
+            artifacts=artifacts,
         )
         fresh.restarts = old.restarts + 1
         if self._runner is not None:

@@ -415,7 +415,12 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             fitted, ver = registry.load(version)
-            info = self.service.swap(fitted, version=ver)
+            # ship the version's AOT artifacts like the watcher does:
+            # an admin swap must not silently drop the pool's artifact
+            # tier (the commit moves the bundle with the generation, so
+            # a None here would also cost every later supervisor heal)
+            arts = registry.load_artifacts(ver)
+            info = self.service.swap(fitted, version=ver, artifacts=arts)
         except RegistryError as e:
             self._send(404, {"error": str(e)})
             return

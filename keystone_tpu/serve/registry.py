@@ -36,6 +36,7 @@ import re
 import threading
 from typing import List, Optional, Tuple
 
+from keystone_tpu.faults import fault_point
 from keystone_tpu.obs import metrics
 from keystone_tpu.utils import durable
 
@@ -43,6 +44,8 @@ logger = logging.getLogger(__name__)
 
 CURRENT = "CURRENT"
 MODEL_FILE = "model.pkl"
+ARTIFACTS_DIR = "artifacts"
+MANIFEST_FILE = "MANIFEST.json"
 
 _VERSION_RE = re.compile(r"^v(\d+)$")
 
@@ -51,6 +54,51 @@ class RegistryError(RuntimeError):
     """A registry operation failed structurally (unknown version,
     empty registry, malformed version id) — as opposed to transient I/O
     (retried) or corruption (:class:`~keystone_tpu.utils.durable.CorruptStateError`)."""
+
+
+def write_artifact_bundle(
+    adir: str, bundle: dict, describe: str = "artifact bundle"
+) -> None:
+    """Write an AOT artifact bundle into ``adir`` in the registry
+    layout: one checksummed blob per entry, ``MANIFEST.json`` LAST (a
+    crash mid-write leaves blobs without a manifest, which
+    ``load_artifacts`` reads as "no artifact tier") — every file via
+    ``durable.atomic_write`` + BLAKE2b sidecar, transient errors
+    retried.  The single writer behind ``ModelRegistry.publish(...,
+    artifacts=)`` and ``keystone export --out``, so the two layouts
+    cannot drift."""
+    import json
+
+    os.makedirs(adir, exist_ok=True)
+    manifest = bundle.get("manifest") or {}
+    blobs = bundle.get("blobs") or {}
+
+    def _blob_writer(data: bytes):
+        def _w(tmp: str) -> None:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+
+        return _w
+
+    for key, ent in (manifest.get("entries") or {}).items():
+        data = blobs.get(key)
+        if data is None:
+            raise RegistryError(f"artifact bundle entry {key!r} has no blob")
+        durable.with_retries(
+            lambda p=os.path.join(adir, ent["file"]), d=data: (
+                durable.atomic_write(p, _blob_writer(d))
+            ),
+            description=f"{describe}/{key}",
+        )
+    mtext = json.dumps(manifest, indent=2, sort_keys=True).encode()
+    durable.with_retries(
+        lambda: durable.atomic_write(
+            os.path.join(adir, MANIFEST_FILE), _blob_writer(mtext)
+        ),
+        description=f"{describe} manifest",
+    )
 
 
 class ModelRegistry:
@@ -66,6 +114,9 @@ class ModelRegistry:
 
     def model_path(self, version: str) -> str:
         return os.path.join(self.version_dir(version), MODEL_FILE)
+
+    def artifacts_dir(self, version: str) -> str:
+        return os.path.join(self.version_dir(version), ARTIFACTS_DIR)
 
     def _current_path(self) -> str:
         return os.path.join(self.root, CURRENT)
@@ -168,11 +219,23 @@ class ModelRegistry:
         return f"v{n:04d}"
 
     def publish(
-        self, fitted, version: Optional[str] = None, set_current: bool = True
+        self,
+        fitted,
+        version: Optional[str] = None,
+        set_current: bool = True,
+        artifacts: Optional[dict] = None,
     ) -> str:
         """Durably publish a fitted pipeline as a new version and
         (default) flip ``CURRENT`` to it.  Model blob lands before the
-        pointer moves, so watchers never race a half-published version."""
+        pointer moves, so watchers never race a half-published version.
+
+        ``artifacts``: an AOT artifact bundle
+        (``FrozenApplier.export_artifacts``) stored under the version
+        dir next to the model — every blob and the manifest ride the
+        same atomic-write + BLAKE2b-sidecar discipline, and they land
+        BEFORE the model blob (which lands before the pointer), so a
+        watcher that sees the new version always finds its artifacts
+        fully published (or absent as a unit, never torn)."""
         version = version or self.next_version()
         if not _VERSION_RE.match(version):
             raise RegistryError(
@@ -181,6 +244,8 @@ class ModelRegistry:
         vdir = self.version_dir(version)
         os.makedirs(vdir, exist_ok=True)
         blob = pickle.dumps(fitted)
+        if artifacts:
+            self._write_artifacts(version, artifacts)
 
         def _write(tmp: str) -> None:
             with open(tmp, "wb") as f:
@@ -197,6 +262,77 @@ class ModelRegistry:
         metrics.inc("serve.registry_published")
         logger.info("published %s to registry %s", version, self.root)
         return version
+
+    def publish_artifacts(self, version: str, bundle: dict) -> None:
+        """Attach an AOT artifact bundle to an ALREADY-published version
+        (``keystone export --model-dir`` retrofits the current version
+        this way).  Same durable-write discipline as :meth:`publish`."""
+        if not os.path.exists(self.model_path(version)):
+            raise RegistryError(
+                f"cannot attach artifacts to unpublished version {version!r}"
+            )
+        self._write_artifacts(version, bundle)
+
+    def _write_artifacts(self, version: str, bundle: dict) -> None:
+        write_artifact_bundle(
+            self.artifacts_dir(version),
+            bundle,
+            describe=f"registry artifact {version}",
+        )
+
+    def load_artifacts(self, version: str) -> Optional[dict]:
+        """The AOT artifact bundle published with ``version``, or None
+        when the version has none (or its manifest is unreadable).
+
+        Corrupt-tolerant, mirroring ``load(None)``'s discipline: a bad
+        manifest drops the whole tier, a bad individual blob drops just
+        that bucket — both counted as ``serve.artifact_fallbacks`` and
+        logged, NEVER raised: a damaged artifact must degrade a deploy
+        to recompilation, not fail it.  The ``serve.artifact_load``
+        fault site fires per file read (chaos plans corrupt/fail
+        exactly this)."""
+        import json
+
+        adir = self.artifacts_dir(version)
+        mpath = os.path.join(adir, MANIFEST_FILE)
+        if not os.path.exists(mpath):
+            return None
+        try:
+            fault_point("serve.artifact_load", path=mpath)
+            durable.verify_checksum(mpath)
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode())
+        except Exception as e:
+            metrics.inc("serve.artifact_fallbacks")
+            logger.warning(
+                "unreadable artifact manifest for %s (%s: %s); version "
+                "will compile",
+                version,
+                type(e).__name__,
+                e,
+            )
+            return None
+        blobs = {}
+        for key, ent in (manifest.get("entries") or {}).items():
+            path = os.path.join(adir, str(ent.get("file", "")))
+            try:
+                fault_point("serve.artifact_load", path=path)
+                durable.verify_checksum(path)
+                with open(path, "rb") as f:
+                    blobs[key] = f.read()
+            except Exception as e:
+                metrics.inc("serve.artifact_fallbacks")
+                logger.warning(
+                    "skipping unreadable artifact %s/%s (%s: %s); that "
+                    "bucket will compile",
+                    version,
+                    key,
+                    type(e).__name__,
+                    e,
+                )
+        if not blobs:
+            return None
+        return {"manifest": manifest, "blobs": blobs}
 
     def set_current(self, version: str) -> None:
         if not os.path.exists(self.model_path(version)):
@@ -304,7 +440,10 @@ class RegistryWatcher:
         if not cur or cur == self.service.version:
             return
         fitted, ver = self.registry.load(cur)
-        info = self.service.swap(fitted, version=ver)
+        # best-effort AOT tier: a version published without artifacts
+        # (or with damaged ones) swaps in via the compile ladder
+        arts = self.registry.load_artifacts(ver)
+        info = self.service.swap(fitted, version=ver, artifacts=arts)
         metrics.inc("serve.watch_swaps")
         logger.info(
             "watcher swapped in %s (pause %.1f ms)",

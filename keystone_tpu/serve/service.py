@@ -393,11 +393,20 @@ class PipelineService:
         restart_window_s: float = 60.0,
         hedge_ms: Optional[float] = None,
         bisect: bool = True,
+        artifacts: Optional[dict] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if queue_bound < 1:
             raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        # the persistent-compile-cache tier of the prime fallback ladder
+        # (artifact → cache → compile): auto-enabled for library callers
+        # too, not just the CLI entry points.  Env-gated
+        # (KEYSTONE_COMPILE_CACHE=0 disables) and never clobbers an
+        # already-configured cache dir.
+        from keystone_tpu.utils.compile_cache import ensure_compilation_cache
+
+        ensure_compilation_cache()
         self._pool = ReplicaPool(
             pipeline,
             replicas=replicas,
@@ -405,6 +414,7 @@ class PipelineService:
             version=version,
             name=name,
             heartbeat_s=heartbeat_s,
+            artifacts=artifacts,
         )
         #: the flight recorder: True (default) = a fresh bounded
         #: recorder, False/None = tracing fully off (request ids stay
@@ -514,22 +524,114 @@ class PipelineService:
         )
 
     # ------------------------------------------------------------ priming
-    def prime(self, replicas=None) -> None:
-        """Compile (or cache-load) the apply programs at every bucket
-        shape on every replica NOW, so no request ever pays a
-        trace+compile against its deadline.  Requires the item shape (an
-        ``example`` at construction, or a first request already served).
+    def prime(self, replicas=None, have_artifacts: Optional[bool] = None) -> None:
+        """Make the apply program at every bucket shape on every replica
+        ready NOW, so no request ever pays a trace+compile against its
+        deadline.  Requires the item shape (an ``example`` at
+        construction, or a first request already served).
         ``replicas``: prime just these (the swap path primes a staged
-        generation; default: the pool's live replicas)."""
+        generation; default: the pool's live replicas).
+
+        Each bucket rides the prime fallback ladder and is metered as
+        ``serve.prime_seconds{source=artifact|cache|compile}``:
+        **artifact** — an installed AOT bucket program (pre-lowered at
+        publish; the first call only runs the backend compile of its
+        serialized module); **cache** — a fresh trace whose executable
+        the persistent XLA compilation cache may serve; **compile** —
+        a fully cold trace+compile.  When a bundle was configured but a
+        bucket has no installed program, that bucket counts as a
+        ``serve.artifact_misses``.  ``have_artifacts``: whether the
+        GENERATION being primed was given a bundle — the swap path
+        passes the staged bundle's presence, because the pool's own
+        flag still describes the LIVE generation mid-swap and would
+        mislabel the staged primes; default None reads the pool (the
+        construction and heal paths, where they agree)."""
         if self._item_shape is None:
             raise ValueError(
                 "prime() needs the request item shape; construct the "
                 "service with example=<one datum> (or serve a request first)"
             )
+        from keystone_tpu.utils.compile_cache import cache_active
+
+        have_bundle = (
+            self._pool.has_artifacts
+            if have_artifacts is None
+            else bool(have_artifacts)
+        )
+        cache_tier = cache_active()
+        t_all = time.monotonic()
+        sources: dict = {}
+        n_replicas = 0
         for replica in self._pool.replicas if replicas is None else replicas:
+            n_replicas += 1
             for bucket in self.buckets:
                 zeros = np.zeros((bucket,) + self._item_shape, self._dtype)
-                self._apply_rows(zeros, deadline=None, replica=replica, prime=True)
+                t0 = time.monotonic()
+                box: list = []
+                self._apply_rows(
+                    zeros,
+                    deadline=None,
+                    replica=replica,
+                    prime=True,
+                    source_box=box,
+                )
+                dt = time.monotonic() - t0
+                if box and box[0] == "artifact":
+                    source = "artifact"
+                else:
+                    if have_bundle:
+                        metrics.inc("serve.artifact_misses")
+                    source = "cache" if cache_tier else "compile"
+                metrics.observe("serve.prime_seconds", dt, source=source)
+                sources[source] = sources.get(source, 0) + 1
+                if source == "artifact" and getattr(
+                    replica.applier, "_degradable", False
+                ):
+                    # degradation-declaring pipelines route deadline-
+                    # carrying live flushes to the executor WALK — warm
+                    # it too, or the first such request pays the
+                    # trace+compile in-band that priming exists to
+                    # prevent (a far-future deadline selects the walk
+                    # without ever firing a watchdog).  Timed and
+                    # labeled as its OWN cache/compile-tier prime:
+                    # charged to the artifact label, the per-source
+                    # ladder timings would show the artifact tier as
+                    # slow as the compile tier on degradable pipelines.
+                    t1 = time.monotonic()
+                    self._apply_rows(
+                        zeros,
+                        deadline=guard.Deadline.after(86400.0),
+                        replica=replica,
+                        prime=True,
+                    )
+                    walk_src = "cache" if cache_tier else "compile"
+                    metrics.observe(
+                        "serve.prime_seconds",
+                        time.monotonic() - t1,
+                        source=walk_src,
+                    )
+                    sources[walk_src] = sources.get(walk_src, 0) + 1
+        took = time.monotonic() - t_all
+        dominant = max(sources, key=sources.get) if sources else "compile"
+        ledger.event(
+            "serve.prime",
+            seconds=round(took, 6),
+            replicas=n_replicas,
+            source=dominant,
+            n=sum(sources.values()),
+        )
+        rec = self.recorder
+        if rec is not None:
+            # a prime is a control-plane moment (cold start, swap
+            # staging, supervisor heal): visible in /tracez between the
+            # request traces it delayed
+            rec.ops(
+                "serve.prime",
+                seconds=round(took, 6),
+                replicas=n_replicas,
+                source=dominant,
+                n=sum(sources.values()),
+            )
 
     def prime_replacement(self, replica) -> None:
         """Prime one not-yet-routed replica's bucket programs — the
@@ -815,6 +917,7 @@ class PipelineService:
         lat = self._lat_win.summary()
         bat = self._batch_win.summary()
         reg = metrics.REGISTRY
+        replica_stats = self.replica_statuses()
         rec = self.recorder
         out = {
             "name": self.name,
@@ -843,9 +946,27 @@ class PipelineService:
                     "serve.hedges",
                     "serve.hedge_wins",
                     "serve.unavailable",
+                    "serve.artifact_hits",
+                    "serve.artifact_misses",
+                    "serve.artifact_fallbacks",
                 )
             },
-            "replicas": self.replica_statuses(),
+            # the AOT tier at a glance: was a bundle configured, how
+            # many bucket programs each live replica holds, and the
+            # prime ladder's per-source timing totals
+            "artifacts": {
+                "configured": self._pool.has_artifacts,
+                "installed_buckets": sum(
+                    r.get("artifact_buckets", 0) for r in replica_stats
+                ),
+                "prime_seconds": {
+                    src: reg.histogram_value(
+                        "serve.prime_seconds", source=src
+                    )
+                    for src in ("artifact", "cache", "compile")
+                },
+            },
+            "replicas": replica_stats,
             "supervisor": (
                 None if self.supervisor is None else self.supervisor.status()
             ),
@@ -881,7 +1002,13 @@ class PipelineService:
         return out
 
     # --------------------------------------------------------------- swap
-    def swap(self, pipeline, version: Optional[str] = None, prime: bool = True) -> dict:
+    def swap(
+        self,
+        pipeline,
+        version: Optional[str] = None,
+        prime: bool = True,
+        artifacts: Optional[dict] = None,
+    ) -> dict:
         """Blue/green model hot-swap: stage a full replica generation
         for ``pipeline``, prime its padding-bucket programs while the
         OLD generation keeps serving, then atomically commit at the
@@ -894,7 +1021,14 @@ class PipelineService:
 
         Concurrent swaps serialize; a failed stage/prime leaves the old
         generation serving untouched (the ``serve.swap`` fault site
-        injects exactly that)."""
+        injects exactly that).
+
+        ``artifacts``: the new version's AOT artifact bundle (registry
+        ``load_artifacts``): staged replicas install the pre-lowered
+        bucket programs so the stage→prime window stops paying
+        trace+lower time, and the bundle becomes the pool's for
+        supervisor heals after the commit.  A damaged/skewed bundle
+        degrades that swap to recompilation — it never fails it."""
         if self._closing:
             raise ServiceClosed(f"service {self.name!r} is closed")
         with self._swap_lock:
@@ -909,10 +1043,13 @@ class PipelineService:
             with ledger.span("serve.swap", version=version):
                 fault_point("serve.swap", version=version)
                 t0 = time.monotonic()
-                staged = self._pool.stage(pipeline, version)
+                staged = self._pool.stage(pipeline, version, artifacts=artifacts)
                 try:
                     if prime and self._item_shape is not None:
-                        self.prime(replicas=staged)
+                        self.prime(
+                            replicas=staged,
+                            have_artifacts=artifacts is not None,
+                        )
                 except BaseException:
                     # failed prime = failed swap: retire the staged
                     # workers instead of leaking them; the old
@@ -1517,12 +1654,27 @@ class PipelineService:
         return self.buckets[-1]
 
     def _apply_rows(
-        self, stacked: np.ndarray, deadline=None, replica=None, prime: bool = False
+        self,
+        stacked: np.ndarray,
+        deadline=None,
+        replica=None,
+        prime: bool = False,
+        source_box: Optional[list] = None,
     ) -> np.ndarray:
         """Pad ``(k, ...)`` rows up to the smallest bucket >= k (the
         ``iter_row_chunks`` pad discipline — zero pad rows, outputs
         sliced back to k), apply the frozen graph on ``replica``
-        (default: the pool's first), return host rows."""
+        (default: the pool's first), return host rows.
+
+        ``source_box``: when given, ``"artifact"`` is appended iff the
+        batch the applier actually sees matches an installed AOT bucket
+        program AND the program survived the call — the authoritative
+        prime-source label.  Checked on the POST-construction dataset
+        (a sharded deviceless path may pad the batch past the bucket
+        shape, in which case the program does not serve), and
+        RE-checked after the apply (a program failing at call time is
+        dropped and the walk serves — labeling that bucket "artifact"
+        would hide exactly the fallback the metric exists to show)."""
         from keystone_tpu.workflow.dataset import Dataset
         from keystone_tpu.workflow.transformer import iter_row_chunks
 
@@ -1539,7 +1691,19 @@ class PipelineService:
             ds = Dataset(jax.device_put(padded, rep.device), n=k, shard=False)
         else:
             ds = Dataset(padded, n=k)
+        has = getattr(rep.applier, "has_bucket_program", None)
+        prog_key = None
+        if (
+            source_box is not None
+            and has is not None
+            and not ds.is_host
+            and ds.mask is None
+            and has(tuple(ds.array.shape), ds.array.dtype)
+        ):
+            prog_key = (tuple(ds.array.shape), ds.array.dtype)
         out = rep.apply(ds, deadline=deadline, prime=prime)
+        if prog_key is not None and has(*prog_key):
+            source_box.append("artifact")
         return np.asarray(out.array)[:k]
 
 
@@ -1567,6 +1731,7 @@ def serve(
     restart_window_s: float = 60.0,
     hedge_ms: Optional[float] = None,
     bisect: bool = True,
+    artifacts: Optional[dict] = None,
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -1625,6 +1790,15 @@ def serve(
       :class:`PoisonRequest`, HTTP 422) while innocent co-batched
       riders complete; the content-keyed quarantine cache then refuses
       repeat offenders at admission.
+    - ``artifacts`` — an AOT artifact bundle
+      (``FrozenApplier.export_artifacts`` / registry
+      ``load_artifacts``): every replica installs the pre-lowered
+      bucket programs so construction-time priming loads instead of
+      re-tracing — the cold-start path stops paying compile time.  Any
+      mismatch (jax version skew, different backend, corrupt blob,
+      signature drift) silently falls one rung down the ladder —
+      artifact → persistent compile cache → fresh compile — counted as
+      ``serve.artifact_fallbacks``, never failing the deploy.
     """
     return PipelineService(
         pipeline,
@@ -1649,4 +1823,5 @@ def serve(
         restart_window_s=restart_window_s,
         hedge_ms=hedge_ms,
         bisect=bisect,
+        artifacts=artifacts,
     )

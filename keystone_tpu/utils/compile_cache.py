@@ -70,3 +70,36 @@ def enable_compilation_cache(
         logger.warning("compilation cache unavailable (%s); continuing without", e)
         return None
     return d
+
+
+def ensure_compilation_cache() -> Optional[str]:
+    """Library-path auto-enable (the serve/``PipelineService`` entry
+    points call this): honor an already-configured cache dir — a user
+    who pointed ``jax.config.jax_compilation_cache_dir`` somewhere must
+    not be clobbered — else apply :func:`enable_compilation_cache` with
+    its ``KEYSTONE_COMPILE_CACHE`` env semantics (path overrides,
+    ``0``/``off`` disables).  Returns the active cache dir or None."""
+    env = os.environ.get("KEYSTONE_COMPILE_CACHE", "").strip()
+    if env.lower() in _DISABLE_VALUES:
+        return None
+    try:
+        import jax
+
+        existing = jax.config.jax_compilation_cache_dir
+    except Exception:
+        existing = None
+    if existing:
+        return existing
+    return enable_compilation_cache()
+
+
+def cache_active() -> bool:
+    """Is a persistent XLA compilation cache configured right now?
+    (The serve prime path labels its timings
+    ``serve.prime_seconds{source=cache}`` vs ``compile`` on this.)"""
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return False

@@ -23,6 +23,81 @@ def array_fingerprint(*arrays) -> str:
     return h.hexdigest()[:16]
 
 
+def _stable_repr(p) -> str:
+    """A process-stable repr of a ``params()`` value: containers recurse
+    per element, and ONLY an element whose default repr carries a
+    process-local address collapses to its type name — collapsing the
+    whole container would also drop its well-behaved siblings, letting
+    two pipelines differing only in those params hash identically (the
+    stale-artifact hazard the signature exists to prevent)."""
+    if isinstance(p, (tuple, list)):
+        inner = ",".join(_stable_repr(x) for x in p)
+        return f"{type(p).__name__}({inner})"
+    if isinstance(p, dict):
+        items = sorted(
+            (_stable_repr(k), _stable_repr(v)) for k, v in p.items()
+        )
+        return "dict(" + ",".join(f"{k}:{v}" for k, v in items) + ")"
+    r = repr(p)
+    return type(p).__name__ if " at 0x" in r else r
+
+
+def pipeline_fingerprint(pipeline) -> str:
+    """Stable content hash of a fitted pipeline: graph structure (topo
+    order of operator/transformer types + CSE params) plus every fitted
+    array's shape/dtype/bytes.
+
+    The AOT artifact tier (``FrozenApplier.export_artifacts``) keys
+    serialized executables by this — an artifact must never be replayed
+    against a pipeline whose weights differ from the one it was lowered
+    from, and process-local identities (``id()``, optimizer output,
+    pickle bytes of hash-randomized sets) are all unstable across the
+    publish/deploy process boundary.  Computed from the PRE-optimizer
+    graph (the pickled deploy payload), never the optimized one: rules
+    like ProfilingAutoCacheRule place nodes by measured timings, so two
+    processes can optimize the same pipeline into different graphs.
+
+    Cached on the instance (``_keystone_fp``), validated by fitted-array
+    identity like :func:`cached_fingerprint` — replacing a fitted array
+    invalidates the cache instead of reporting the stale digest.  The
+    cache attribute survives pickling, so replica clones of a published
+    pipeline reuse the publisher's hash without re-reading every weight.
+    """
+    from keystone_tpu.workflow.executor import block_on_arrays
+
+    g = pipeline.graph
+    struct = hashlib.sha256()
+    arrays: list = []
+    for n in g.topological_nodes():
+        op = g.operators[n]
+        struct.update(type(op).__name__.encode())
+        t = getattr(op, "transformer", None)
+        if t is None:
+            continue
+        struct.update(type(t).__name__.encode())
+        try:
+            p = t.params()
+        except Exception:
+            p = None
+        struct.update(_stable_repr(p).encode())
+        block_on_arrays(t, visit=arrays.append)
+    struct_hex = struct.hexdigest()[:16]
+    cached = getattr(pipeline, "_keystone_fp", None)
+    if (
+        cached is not None
+        and cached[0] == struct_hex
+        and len(cached[1]) == len(arrays)
+        and all(a is b for a, b in zip(cached[1], arrays))
+    ):
+        return cached[2]
+    fp = struct_hex + array_fingerprint(*arrays)
+    try:
+        pipeline._keystone_fp = (struct_hex, tuple(arrays), fp)
+    except AttributeError:
+        pass
+    return fp
+
+
 def cached_fingerprint(obj, attr: str, *arrays) -> str:
     """Compute once per object, cache on the instance.
 

@@ -49,6 +49,7 @@ from keystone_tpu.workflow.optimizer import (  # noqa: F401
     default_optimizer,
 )
 from keystone_tpu.workflow.pipeline import (  # noqa: F401
+    ArtifactMismatch,
     FittedPipeline,
     FrozenApplier,
     Pipeline,

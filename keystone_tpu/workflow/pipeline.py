@@ -26,7 +26,7 @@ import pickle
 from typing import List, Optional, Sequence, Union
 
 from keystone_tpu.workflow import graph as G
-from keystone_tpu.workflow.dataset import Dataset, as_dataset
+from keystone_tpu.workflow.dataset import Dataset, StreamDataset, as_dataset
 from keystone_tpu.workflow.estimator import Estimator, LabelEstimator
 from keystone_tpu.workflow.executor import (
     DatasetExpr,
@@ -538,7 +538,17 @@ class FrozenApplier:
     ``Pipeline.fit(deadline=…)``: stages run under apportioned
     watchdogs, and ``optional``/``with_fallback`` nodes degrade instead
     of failing the batch — graceful degradation applies on the serve
-    path too."""
+    path too.
+
+    **AOT artifacts** — :meth:`export_artifacts` lowers the whole
+    frozen apply at each padding-bucket shape to a serialized
+    ``jax.export`` program (the fitted weights ride along as program
+    constants), and :meth:`install_artifacts` registers the
+    deserialized programs so calls at exactly those shapes skip the
+    optimizer-bind + per-stage trace/lower entirely — the cold-start,
+    hot-swap, and supervisor-heal paths stop paying compile time.
+    With nothing installed the cost is one empty-dict check per call
+    (the pre-artifact path, byte-identical)."""
 
     def __init__(self, pipeline: "Pipeline", validate=None, example=None):
         for op in pipeline.graph.operators.values():
@@ -555,12 +565,108 @@ class FrozenApplier:
         self.graph = opt.execute(pipeline.graph)
         self.source = pipeline.source
         self.sink = pipeline.sink
+        #: the PRE-optimizer pipeline: the artifact signature hashes
+        #: this (the pickled deploy payload) — the optimized graph is
+        #: process-local (profiling-driven rules place by timings)
+        self._frozen_from = pipeline
+        #: installed AOT bucket programs: (shape, dtype str) -> callable.
+        #: Unpicklable jitted callables — stripped by __getstate__.
+        self._bucket_programs: dict = {}
+        self._artifact_meta: dict = {}
+        #: True when any stage declares optional/with_fallback: such
+        #: pipelines keep the executor walk for deadline-carrying calls
+        #: (a monolithic AOT program cannot degrade mid-run)
+        self._degradable = any(
+            getattr(getattr(op, "transformer", None), "optional", False)
+            or getattr(getattr(op, "transformer", None), "fallback", None)
+            is not None
+            for op in self.graph.operators.values()
+        )
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        # jitted callables are unpicklable; a cloned applier re-installs
+        # from the bundle (ReplicaPool keeps it) or recompiles
+        state["_bucket_programs"] = {}
+        state["_artifact_meta"] = {}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # appliers pickled by older code lack the artifact fields
+        self.__dict__.setdefault("_bucket_programs", {})
+        self.__dict__.setdefault("_artifact_meta", {})
+        self.__dict__.setdefault("_frozen_from", None)
+        self.__dict__.setdefault("_degradable", True)
 
     def __call__(self, data, deadline=None) -> Dataset:
         """Apply the frozen graph to one batch (a Dataset or batch-like
         array); returns the result Dataset.  ``deadline``: wall-clock
-        budget for this batch, apportioned per stage by the executor."""
+        budget for this batch, apportioned per stage by the executor.
+
+        When an AOT bucket program is installed for the batch's exact
+        shape/dtype (see :meth:`install_artifacts`), it runs instead of
+        the executor walk — same math, one pre-lowered program.  A
+        deadline-carrying call keeps the deadline contract: on a
+        pipeline that declares degradation it takes the walk (per-stage
+        watchdogs and substitutes need stage boundaries); otherwise the
+        program runs under one whole-batch ``guard.run_with_deadline``
+        watchdog, so an overrun still raises the typed
+        ``DeadlineExceeded`` the walk would have.  A bucket program
+        that fails at run time falls back to the walk for good and is
+        counted (``serve.artifact_fallbacks``)."""
         ds = as_dataset(data)
+        if (
+            self._bucket_programs
+            and not isinstance(ds, StreamDataset)
+            and not ds.is_host
+            and ds.mask is None
+        ):
+            # StreamDatasets are excluded BEFORE touching .array: an
+            # out-of-core stream's .array materializes every batch, and
+            # the walk streams them — shape-keyed programs can never
+            # match a stream anyway
+            if deadline is None or not self._degradable:
+                key = (tuple(ds.array.shape), str(ds.array.dtype))
+                fn = self._bucket_programs.get(key)
+                if fn is not None:
+                    from keystone_tpu.utils import guard
+
+                    try:
+                        if deadline is None:
+                            out = fn(ds.array)
+                        else:
+                            # the walk apportions the budget per stage;
+                            # a monolith gets it whole — an overrun is
+                            # the same typed OSError either way
+                            out = guard.run_with_deadline(
+                                lambda: fn(ds.array),
+                                guard.as_deadline(deadline),
+                                site="serve.artifact",
+                            )
+                        return Dataset(out, n=ds.n, shard=False)
+                    except guard.DeadlineExceeded:
+                        # a genuine timeout, not a broken program: the
+                        # caller's deadline contract fires; keep the
+                        # program for the next flush
+                        raise
+                    except Exception as e:
+                        # one failed program must not fail serving (or
+                        # re-pay a doomed call per flush): drop it and
+                        # walk — the compile tier takes over
+                        self._bucket_programs.pop(key, None)
+                        from keystone_tpu.obs import metrics
+
+                        metrics.inc("serve.artifact_fallbacks")
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "AOT bucket program %s failed (%s: %s); "
+                            "falling back to the executor walk",
+                            key,
+                            type(e).__name__,
+                            e,
+                        )
         g, _ = self.graph.replace_source_with_node(
             self.source, G.DatasetOperator(ds)
         )
@@ -571,6 +677,248 @@ class FrozenApplier:
                 f"frozen apply produced {type(expr).__name__}, expected dataset"
             )
         return expr.dataset
+
+    # ------------------------------------------------------ AOT artifacts
+    ARTIFACT_FORMAT = 1
+
+    def fingerprint(self) -> str:
+        """The pipeline signature hash artifacts are keyed by
+        (``utils.hashing.pipeline_fingerprint`` of the pre-optimizer
+        pipeline — structure + every fitted weight's bytes)."""
+        if self._frozen_from is None:
+            raise RuntimeError(
+                "this FrozenApplier was pickled by an older version and "
+                "lost its source pipeline; re-freeze to use artifacts"
+            )
+        from keystone_tpu.utils.hashing import pipeline_fingerprint
+
+        return pipeline_fingerprint(self._frozen_from)
+
+    def _bucket_callable(self):
+        """The whole frozen apply as ONE traceable function of the
+        padded batch — what gets lowered per bucket.  Host stages,
+        data-dependent Python, and anything else untraceable raise at
+        trace time; callers treat that as \"this pipeline has no
+        artifact tier\" and ride the compile ladder."""
+        graph, source, sink = self.graph, self.source, self.sink
+
+        def run(x):
+            ds = Dataset(x, n=x.shape[0], shard=False)
+            g, _ = graph.replace_source_with_node(
+                source, G.DatasetOperator(ds)
+            )
+            ex = GraphExecutor(g)
+            expr = ex.execute(g.sink_dependencies[sink])
+            if not isinstance(expr, DatasetExpr):
+                raise TypeError(
+                    f"frozen apply produced {type(expr).__name__}, "
+                    "expected dataset"
+                )
+            return expr.dataset.array
+
+        return run
+
+    @staticmethod
+    def _bucket_entry_key(rows: int) -> str:
+        return f"b{int(rows):05d}"
+
+    def export_artifacts(
+        self, example=None, buckets=(8, 16, 32), item_shape=None, dtype=None
+    ) -> dict:
+        """Lower the frozen apply at every padding-bucket shape and
+        serialize the programs with ``jax.export``; returns the artifact
+        bundle ``{"manifest": {...}, "blobs": {entry: bytes}}`` the
+        registry stores next to ``model.pkl``.
+
+        Keyed by bucket shape/dtype, jax version, backend platform, and
+        the pipeline's signature hash (:meth:`fingerprint`) — any skew
+        at install time falls through to the compile ladder instead of
+        replaying a stale program.  Fitted weights are embedded as
+        program constants, so blobs scale with model size (they live
+        next to the model blob, which carries the same bytes).
+
+        ``example``: one datum (array) the per-item shape/dtype are read
+        from; or pass ``item_shape``/``dtype`` explicitly."""
+        import jax
+        from jax import export as jexport
+
+        import numpy as np
+
+        if example is not None:
+            ex = np.asarray(example)
+            item_shape = tuple(ex.shape)
+            dtype = ex.dtype
+        if item_shape is None:
+            raise ValueError(
+                "export_artifacts needs the per-item shape: pass "
+                "example=<one datum> or item_shape="
+            )
+        dtype = np.dtype(dtype if dtype is not None else np.float32)
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or min(buckets) < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        blobs: dict = {}
+        entries: dict = {}
+        platforms: set = set()
+        fn = self._bucket_callable()
+        for b in buckets:
+            shape = (b,) + tuple(item_shape)
+            exported = jexport.export(jax.jit(fn))(
+                jax.ShapeDtypeStruct(shape, dtype)
+            )
+            platforms.update(exported.platforms)
+            key = self._bucket_entry_key(b)
+            blobs[key] = bytes(exported.serialize())
+            entries[key] = {"rows": b, "file": f"{key}.hlo"}
+        manifest = {
+            "format": FrozenApplier.ARTIFACT_FORMAT,
+            "jax_version": jax.__version__,
+            "platforms": sorted(platforms),
+            "signature": self.fingerprint(),
+            "item_shape": list(item_shape),
+            "dtype": str(dtype),
+            "buckets": buckets,
+            "entries": entries,
+        }
+        return {"manifest": manifest, "blobs": blobs}
+
+    def install_artifacts(
+        self,
+        bundle,
+        device=None,
+        signature=None,
+        strict: bool = False,
+        program_cache: Optional[dict] = None,
+    ) -> int:
+        """Deserialize an artifact bundle and register its bucket
+        programs; returns how many were installed.
+
+        The fallback ladder's first rung: ANY mismatch — format drift,
+        jax version skew, wrong backend, signature drift, a corrupt
+        blob — skips the offending artifact (counted as
+        ``serve.artifact_fallbacks``) and leaves the compile tiers to
+        serve, instead of failing the deploy.  ``strict=True`` raises
+        instead (forensics).  ``device``: pin the programs' compilation
+        to one device (the replica-fleet placement discipline);
+        ``signature``: the expected pipeline hash, precomputed by the
+        caller (default: :meth:`fingerprint`, which reads every fitted
+        weight once).  ``program_cache``: a caller-owned dict keyed by
+        (bundle signature, entry, device) of already-deserialized
+        programs — the ReplicaPool shares one across replica builds and
+        supervisor heals, so a replacement replica re-installs in
+        microseconds instead of re-deserializing (compile time must
+        not become recovery time); the programs are immutable pure
+        functions, safe to share across worker generations."""
+        import logging
+
+        import jax
+        from jax import export as jexport
+
+        from keystone_tpu.obs import metrics
+
+        log = logging.getLogger(__name__)
+
+        def reject(why: str) -> int:
+            if strict:
+                raise ArtifactMismatch(why)
+            metrics.inc("serve.artifact_fallbacks")
+            log.warning("AOT artifacts rejected (%s); will compile", why)
+            return 0
+
+        manifest = (bundle or {}).get("manifest") or {}
+        blobs = (bundle or {}).get("blobs") or {}
+        if manifest.get("format") != FrozenApplier.ARTIFACT_FORMAT:
+            return reject(f"unknown artifact format {manifest.get('format')!r}")
+        if manifest.get("jax_version") != jax.__version__:
+            return reject(
+                f"jax version skew (artifact {manifest.get('jax_version')}, "
+                f"running {jax.__version__})"
+            )
+        backend = jax.default_backend()
+        if backend not in (manifest.get("platforms") or ()):
+            return reject(
+                f"backend skew (artifact {manifest.get('platforms')}, "
+                f"running {backend!r})"
+            )
+        want = signature if signature is not None else self.fingerprint()
+        if manifest.get("signature") != want:
+            return reject(
+                "pipeline signature drift (artifact "
+                f"{manifest.get('signature')!r}, pipeline {want!r})"
+            )
+        item_shape = tuple(int(d) for d in manifest.get("item_shape") or ())
+        dtype = str(manifest.get("dtype") or "float32")
+        installed = 0
+        for key, ent in (manifest.get("entries") or {}).items():
+            cache_key = (manifest.get("signature"), key, device)
+            call = (
+                program_cache.get(cache_key)
+                if program_cache is not None
+                else None
+            )
+            if call is None:
+                blob = blobs.get(key)
+                if blob is None:
+                    continue  # load-time skip already counted by the reader
+                try:
+                    exported = jexport.deserialize(bytearray(blob))
+                    call = jax.jit(exported.call)
+                except Exception as e:
+                    if strict:
+                        raise ArtifactMismatch(
+                            f"artifact {key} failed to deserialize: {e}"
+                        )
+                    metrics.inc("serve.artifact_fallbacks")
+                    log.warning(
+                        "AOT artifact %s failed to deserialize (%s: %s); "
+                        "that bucket will compile",
+                        key,
+                        type(e).__name__,
+                        e,
+                    )
+                    continue
+                if device is not None:
+                    call = _pinned_to_device(call, device)
+                if program_cache is not None:
+                    program_cache[cache_key] = call
+            shape = (int(ent["rows"]),) + item_shape
+            self._bucket_programs[(shape, dtype)] = call
+            self._artifact_meta[(shape, dtype)] = {
+                "rows": int(ent["rows"]),
+                "jax_version": manifest["jax_version"],
+            }
+            installed += 1
+        return installed
+
+    def has_bucket_program(self, shape, dtype) -> bool:
+        import numpy as np
+
+        return (tuple(shape), str(np.dtype(dtype))) in self._bucket_programs
+
+    def installed_buckets(self) -> int:
+        """How many AOT bucket programs this applier currently holds."""
+        return len(self._bucket_programs)
+
+
+class ArtifactMismatch(RuntimeError):
+    """An AOT artifact bundle does not match this process/pipeline
+    (format, jax version, backend, or pipeline signature) — raised only
+    under ``install_artifacts(strict=True)``; the serving path counts
+    the mismatch and falls through to the compile ladder instead."""
+
+
+def _pinned_to_device(fn, device):
+    """Wrap an AOT program so its (first-call) compilation and constants
+    land on ``device`` — the replica fleet's one-replica-one-device
+    placement discipline; without this every replica's artifact program
+    would compute on the default device."""
+    import jax
+
+    def call(x):
+        with jax.default_device(device):
+            return fn(x)
+
+    return call
 
 
 class PreflightOOMError(RuntimeError):

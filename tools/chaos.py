@@ -145,7 +145,77 @@ def _stream(tmp, restarts):
         raise RuntimeError(f"stream delivered {total}/512 rows")
 
 
-WORKLOADS = {"bcd": _bcd, "ooc": _ooc, "lbfgs": _lbfgs, "stream": _stream}
+def _serve_artifacts(tmp, restarts):
+    """The AOT artifact ladder under fault: publish a model WITH
+    pre-lowered artifacts, then deploy → predict → hot-swap → heal a
+    crashed worker, all while the plan batters ``serve.artifact_load``
+    (corrupt the blobs, fail the reads, stall them).  The contract
+    being proven: a damaged or missing artifact degrades that
+    deploy/swap/heal to recompilation — it NEVER fails it, and
+    predictions keep flowing."""
+    import numpy as np
+
+    from keystone_tpu.serve import ModelRegistry, serve
+    from tools.serve_bench import build_pipeline
+
+    dim = 16
+    reg = ModelRegistry(os.path.join(tmp, "registry"))
+    example = np.zeros((dim,), np.float32)
+    for seed in (0, 1):
+        pipe = build_pipeline(dim=dim, seed=seed)
+        bundle = pipe.freeze().export_artifacts(example=example, buckets=(4, 8))
+        reg.publish(pipe, artifacts=bundle)
+    fitted, version = reg.load("v0001")
+    arts = reg.load_artifacts(version)
+    svc = serve(
+        fitted,
+        max_batch=8,
+        buckets=(4, 8),
+        example=example,
+        name="chaos_artifacts",
+        replicas=2,
+        supervise=True,
+        supervise_interval_s=0.05,
+        artifacts=arts,
+    )
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(dim,)).astype(np.float32)
+    try:
+        y0 = np.asarray(svc.submit(x).result(timeout=30.0))
+        # hot-swap to v0002, loading its artifacts under the plan
+        fitted2, v2 = reg.load("v0002")
+        svc.swap(fitted2, version=v2, artifacts=reg.load_artifacts(v2))
+        np.asarray(svc.submit(x).result(timeout=30.0))
+        # heal: crash one worker, require the supervisor to rejoin it
+        from keystone_tpu import faults as _faults
+
+        with _faults.inject("serve.worker:ctx.replica=0:raise:times=1"):
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                try:
+                    svc.submit(x).result(timeout=10.0)
+                except Exception:
+                    pass
+                if svc.supervisor.restarts_total >= 1:
+                    break
+                time.sleep(0.01)
+        if svc.supervisor.restarts_total < 1:
+            raise RuntimeError("supervisor never healed the crashed worker")
+        y1 = np.asarray(svc.submit(x).result(timeout=30.0))
+        if not np.all(np.isfinite(y1)):
+            raise RuntimeError("post-heal prediction is non-finite")
+        del y0
+    finally:
+        svc.close()
+
+
+WORKLOADS = {
+    "bcd": _bcd,
+    "ooc": _ooc,
+    "lbfgs": _lbfgs,
+    "stream": _stream,
+    "serve_artifacts": _serve_artifacts,
+}
 
 
 # --------------------------------------------------------------- soak

@@ -200,6 +200,34 @@ def summarize(path: str, top_k: int = 10) -> dict:
                 ):
                     memory[dst] = float(v)
 
+    # ----------------------------------------------------- AOT artifacts
+    # the prime fallback ladder's story: how many bucket primes each
+    # tier served (serve.prime_seconds{source=...}) and the install
+    # counters — a deploy that silently fell through artifact→compile
+    # shows up here as fallbacks>0 with compile-sourced primes
+    def _hist(name: str) -> dict:
+        h = hists.get(name) or {}
+        return {
+            "count": int(h.get("count") or 0),
+            "seconds": float(h.get("sum") or 0.0),
+        }
+
+    artifacts = {
+        "hits": int(_counter_total(snapshot, "serve.artifact_hits")),
+        "misses": int(_counter_total(snapshot, "serve.artifact_misses")),
+        "fallbacks": int(
+            _counter_total(snapshot, "serve.artifact_fallbacks")
+        ),
+        "prime": {
+            src: _hist(f"serve.prime_seconds{{source={src}}}")
+            for src in ("artifact", "cache", "compile")
+        },
+    }
+    if not any(
+        (artifacts["hits"], artifacts["misses"], artifacts["fallbacks"])
+    ) and not any(p["count"] for p in artifacts["prime"].values()):
+        artifacts = None
+
     # ------------------------------------------------------------ faults
     faults: Dict[str, dict] = {}
     injected = _fault_sites(snapshot, "faults.injected")
@@ -238,6 +266,7 @@ def summarize(path: str, top_k: int = 10) -> dict:
         "io": io,
         "memory": memory,
         "dataflow": dataflow,
+        "artifacts": artifacts,
         "faults": faults,
         "fault_restarts": fault_events,
     }
@@ -356,6 +385,20 @@ def render(summary: dict) -> str:
             out.append(
                 f"  host peak RSS: {_fmt_bytes(mem['host_max_rss_bytes'])}"
             )
+
+    arts = summary.get("artifacts")
+    if arts:
+        out.append("\n== AOT artifacts ==")
+        out.append(
+            f"  installed: {arts['hits']}  misses: {arts['misses']}  "
+            f"fallbacks: {arts['fallbacks']}"
+        )
+        for src, p in (arts.get("prime") or {}).items():
+            if p["count"]:
+                out.append(
+                    f"  prime[{src}]: n={p['count']} "
+                    f"total={p['seconds']:.3f}s"
+                )
 
     faults = summary.get("faults") or {}
     if faults:

@@ -70,6 +70,51 @@ def build_pipeline(dim: int = 64, classes: int = 16, seed: int = 0):
     return Pipeline.of(NormalizeRows()) | LinearMapper(w)
 
 
+def build_aot_pipeline(
+    dim: int = 64, classes: int = 16, seed: int = 0, branches: int = 8
+):
+    """The cold-start/restart A/B workload: a ``branches``-way gather of
+    RandomSignNode → PaddedFFT → LinearRectifier chains feeding a
+    normalized linear head — the MnistRandomFFT shape.  The gather is
+    the point: a plain two-stage chain fuses into ONE tiny program
+    whose Python trace costs nothing, so an A/B over it measures only
+    XLA backend time (which both arms pay); a real pipeline is N fused
+    branch programs, each traced+lowered per padding bucket per
+    replica clone — exactly the repeated host-side work the AOT
+    artifact (one whole-graph program per bucket) removes.  Each
+    branch's rectifier carries a DISTINCT constant: identical-structure
+    branches lower to identical HLO that the persistent compile cache
+    dedupes across programs (hiding the trace cost the A/B measures),
+    which real heterogeneous pipelines don't enjoy."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.models.linear import LinearMapper
+    from keystone_tpu.ops.stats import (
+        LinearRectifier,
+        NormalizeRows,
+        PaddedFFT,
+        RandomSignNode,
+    )
+    from keystone_tpu.workflow import Pipeline
+
+    feat = Pipeline.gather(
+        [
+            RandomSignNode.init(dim, seed * 1000 + i)
+            | PaddedFFT()
+            | LinearRectifier(0.0, alpha=0.001 * (i + 1))
+            for i in range(branches)
+        ]
+    )
+    padded = 1 << (dim - 1).bit_length()
+    feat_dim = branches * (padded // 2 + 1) * 2
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(
+        rng.normal(size=(feat_dim, classes)).astype(np.float32)
+    )
+    return feat | NormalizeRows() | LinearMapper(w)
+
+
 def build_service(
     dim: int = 64,
     classes: int = 16,
@@ -504,7 +549,344 @@ def run_straggler_ab(
     return out
 
 
+# ----------------------------------------------------- AOT artifact A/Bs
+def publish_bench_registry(
+    root: str,
+    dim: int = 64,
+    classes: int = 16,
+    max_batch: int = 32,
+    seed: int = 0,
+    builder=None,
+) -> str:
+    """Publish an A/B workload into a fresh registry at ``root`` WITH
+    its AOT artifact bundle; returns the version id.  Both arms of
+    every A/B deploy from this — identical model bytes, the only
+    difference being whether the deploy loads the artifacts.
+    ``builder``: the pipeline factory (default :func:`build_pipeline`;
+    the restart A/B uses :func:`build_aot_pipeline`)."""
+    import numpy as np
+
+    from keystone_tpu.serve import ModelRegistry
+    from keystone_tpu.serve.service import default_buckets
+
+    pipe = (builder or build_pipeline)(dim=dim, classes=classes, seed=seed)
+    bundle = pipe.freeze().export_artifacts(
+        example=np.zeros((dim,), np.float32),
+        buckets=default_buckets(max_batch),
+    )
+    return ModelRegistry(root).publish(pipe, artifacts=bundle)
+
+
+def run_cold_start(
+    arm: str,
+    registry_root: str,
+    dim: int = 64,
+    max_batch: int = 32,
+) -> dict:
+    """ONE cold-start-to-first-prediction sample, in THIS process (the
+    A/B driver runs each sample in a fresh subprocess — in-process the
+    second arm would ride the first's shared jit caches and measure
+    nothing).  ``arm``: ``artifact`` loads the registry's AOT bundle,
+    ``compile`` ignores it (the pre-artifact deploy path).  Reports the
+    registry-load → service-ready (primed) → first-prediction
+    timeline."""
+    import time
+
+    import numpy as np
+
+    from keystone_tpu.obs import metrics
+    from keystone_tpu.serve import ModelRegistry, serve
+
+    reg = ModelRegistry(registry_root)
+    c0 = dict(metrics.snapshot().get("counters") or {})
+    t0 = time.perf_counter()
+    fitted, version = reg.load()
+    t_load = time.perf_counter() - t0
+    arts = reg.load_artifacts(version) if arm == "artifact" else None
+    svc = serve(
+        fitted,
+        max_batch=max_batch,
+        deadline_ms=None,
+        example=np.zeros((dim,), np.float32),
+        name="coldstart",
+        supervise=False,
+        artifacts=arts,
+    )
+    t_ready = time.perf_counter() - t0
+    x = np.random.default_rng(7).normal(size=(dim,)).astype(np.float32)
+    y = np.asarray(svc.submit(x).result())
+    t_first = time.perf_counter() - t0
+    snap = metrics.snapshot()
+    c1 = dict(snap.get("counters") or {})
+    hists = snap.get("histograms") or {}
+    prime = {
+        src: (hists.get(f"serve.prime_seconds{{source={src}}}") or {}).get(
+            "count", 0
+        )
+        for src in ("artifact", "cache", "compile")
+    }
+    svc.close()
+    return {
+        "arm": arm,
+        "model_load_s": round(t_load, 4),
+        "ready_s": round(t_ready, 4),
+        "first_prediction_s": round(t_first, 4),
+        "prime_sources": prime,
+        "artifact_hits": int(
+            c1.get("serve.artifact_hits", 0) - c0.get("serve.artifact_hits", 0)
+        ),
+        "artifact_fallbacks": int(
+            c1.get("serve.artifact_fallbacks", 0)
+            - c0.get("serve.artifact_fallbacks", 0)
+        ),
+        # FULL-output digest: predictions_match is a bit-for-bit claim,
+        # so it must cover every byte, not an eyeball head
+        "prediction_sha": _prediction_sha(y),
+        "prediction_head": [round(float(v), 6) for v in y.ravel()[:4]],
+    }
+
+
+def _prediction_sha(y) -> str:
+    # the repo's one full-array digest (shape+dtype+bytes): the parity
+    # claim must not grow a second hashing implementation to drift from
+    from keystone_tpu.utils.hashing import array_fingerprint
+
+    return array_fingerprint(y)
+
+
+def run_restart(
+    arm: str,
+    registry_root: str,
+    dim: int = 64,
+    max_batch: int = 32,
+    replicas: int = 2,
+    timeout_s: float = 60.0,
+) -> dict:
+    """ONE supervisor restart-to-rejoin sample: serve the registry's
+    model on a 2-replica fleet, crash replica 0's worker via an
+    injected ``serve.worker`` fault under light load, and report how
+    long the supervisor's heal (re-clone + re-prime + adopt) took —
+    the window during which the fleet runs a replica short.  With
+    ``arm="artifact"`` the replacement primes from installed AOT
+    programs; ``compile`` re-traces every bucket."""
+    import time
+
+    import numpy as np
+
+    from keystone_tpu import faults
+    from keystone_tpu.serve import ModelRegistry, serve
+
+    reg = ModelRegistry(registry_root)
+    fitted, version = reg.load()
+    arts = reg.load_artifacts(version) if arm == "artifact" else None
+    svc = serve(
+        fitted,
+        max_batch=max_batch,
+        deadline_ms=None,
+        example=np.zeros((dim,), np.float32),
+        name="restart_bench",
+        replicas=replicas,
+        supervise=True,
+        supervise_interval_s=0.05,
+        heartbeat_s=30.0,
+        artifacts=arts,
+    )
+    rng = np.random.default_rng(11)
+    payload = rng.normal(size=(dim,)).astype(np.float32)
+    try:
+        # warm both replicas with real traffic first
+        for _ in range(4):
+            svc.submit(payload).result()
+        with faults.inject("serve.worker:ctx.replica=0:raise:times=1"):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    svc.submit(payload).result(timeout=10.0)
+                except Exception:
+                    pass  # the crashed flush's riders fail typed; fine
+                if svc.supervisor.restarts_total >= 1:
+                    break
+                time.sleep(0.01)
+        last = svc.supervisor.last_restart
+        # the healed fleet must answer cleanly
+        y = np.asarray(svc.submit(payload).result(timeout=30.0))
+    finally:
+        svc.close()
+    if not last:
+        raise RuntimeError("supervisor never restarted the crashed replica")
+    return {
+        "arm": arm,
+        "restart_to_rejoin_s": last["seconds"],
+        "reason": last["reason"],
+        "restarts": svc.supervisor.restarts_total,
+        "prediction_sha": _prediction_sha(y),
+        "prediction_head": [round(float(v), 6) for v in y.ravel()[:4]],
+    }
+
+
+def _artifact_arm_subprocess(
+    flag: str, arm: str, root: str, dim: int, max_batch: int
+):
+    """Run one A/B arm in a pinned-env subprocess: fresh process (cold
+    jit caches, cold shared-apply cache) and a FRESH empty persistent
+    compile cache per invocation — both arms start equally cold, so
+    the delta is the artifact tier, not leftover warmth.  The workload
+    geometry (dim/max_batch) is forwarded explicitly: the arm must
+    serve exactly what the driver published."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    cache = tempfile.mkdtemp(prefix="keystone-ab-xla-")
+    env["KEYSTONE_COMPILE_CACHE"] = cache
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                flag,
+                arm,
+                "--registry",
+                root,
+                "--dim",
+                str(int(dim)),
+                "--max-batch",
+                str(int(max_batch)),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{flag} {arm} arm failed: {proc.stderr[-400:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
+def _ab_summary(samples: dict, key: str) -> dict:
+    import statistics
+
+    out = {}
+    for arm in ("artifact", "compile"):
+        vals = [s[key] for s in samples[arm] if s.get(key) is not None]
+        out[arm] = round(float(statistics.median(vals)), 4) if vals else None
+    if out.get("artifact") and out.get("compile"):
+        out["speedup"] = round(out["compile"] / out["artifact"], 3)
+    return out
+
+
+def _run_artifact_ab(
+    flag: str,
+    summary_keys,
+    dim: int,
+    max_batch: int,
+    rounds: int,
+    registry_root,
+) -> dict:
+    """The shared A/B harness: publish ONE registry version
+    (+artifacts, the heterogeneous-branch ``build_aot_pipeline``
+    workload), run each arm ``rounds`` times in order-alternated fresh
+    subprocesses, report per-arm medians + speedups, and pin the parity
+    claim — every sample's FULL prediction digest must agree across
+    arms.  Cleans up the registry it created."""
+    import shutil
+    import tempfile
+
+    created = registry_root is None
+    root = registry_root or tempfile.mkdtemp(prefix="keystone-artifact-ab-")
+    try:
+        publish_bench_registry(
+            root, dim=dim, max_batch=max_batch, builder=build_aot_pipeline
+        )
+        samples = {"artifact": [], "compile": []}
+        for rnd in range(max(1, int(rounds))):
+            order = (
+                ("artifact", "compile")
+                if rnd % 2 == 0
+                else ("compile", "artifact")
+            )
+            for arm in order:
+                samples[arm].append(
+                    _artifact_arm_subprocess(flag, arm, root, dim, max_batch)
+                )
+        out = {"rounds": rounds, "dim": dim, "max_batch": max_batch}
+        for key in summary_keys:
+            out[key] = _ab_summary(samples, key)
+        shas = {
+            s.get("prediction_sha")
+            for arm_samples in samples.values()
+            for s in arm_samples
+        }
+        out["predictions_match"] = len(shas) == 1
+        out["samples"] = samples
+        return out
+    finally:
+        if created:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_cold_start_ab(
+    dim: int = 64, max_batch: int = 32, rounds: int = 2, registry_root=None
+) -> dict:
+    """The cold-start A/B: median registry-load → service-ready →
+    first-prediction timeline per arm, plus the artifact speedup and
+    the full-digest parity pin."""
+    out = _run_artifact_ab(
+        "--cold-start-arm",
+        ("first_prediction_s", "ready_s"),
+        dim,
+        max_batch,
+        rounds,
+        registry_root,
+    )
+    out["prime_sources"] = {
+        arm: out["samples"][arm][0]["prime_sources"] for arm in out["samples"]
+    }
+    return out
+
+
+def run_restart_ab(
+    dim: int = 64, max_batch: int = 32, rounds: int = 2, registry_root=None
+) -> dict:
+    """The supervisor heal A/B: same registry, same injected worker
+    crash, restart-to-rejoin latency with artifact-primed replacements
+    vs recompiled ones.  (The multi-branch workload matters: a heal
+    re-builds every per-instance branch program — exactly the trace
+    work this A/B exposes; a fused two-stage chain re-traces nearly
+    nothing.)"""
+    return _run_artifact_ab(
+        "--restart-arm",
+        ("restart_to_rejoin_s",),
+        dim,
+        max_batch,
+        rounds,
+        registry_root,
+    )
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # single-arm entries the A/B driver spawns (fresh process per
+    # sample); also usable by hand for debugging one arm
+    if argv and argv[0] in ("--cold-start-arm", "--restart-arm"):
+        sub = argparse.ArgumentParser(prog=f"serve_bench {argv[0]}")
+        sub.add_argument("arm", choices=("artifact", "compile"))
+        sub.add_argument("--registry", required=True)
+        sub.add_argument("--dim", type=int, default=64)
+        sub.add_argument("--max-batch", type=int, default=32)
+        a = sub.parse_args(argv[1:])
+        fn = run_cold_start if argv[0] == "--cold-start-arm" else run_restart
+        print(
+            json.dumps(
+                fn(a.arm, a.registry, dim=a.dim, max_batch=a.max_batch)
+            )
+        )
+        return 0
     ap = argparse.ArgumentParser(
         description="open-loop load generator for keystone_tpu.serve"
     )
@@ -572,7 +954,33 @@ def main(argv=None) -> int:
         help="enable hedged dispatch with this floor delay (needs "
         "--replicas >= 2); pair with --straggler-ms to see the p99 win",
     )
+    ap.add_argument(
+        "--cold-start",
+        action="store_true",
+        help="run the AOT-artifact A/Bs instead of the load generator: "
+        "cold-start-to-first-prediction and supervisor "
+        "restart-to-rejoin, each artifact-vs-compile in fresh "
+        "subprocesses with fresh compile caches",
+    )
+    ap.add_argument(
+        "--ab-rounds",
+        type=int,
+        default=2,
+        help="samples per arm for --cold-start (order-alternated)",
+    )
     args = ap.parse_args(argv)
+
+    if args.cold_start:
+        report = {
+            "cold_start": run_cold_start_ab(
+                dim=args.dim, max_batch=args.max_batch, rounds=args.ab_rounds
+            ),
+            "restart": run_restart_ab(
+                dim=args.dim, max_batch=args.max_batch, rounds=args.ab_rounds
+            ),
+        }
+        print(json.dumps(report, indent=2))
+        return 0
 
     svc, item_shape = build_service(
         dim=args.dim,
