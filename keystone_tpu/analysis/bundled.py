@@ -142,6 +142,43 @@ def _amazon():
     )
 
 
+def _kernel_timit():
+    from keystone_tpu.loaders.timit import TimitFeaturesDataLoader
+    from keystone_tpu.pipelines.kernel_timit import KernelTimitPipeline
+
+    cfg = KernelTimitPipeline.Config(
+        num_landmarks=64,
+        solver_block_size=64,
+        num_epochs=1,
+        num_classes=8,
+        synthetic_n=256,
+    )
+    train = TimitFeaturesDataLoader.synthetic(
+        cfg.synthetic_n, cfg.num_classes, seed=1
+    )
+    return (
+        KernelTimitPipeline.build(cfg, train.data, train.labels),
+        train.data,
+    )
+
+
+def _kernel_cifar():
+    from keystone_tpu.loaders.cifar import CifarLoader
+    from keystone_tpu.pipelines.kernel_cifar import KernelCifarPipeline
+
+    cfg = KernelCifarPipeline.Config(
+        num_landmarks=48,
+        solver_block_size=48,
+        num_epochs=1,
+        synthetic_n=96,
+    )
+    train = CifarLoader.synthetic(cfg.synthetic_n, seed=1)
+    return (
+        KernelCifarPipeline.build(cfg, train.data, train.labels),
+        train.data,
+    )
+
+
 _BUILDERS = {
     "MnistRandomFFT": _mnist,
     "LinearPixels": _linear_pixels,
@@ -151,6 +188,8 @@ _BUILDERS = {
     "ImageNetSiftLcsFV": _imagenet,
     "VOCSIFTFisher": _voc,
     "AmazonReviewsPipeline": _amazon,
+    "KernelTimitPipeline": _kernel_timit,
+    "KernelCifarPipeline": _kernel_cifar,
 }
 
 BUNDLED = tuple(_BUILDERS)

@@ -195,6 +195,45 @@ def _entry_kernel_ridge():
     return fn, _avals(((8, 4), "f4"), ((8, 2), "f4"), ((), "f4"))
 
 
+def _entry_kernel_ridge_oc():
+    """The out-of-core gram-block sweep: one diag (solve) step chained
+    into one off-diag F update — the two jitted programs the streamed
+    fit dispatches.  Traced with use_pallas=False: the lint runs on CPU
+    and audits the XLA chain; the Pallas path accumulates f32 in VMEM
+    by construction and carries no dot_general to audit."""
+    from keystone_tpu.models.kernel_ridge import (
+        _oc_krr_diag_step,
+        _oc_krr_offdiag_step,
+    )
+
+    def fn(xb, fb, ab, yb, ok_b, lam_n, xi, fi):
+        ab2, fb2, dab, _ = _oc_krr_diag_step(
+            xb, fb, ab, yb, ok_b, lam_n, gamma=0.5, use_pallas=False
+        )
+        fi2, _ = _oc_krr_offdiag_step(
+            fi, xi, xb, dab, ok_b, ok_b, gamma=0.5, use_pallas=False
+        )
+        return ab2, fb2, fi2
+
+    return fn, _avals(
+        ((8, 4), "f4"),
+        ((8, 2), "f4"),
+        ((8, 2), "f4"),
+        ((8, 2), "f4"),
+        ((8,), "f4"),
+        ((), "f4"),
+        ((8, 4), "f4"),
+        ((8, 2), "f4"),
+    )
+
+
+def _entry_nystrom():
+    from keystone_tpu.models.nystrom import _nystrom_whiten
+
+    fn = lambda l, g, r: _nystrom_whiten(l, g, r)  # noqa: E731
+    return fn, _avals(((8, 4), "f4"), ((), "f4"), ((), "f4"))
+
+
 #: (name, builder) — builder returns (traceable fn, input avals).  Every
 #: solver family the repo ships must appear here; the seeded-defect
 #: tests assert the checker catches a planted bf16 leak via check_fn.
@@ -204,6 +243,8 @@ SOLVER_ENTRIES: Sequence[Tuple[str, Callable]] = (
     ("block_ls", _entry_block_ls),
     ("block_weighted_ls", _entry_block_weighted_ls),
     ("kernel_ridge", _entry_kernel_ridge),
+    ("kernel_ridge.oc", _entry_kernel_ridge_oc),
+    ("nystrom", _entry_nystrom),
 )
 
 

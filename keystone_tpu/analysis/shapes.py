@@ -244,6 +244,14 @@ def _apply_transformer_abstract(
             )
             return UNKNOWN
         return UNKNOWN  # in-memory host items: applied per item, shape opaque
+    # kernel-tier mappers get an EXPLICIT case before the generic walk:
+    # the out-of-core mapper's apply streams train blocks from DISK, so
+    # pushing it through eval_shape would do real IO mid-analysis, and
+    # a misshaped kernel state (α rows vs train rows) only explodes
+    # mid-sweep at runtime — both must fail pre-flight instead
+    kernel_out = _kernel_case(t, val, node, findings)
+    if kernel_out is not None:
+        return kernel_out
     # device transformer over a device batch: the real eval_shape walk
     assert isinstance(val, ArrayVal)
     try:
@@ -311,6 +319,155 @@ def _apply_transformer_abstract(
             )
         )
     return result
+
+
+def check_kernel_generator(kg, findings: List[Finding], node, label) -> bool:
+    """Validate a Gaussian-kernel generator's γ: non-finite or
+    non-positive γ makes the whole kernel degenerate (exp(0)=1
+    everywhere) and the sweep converges to garbage SILENTLY.  Returns
+    True when a finding was emitted."""
+    import math
+
+    gamma = getattr(kg, "gamma", None)
+    if gamma is None:
+        return False
+    try:
+        g = float(gamma)
+    except (TypeError, ValueError):
+        g = float("nan")
+    if not math.isfinite(g) or g <= 0.0:
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "bad-kernel-generator",
+                f"{label} carries a GaussianKernelGenerator with "
+                f"gamma={gamma!r}; γ must be a finite positive scalar "
+                "or every kernel value degenerates to exp(0)=1",
+                node=None if node is None else node.id,
+                label=label,
+            )
+        )
+        return True
+    return False
+
+
+def _kernel_case(
+    t, val: _Abstract, node, findings: List[Finding]
+) -> Optional[_Abstract]:
+    """Explicit shapes case for the kernel tier's fitted mappers
+    (KernelBlockLinearMapper / OutOfCoreKernelBlockLinearMapper /
+    NystromFeatureMap): returns None when ``t`` is none of them (the
+    generic eval_shape walk proceeds), else an abstract output after
+    checking the kernel-specific invariants the generic walk cannot —
+    fitted-state consistency, the disk-backed store's feature dim, and
+    generator validity."""
+    import jax
+    import numpy as np
+
+    try:
+        from keystone_tpu.models.kernel_ridge import (
+            KernelBlockLinearMapper,
+            OutOfCoreKernelBlockLinearMapper,
+        )
+        from keystone_tpu.models.nystrom import NystromFeatureMap
+    except Exception:  # pragma: no cover - models always importable here
+        return None
+
+    if not isinstance(
+        t,
+        (
+            KernelBlockLinearMapper,
+            OutOfCoreKernelBlockLinearMapper,
+            NystromFeatureMap,
+        ),
+    ):
+        return None
+    assert isinstance(val, ArrayVal)
+    label = t.label
+    bad = check_kernel_generator(t.kernel_gen, findings, node, label)
+    d_in = int(val.aval.shape[-1]) if len(val.aval.shape) else None
+
+    def _mismatch(train_d, what):
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "kernel-shape-mismatch",
+                f"{label} computes kernels against {what} with "
+                f"{train_d} features but its input carries {d_in}",
+                node=node.id,
+                label=label,
+            )
+        )
+
+    if isinstance(t, NystromFeatureMap):
+        m, train_d = (int(s) for s in t.landmarks.shape)
+        if d_in is not None and d_in != train_d:
+            _mismatch(train_d, "landmarks")
+            return UNKNOWN
+        if tuple(int(s) for s in t.whiten.shape) != (m, m):
+            findings.append(
+                Finding(
+                    "error",
+                    PASS_SHAPES,
+                    "kernel-bad-state",
+                    f"{label} whitening is {tuple(t.whiten.shape)} for "
+                    f"{m} landmarks; the fitted state is inconsistent",
+                    node=node.id,
+                    label=label,
+                )
+            )
+            return UNKNOWN
+        if bad:
+            return UNKNOWN
+        return ArrayVal(
+            jax.ShapeDtypeStruct(val.aval.shape[:-1] + (m,), np.float32)
+        )
+
+    if isinstance(t, KernelBlockLinearMapper):
+        rows, train_d = (int(s) for s in t.train_x.shape)
+        alpha_rows, k = (int(s) for s in t.alpha.shape)
+    else:  # out-of-core: read the store's META only — never its blocks
+        try:
+            st = t._store()
+            rows, train_d = st.num_blocks * st.block_size, st.d
+        except Exception as e:
+            findings.append(
+                Finding(
+                    "error",
+                    PASS_SHAPES,
+                    "kernel-bad-state",
+                    f"{label} cannot open its backing row-block store "
+                    f"({t.store_directory}): {e} — the store is part of "
+                    "the fitted model and must outlive it",
+                    node=node.id,
+                    label=label,
+                )
+            )
+            return UNKNOWN
+        alpha_rows, k = (int(s) for s in t.alpha.shape)
+    if alpha_rows != rows:
+        findings.append(
+            Finding(
+                "error",
+                PASS_SHAPES,
+                "kernel-bad-state",
+                f"{label} holds α with {alpha_rows} rows against "
+                f"{rows} train rows; the fitted state is inconsistent",
+                node=node.id,
+                label=label,
+            )
+        )
+        return UNKNOWN
+    if d_in is not None and d_in != train_d:
+        _mismatch(train_d, "the train rows")
+        return UNKNOWN
+    if bad:
+        return UNKNOWN
+    return ArrayVal(
+        jax.ShapeDtypeStruct(val.aval.shape[:-1] + (k,), np.float32)
+    )
 
 
 def _gather_abstract(vals, node, findings: List[Finding]) -> _Abstract:
@@ -427,6 +584,12 @@ def run(
                     op.transformer, dvals[0], n, findings
                 )
         elif isinstance(op, G.EstimatorOperator):
+            # kernel estimators (KernelRidgeRegression, NystromFeatures)
+            # carry their generator pre-fit: a degenerate γ must fail
+            # HERE, not after an epoch of wasted sweeps
+            kg = getattr(op.estimator, "kernel_gen", None)
+            if kg is not None:
+                check_kernel_generator(kg, findings, n, op.label())
             if mode == "apply":
                 findings.append(
                     Finding(

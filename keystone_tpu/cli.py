@@ -22,6 +22,8 @@ _PIPELINE_MODULES = {
     "ImageNetSiftLcsFV": "keystone_tpu.pipelines.imagenet_sift_lcs_fv",
     "VOCSIFTFisher": "keystone_tpu.pipelines.voc_sift_fisher",
     "AmazonReviewsPipeline": "keystone_tpu.pipelines.amazon_reviews",
+    "KernelTimitPipeline": "keystone_tpu.pipelines.kernel_timit",
+    "KernelCifarPipeline": "keystone_tpu.pipelines.kernel_cifar",
 }
 
 

@@ -92,6 +92,7 @@ SITES = {
     "serve.swap",
     "serve.worker",
     "serve.artifact_load",
+    "kernel.sweep",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")

@@ -45,6 +45,11 @@ from keystone_tpu.models.kernel_ridge import (  # noqa: F401
     GaussianKernelGenerator,
     KernelBlockLinearMapper,
     KernelRidgeRegressionEstimator,
+    OutOfCoreKernelBlockLinearMapper,
+)
+from keystone_tpu.models.nystrom import (  # noqa: F401
+    NystromFeatureMap,
+    NystromFeatures,
 )
 
 # Reference-named aliases (KeystoneML class names without the Estimator

@@ -52,8 +52,38 @@ class BlockKernelMatrix:
         #: recomputing the gemm
         self.spill_dir = spill_dir
         self.hbm_cols = max(1, int(hbm_cols))
+        #: block-cache accounting (HBM LRU + disk tier): the cached
+        #: KRR sweep's per-epoch telemetry reports hits so an operator
+        #: can SEE whether epochs ≥ 2 actually reread or thrashed
+        self.cache_hits = 0
+        self.cache_misses = 0
         if spill_dir is not None:
             self._init_spill_dir(spill_dir)
+
+    def _compute(self, a, b_rows):
+        """One gram gemm.  Gaussian generators route to the fused
+        Pallas distance-expansion→exp megakernel on capable backends
+        (``ops/gram_pallas``; solver-grade fits stream f32, scoring
+        generators ride the apply precision policy); duck-typed
+        generators — and every CPU/test path — keep the generator's
+        own XLA chain, bit-identically."""
+        from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+
+        kg = self.kernel_gen
+        if isinstance(kg, GaussianKernelGenerator):
+            from keystone_tpu.ops import gram_pallas
+
+            if gram_pallas.gram_pallas_enabled(int(self.x.shape[1])):
+                if getattr(kg, "solver_grade", True):
+                    mxu = "f32"
+                else:
+                    from keystone_tpu.utils import precision
+
+                    mxu = precision.apply_mode()
+                return gram_pallas.gram_block_pallas(
+                    a, b_rows, float(kg.gamma), mxu=mxu
+                )
+        return kg(a, b_rows)
 
     def _init_spill_dir(self, spill_dir: str) -> None:
         """Create/validate the disk tier.  Spilled columns are only
@@ -156,8 +186,13 @@ class BlockKernelMatrix:
             owned = [
                 e
                 for e in entries
+                # kcol_*.npy plus everything the durable spill path
+                # derives from it: the BLAKE2b sidecar (.npy.b2) and
+                # abandoned atomic-write temps (.npy.tmp.<pid>.<tid>,
+                # .npy.b2.tmp.*) left by a crashed writer — a surviving
+                # tmp must not make a reusable cache dir look foreign
                 if e == "kcache_meta.json"
-                or (e.startswith("kcol_") and e.endswith(".npy"))
+                or (e.startswith("kcol_") and ".npy" in e)
             ]
             # dotfiles (.nfsXXXX silly-renames, .DS_Store) are OS
             # artifacts, not user data: left alone, never grounds for
@@ -190,8 +225,10 @@ class BlockKernelMatrix:
         key = (i, j)
         if key in self._cache:
             self._cache.move_to_end(key)
+            self.cache_hits += 1
             return self._cache[key]
-        blk = self.kernel_gen(self._rows(i), self._rows(j))
+        self.cache_misses += 1
+        blk = self._compute(self._rows(i), self._rows(j))
         self._cache[key] = blk
         if len(self._cache) > self._cache_blocks:
             self._cache.popitem(last=False)
@@ -211,38 +248,87 @@ class BlockKernelMatrix:
         if self.num_blocks * self.num_blocks <= self._cache_blocks:
             blk = self._col_cache.get(j)
             if blk is None:
-                blk = self.kernel_gen(self.x, self._rows(j))
+                self.cache_misses += 1
+                blk = self._compute(self.x, self._rows(j))
                 self._col_cache[j] = blk
                 if len(self._col_cache) > self.num_blocks:
                     self._col_cache.popitem(last=False)
             else:
+                self.cache_hits += 1
                 self._col_cache.move_to_end(j)
             return blk
         if self.spill_dir is not None:
             return self._column_via_disk(j)
-        return self.kernel_gen(self.x, self._rows(j))
+        return self._compute(self.x, self._rows(j))
 
     def _column_via_disk(self, j: int) -> jnp.ndarray:
-        """HBM-LRU → disk → compute-and-persist, in that order."""
+        """HBM-LRU → disk → compute-and-persist, in that order.
+
+        The disk tier rides ``utils/durable`` end to end: spilled
+        columns publish atomically (per-pid/thread tmp + fsync +
+        rename) with a BLAKE2b sidecar, reads retry transient errors
+        with backoff, and a torn or bit-flipped spill block — which the
+        raw ``np.load`` path silently trusted — is detected
+        (checksum/shape mismatch), counted as
+        ``kernel.spill_corruption``, quarantined off disk, and
+        REGENERATED from the gemm instead of poisoning every later
+        epoch of the sweep."""
         import os
 
         import numpy as np
 
+        from keystone_tpu.obs import metrics
+        from keystone_tpu.utils import durable
+
         blk = self._col_cache.get(j)
         if blk is not None:
+            self.cache_hits += 1
             self._col_cache.move_to_end(j)
             return blk
+        self.cache_misses += 1
         path = os.path.join(self.spill_dir, f"kcol_{j:05d}.npy")
+        expected = (self.n, self._rows(j).shape[0])
+        blk = None
         if os.path.exists(path):
-            blk = jnp.asarray(np.load(path))
-        else:
-            blk = self.kernel_gen(self.x, self._rows(j))
-            # per-writer temp name: concurrent processes sharing a cache
-            # dir must never interleave into one file (.npy suffix so
-            # np.save won't append another)
-            tmp = f"{path}.tmp.{os.getpid()}.npy"
-            np.save(tmp, np.asarray(blk))
-            os.replace(tmp, path)
+
+            def _read():
+                # sidecar verification (spills written by this version
+                # always have one; legacy sidecar-less files pass the
+                # shape check only)
+                durable.verify_checksum(path)
+                raw = np.load(path)
+                if raw.shape != expected:
+                    raise durable.CorruptStateError(
+                        f"kernel spill column {path} has shape "
+                        f"{raw.shape}, expected {expected}"
+                    )
+                return raw
+
+            try:
+                raw = durable.with_retries(
+                    _read, description=f"kernel spill read {path}"
+                )
+                metrics.inc("kernel.spill_reads")
+                metrics.inc("kernel.spill_read_bytes", int(raw.nbytes))
+                blk = jnp.asarray(raw)
+            except durable.CorruptStateError:
+                metrics.inc("kernel.spill_corruption")
+                for p in (path, durable.checksum_path(path)):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass  # regeneration below rewrites both anyway
+        if blk is None:
+            blk = self._compute(self.x, self._rows(j))
+            host = np.asarray(blk)
+
+            def _write(tmp):
+                with open(tmp, "wb") as f:
+                    np.save(f, host)
+
+            durable.atomic_write(path, _write)
+            metrics.inc("kernel.spill_writes")
+            metrics.inc("kernel.spill_write_bytes", int(host.nbytes))
         self._col_cache[j] = blk
         if len(self._col_cache) > self.hbm_cols:
             self._col_cache.popitem(last=False)  # evictee stays on disk
@@ -278,7 +364,7 @@ class BlockKernelMatrix:
             kcol = (
                 self.column_block(j)
                 if cached
-                else self.kernel_gen(self.x, self._rows(j))
+                else self._compute(self.x, self._rows(j))
             )
             out = out + kcol @ vj
         return out

@@ -78,6 +78,7 @@ ATTR_VOCABULARY = {
     "bucket",
     "budget_bytes",
     "budget_seconds",
+    "cache_hits",
     "checkpoint_save_seconds",
     "chunk_seconds",
     "degraded",

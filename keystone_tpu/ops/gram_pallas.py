@@ -1,0 +1,175 @@
+"""Pallas TPU kernel for Gaussian gram blocks.
+
+The kernel tier's hot contraction is the gram block K(X_i, Z_j) =
+exp(−γ‖x−z‖²): the XLA chain (models/kernel_ridge.py §
+GaussianKernelGenerator) lowers the ‖x−z‖² gemm expansion into a matmul
+plus THREE full-size (tile_n × tile_m) HBM round trips — the squared
+distance, its clamp, and the exp each materialize between fusions when
+the block exceeds the fusion budget.  For out-of-core KRR that tensor
+is produced nb² times per epoch, so the op is HBM-bandwidth bound on
+exactly the sweep the solver spends its life in.
+
+This kernel fuses the whole chain in VMEM per output tile:
+
+    per (row tile i, col tile j):
+      cross = x_i · z_jᵀ                      (one MXU matmul, f32 acc)
+      sq    = max(‖x‖² − 2·cross + ‖z‖², 0)   (VPU, never leaves VMEM)
+      out   = exp(−γ·sq)                      (VPU → one HBM write)
+
+HBM traffic collapses to one read of each operand tile and one write of
+the kernel block.  Under ``mxu='bf16'`` / ``'bf16_apply'`` the operand
+tiles stream from HBM at half width (a bandwidth lever — the row norms
+and all VMEM compute stay f32).  The SOLVER path always streams f32
+(``mxu='f32'``): kernel values feed block Cholesky solves, and the
+precision contract (analysis/precision.py) keeps solver math
+solver-grade under every ``KEYSTONE_MATMUL`` mode.
+
+``gram_block`` is the dispatcher: Pallas on TPU backends
+(``pallas_supported()``, ``KEYSTONE_GRAM_PALLAS=0`` escape hatch), and
+a bit-identical XLA chain everywhere else — ``_gram_block_xla`` emits
+exactly the ``GaussianKernelGenerator`` graph, pinned by test.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from keystone_tpu.ops.fisher_pallas import _compiler_params, pallas_supported
+
+
+def _precision():
+    from keystone_tpu.utils import precision
+
+    return precision
+
+
+#: VMEM bytes budgeted per program: two (tile, d) operand tiles plus ~3
+#: (tile_n, tile_m) f32 intermediates (cross, sq, out) live at once.
+_VMEM_BUDGET = 12 << 20
+
+#: features per row above which the untiled-d operand tiles cannot fit
+#: VMEM even at the 128-row floor — the dispatcher falls back to the
+#: XLA chain rather than asking Mosaic for the impossible.
+GRAM_MAX_D = 8192
+
+
+def _gram_tile(n: int, d: int) -> int:
+    """Rows per operand tile under the VMEM budget.  Single-tile inputs
+    round to a sublane multiple (8); tiled inputs use a 128-multiple so
+    the lane-dim layouts stay native."""
+    cap = 512
+    while cap > 128 and 4 * (2 * cap * d + 3 * cap * cap) > _VMEM_BUDGET:
+        cap //= 2
+    if n <= cap:
+        return -(-n // 8) * 8
+    return cap
+
+
+def _gram_kernel(x_ref, z_ref, out_ref, *, gamma: float):
+    # operands may arrive bf16 (halved HBM read traffic — the kernel is
+    # bandwidth bound); norms and all compute stay f32 in VMEM
+    x = x_ref[:].astype(jnp.float32)  # (TN, d)
+    z = z_ref[:].astype(jnp.float32)  # (TM, d)
+    xn = jnp.sum(x * x, axis=1, keepdims=True)  # (TN, 1)
+    zn = jnp.sum(z * z, axis=1)[None, :]  # (1, TM)
+    # contract d without materializing zᵀ (dot_general, f32 accumulation)
+    cross = jax.lax.dot_general(
+        x, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    sq = jnp.maximum(xn - 2.0 * cross + zn, 0.0)
+    out_ref[:] = jnp.exp(-gamma * sq)
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "interpret", "mxu"))
+def gram_block_pallas(
+    x, z, gamma: float, interpret: bool = False, mxu: str = "f32"
+):
+    """K(x, z) = exp(−γ‖x−z‖²) as one fused Pallas kernel.
+
+    ``x``: (n, d); ``z``: (m, d) → (n, m) f32.  ``gamma`` is static
+    (one fit = one γ = one compile).  Matches ``_gram_block_xla`` /
+    ``GaussianKernelGenerator`` to f32 rounding; padding tiles compute
+    garbage that is sliced away before return."""
+    n, d = x.shape
+    m = z.shape[0]
+    tn = _gram_tile(n, d)
+    tm = _gram_tile(m, d)
+    n_tiles = -(-n // tn)
+    m_tiles = -(-m // tm)
+    if n_tiles * tn != n:
+        x = jnp.pad(x, ((0, n_tiles * tn - n), (0, 0)))
+    if m_tiles * tm != m:
+        z = jnp.pad(z, ((0, m_tiles * tm - m), (0, 0)))
+
+    fdt = _precision().fdtype(mxu)
+    out = pl.pallas_call(
+        functools.partial(_gram_kernel, gamma=float(gamma)),
+        grid=(n_tiles, m_tiles),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        in_specs=[
+            pl.BlockSpec((tn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((tn, tm), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * tn, m_tiles * tm), jnp.float32),
+        interpret=interpret,
+    )(x.astype(fdt), z.astype(fdt))
+    return out[:n, :m]
+
+
+def _gram_block_xla(x, z, gamma, solver_grade: bool = True):
+    """The CPU/fallback chain — EXACTLY the ``GaussianKernelGenerator``
+    graph, by construction: it IS the generator (imported lazily; the
+    models module imports this one only inside functions, so there is
+    no cycle).  Routing through the dispatcher off-TPU is bit-identical
+    to calling the generator directly (pinned by test), and a future
+    generator change cannot silently diverge the fallback."""
+    from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+
+    return GaussianKernelGenerator(gamma, solver_grade=solver_grade)(x, z)
+
+
+def gram_pallas_enabled(d: int = None) -> bool:
+    """Should gram blocks route to the Pallas kernel?  True only on a
+    TPU-capable target (``pallas_supported``), with the
+    ``KEYSTONE_GRAM_PALLAS=0`` escape hatch, and only while the untiled
+    feature dim fits the VMEM budget."""
+    if os.environ.get("KEYSTONE_GRAM_PALLAS", "1") == "0":
+        return False
+    if d is not None and d > GRAM_MAX_D:
+        return False
+    return pallas_supported()
+
+
+def gram_block(
+    x,
+    z,
+    gamma,
+    solver_grade: bool = True,
+    mxu: str = "f32",
+    use_pallas=None,
+    interpret: bool = False,
+):
+    """One kernel column/tile block, routed to the fused Pallas kernel
+    on capable backends and to the bit-identical XLA chain elsewhere.
+
+    ``use_pallas=None`` resolves via :func:`gram_pallas_enabled`;
+    callers inside jitted solver steps resolve it ONCE per fit and pass
+    it static.  ``solver_grade`` keeps the XLA chain's contraction on
+    ``sdot`` (true-f32 MXU passes) — the Pallas path is f32-accumulated
+    regardless, and its operand stream width follows ``mxu`` (kept
+    ``'f32'`` by every solver caller)."""
+    if use_pallas is None:
+        use_pallas = gram_pallas_enabled(int(x.shape[-1]))
+    if use_pallas:
+        return gram_block_pallas(
+            x, z, float(gamma), interpret=interpret, mxu=mxu
+        )
+    return _gram_block_xla(x, z, gamma, solver_grade=solver_grade)

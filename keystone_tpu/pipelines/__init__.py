@@ -14,6 +14,8 @@ from keystone_tpu.pipelines.timit import TimitPipeline  # noqa: F401
 from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetSiftLcsFV  # noqa: F401
 from keystone_tpu.pipelines.voc_sift_fisher import VOCSIFTFisher  # noqa: F401
 from keystone_tpu.pipelines.amazon_reviews import AmazonReviewsPipeline  # noqa: F401
+from keystone_tpu.pipelines.kernel_timit import KernelTimitPipeline  # noqa: F401
+from keystone_tpu.pipelines.kernel_cifar import KernelCifarPipeline  # noqa: F401
 
 ALL_PIPELINES = {
     "MnistRandomFFT": MnistRandomFFT,
@@ -24,4 +26,6 @@ ALL_PIPELINES = {
     "ImageNetSiftLcsFV": ImageNetSiftLcsFV,
     "VOCSIFTFisher": VOCSIFTFisher,
     "AmazonReviewsPipeline": AmazonReviewsPipeline,
+    "KernelTimitPipeline": KernelTimitPipeline,
+    "KernelCifarPipeline": KernelCifarPipeline,
 }

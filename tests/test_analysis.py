@@ -214,6 +214,106 @@ def test_unfitted_estimator_is_error_in_apply_mode():
     assert "unfitted-estimator" in {f.code for f in report.errors}
 
 
+def test_kernel_mapper_shape_mismatch_detected():
+    """The kernel-tier shapes case (ISSUE 13): a fitted kernel mapper
+    whose input feature dim disagrees with its train rows fails
+    pre-flight with a kernel-specific finding, not mid-sweep."""
+    from keystone_tpu.models.kernel_ridge import (
+        GaussianKernelGenerator,
+        KernelBlockLinearMapper,
+    )
+
+    kern = GaussianKernelGenerator(0.1)
+    tx = jnp.zeros((64, 8), jnp.float32)
+    m = KernelBlockLinearMapper(kern, tx, jnp.zeros((64, 3)), 16, 64)
+    rep = analyze(Pipeline.of(m), example=np.zeros((4, 8), np.float32))
+    assert not rep.findings, rep.render()
+    rep = analyze(Pipeline.of(m), example=np.zeros((4, 9), np.float32))
+    assert [f.code for f in rep.errors] == ["kernel-shape-mismatch"]
+
+
+def test_kernel_mapper_bad_state_detected():
+    """Misshaped fitted kernel state (α rows vs train rows) is the
+    explode-mid-sweep class the explicit case exists for."""
+    from keystone_tpu.models.kernel_ridge import (
+        GaussianKernelGenerator,
+        KernelBlockLinearMapper,
+    )
+
+    m = KernelBlockLinearMapper(
+        GaussianKernelGenerator(0.1),
+        jnp.zeros((64, 8), jnp.float32),
+        jnp.zeros((48, 3)),  # 48 α rows against 64 train rows
+        16,
+        64,
+    )
+    rep = analyze(Pipeline.of(m), example=np.zeros((4, 8), np.float32))
+    assert [f.code for f in rep.errors] == ["kernel-bad-state"]
+
+
+def test_oc_kernel_mapper_checked_without_reading_blocks(tmp_path):
+    """The out-of-core mapper is validated from its store's METADATA
+    alone (analysis must never stream train blocks off disk), and a
+    missing backing store is a pre-flight error."""
+    from keystone_tpu.models.kernel_ridge import (
+        GaussianKernelGenerator,
+        OutOfCoreKernelBlockLinearMapper,
+    )
+    from keystone_tpu.workflow.blockstore import RowBlockStore
+
+    store = RowBlockStore.from_array(
+        str(tmp_path / "s"), np.zeros((64, 8), np.float32), 16
+    )
+    m = OutOfCoreKernelBlockLinearMapper(
+        GaussianKernelGenerator(0.1), store.directory,
+        jnp.zeros((64, 3)), 64,
+    )
+    from keystone_tpu.obs import metrics
+
+    reads0 = metrics.REGISTRY.counter_value("blockstore.reads") or 0
+    rep = analyze(Pipeline.of(m), example=np.zeros((4, 8), np.float32))
+    assert not rep.findings, rep.render()
+    assert (metrics.REGISTRY.counter_value("blockstore.reads") or 0) == reads0
+    rep = analyze(Pipeline.of(m), example=np.zeros((4, 9), np.float32))
+    assert [f.code for f in rep.errors] == ["kernel-shape-mismatch"]
+
+    gone = OutOfCoreKernelBlockLinearMapper(
+        GaussianKernelGenerator(0.1), str(tmp_path / "missing"),
+        jnp.zeros((64, 3)), 64,
+    )
+    rep = analyze(Pipeline.of(gone), example=np.zeros((4, 8), np.float32))
+    assert [f.code for f in rep.errors] == ["kernel-bad-state"]
+
+
+def test_degenerate_kernel_generator_detected():
+    """γ ≤ 0 / NaN on an UNFITTED kernel estimator fails pre-flight —
+    exp(0)=1 everywhere converges to garbage silently otherwise."""
+    from keystone_tpu.models.kernel_ridge import (
+        GaussianKernelGenerator,
+        KernelRidgeRegressionEstimator,
+    )
+    from keystone_tpu.models.nystrom import NystromFeatures
+
+    for bad_gamma in (0.0, float("nan")):
+        est = KernelRidgeRegressionEstimator(
+            GaussianKernelGenerator(bad_gamma)
+        )
+        pipe = Pipeline.from_estimator(
+            est,
+            Dataset(np.zeros((8, 4), np.float32)),
+            Dataset(np.zeros((8, 2), np.float32)),
+        )
+        rep = analyze(pipe, example=np.zeros((4, 4), np.float32))
+        assert "bad-kernel-generator" in [f.code for f in rep.errors]
+
+    nys = NystromFeatures(GaussianKernelGenerator(-1.0), 8)
+    pipe = Pipeline.from_estimator(
+        nys, Dataset(np.zeros((8, 4), np.float32))
+    )
+    rep = analyze(pipe, example=np.zeros((4, 4), np.float32))
+    assert "bad-kernel-generator" in [f.code for f in rep.errors]
+
+
 # --------------------------------------------------- pass (b): precision
 def test_planted_bf16_solver_is_flagged():
     def bad(a, b):

@@ -80,6 +80,35 @@ def test_solver_flops_matches_hand_count():
     assert bench.solver_flops(n, d, k, bs, e) == want
 
 
+def test_kernel_flops_matches_hand_count():
+    """2·MACs accounting for the blockwise KRR sweep: kernel column
+    gemm + F update + block target + Cholesky, over blocks × epochs."""
+    n, d, k, bs, e = 96, 12, 4, 32, 2
+    nb = 3
+    want = e * nb * (
+        2 * n * bs * d + 2 * n * bs * k + 2 * bs * bs * k + bs**3 / 3
+    )
+    assert bench.kernel_flops(n, d, k, bs, e) == want
+
+
+def test_measure_kernel_at_scale_runs_on_cpu(monkeypatch):
+    """The kernel_at_scale leg (scaled down) on CPU: both sweeps run,
+    the A/B r² gate holds, and the OC dataflow accounts are populated
+    (the acceptance fields)."""
+    monkeypatch.setattr(bench, "KERNEL_N", 160)
+    monkeypatch.setattr(bench, "KERNEL_D", 16)
+    monkeypatch.setattr(bench, "KERNEL_K", 3)
+    monkeypatch.setattr(bench, "KERNEL_BLOCK", 32)
+    monkeypatch.setattr(bench, "KERNEL_EPOCHS", 2)
+    monkeypatch.setattr(bench, "KERNEL_GAMMA", 0.02)
+    out = bench.measure_kernel_at_scale()
+    assert out["kernel_tflops"] > 0 and out["oc_kernel_tflops"] > 0
+    assert out["oc_vs_incore_r2"] >= 0.999
+    assert out["transfer_seconds"] > 0
+    assert out["device_busy_fraction"] is not None
+    assert out["oc_store_bytes"] > 0 and out["oc_over_resident_x"] > 0
+
+
 def test_measure_solver_runs_on_cpu(monkeypatch):
     """The solver-phase leg runs (scaled down) on the CPU mesh and
     reports positive TFLOP/s."""

@@ -1,0 +1,442 @@
+"""Out-of-core kernel solver tier (ISSUE 13): streamed gram-block BCD
+parity with the in-core sweep, donation + tick flow-control pins,
+prefetch plumbing, durable epoch checkpoints (corrupt-newest fallback,
+kernel.sweep chaos), per-epoch telemetry, the Nyström tier's accuracy
+gate, and the row-block store the whole tier rides."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import faults
+from keystone_tpu.models.kernel_ridge import (
+    GaussianKernelGenerator,
+    KernelRidgeRegressionEstimator,
+    OutOfCoreKernelBlockLinearMapper,
+    _oc_krr_diag_step,
+    _oc_krr_fit,
+    _oc_krr_offdiag_step,
+)
+from keystone_tpu.workflow.blockstore import RowBlockStore
+from keystone_tpu.workflow.dataset import Dataset, StreamDataset
+
+
+def _problem(n=150, d=12, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(n, k))).astype(np.float32)
+    return x, y
+
+
+def _est(bs=32, epochs=4, gamma=0.05, lam=1e-4):
+    return KernelRidgeRegressionEstimator(
+        GaussianKernelGenerator(gamma), lam=lam, block_size=bs,
+        num_epochs=epochs,
+    )
+
+
+def _r2(a, b):
+    return 1.0 - ((a - b) ** 2).sum() / ((b - b.mean(axis=0)) ** 2).sum()
+
+
+# ------------------------------------------------ row-block store basics
+
+
+def test_row_block_store_roundtrip(tmp_path):
+    """Streaming batches of uneven sizes across block boundaries land
+    row-exact; the final block zero-pads; reloads read through the same
+    hardened path (sidecars written at finalize)."""
+    x, _ = _problem(n=70, d=5)
+
+    def batches():
+        i = 0
+        for m in (7, 20, 16, 3, 24):
+            yield x[i : i + m]
+            i += m
+
+    st = RowBlockStore.from_batches(str(tmp_path / "s"), batches(), 70, 16)
+    assert (st.num_blocks, st.n, st.d) == (5, 70, 5)
+    rec = np.concatenate([st.read_block(b) for b in range(5)])[:70]
+    np.testing.assert_array_equal(rec, x)
+    assert not st.read_block(4)[6:].any()  # padding rows stay zero
+    assert sorted(f for f in os.listdir(tmp_path / "s") if f.endswith(".b2"))
+    # a torn block file is detected, not trusted
+    from keystone_tpu.utils import durable
+
+    path = st._block_path(st.directory, 2)
+    with open(path, "r+b") as f:
+        f.seek(200)
+        f.write(b"\x11\x22\x33\x44")
+    with pytest.raises(durable.CorruptStateError):
+        RowBlockStore(str(tmp_path / "s")).read_block(2)
+
+
+def test_row_store_rides_shared_device_feed(tmp_path):
+    """RowBlockStore inherits the SAME iter_device_blocks machinery the
+    feature store uses (one implementation, one flow-control contract)."""
+    from keystone_tpu.workflow.blockstore import FeatureBlockStore
+
+    assert (
+        RowBlockStore.iter_device_blocks
+        is FeatureBlockStore.iter_device_blocks
+    )
+    x, _ = _problem(n=64, d=6)
+    st = RowBlockStore.from_array(str(tmp_path / "s"), x, 16)
+    got = dict(st.iter_device_blocks([2, 0]))
+    np.testing.assert_allclose(np.asarray(got[2]), x[32:48])
+
+
+# ------------------------------------------------ in-core vs OC parity
+
+
+def test_oc_kernel_fit_matches_incore(tmp_path):
+    """The streamed gram-block sweep reproduces the in-core jitted
+    sweep: same α (the per-tile gemm expansion is row-exact) and
+    prediction r² ≥ 0.999 — the acceptance gate."""
+    x, y = _problem()
+    est = _est()
+    ref = est.fit_arrays(x, y)
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    oc = est.fit_store(store, Dataset(jnp.asarray(y), n=x.shape[0]))
+    np.testing.assert_allclose(
+        np.asarray(oc.alpha), np.asarray(ref.alpha), atol=1e-5
+    )
+    xt = np.random.default_rng(9).normal(size=(40, x.shape[1])).astype(
+        np.float32
+    )
+    p_ref = np.asarray(ref.apply_batch(jnp.asarray(xt)))
+    p_oc = np.asarray(oc.apply_batch(jnp.asarray(xt)))
+    assert _r2(p_oc, p_ref) >= 0.999
+
+
+def test_oc_kernel_stream_dataset_path(tmp_path):
+    """A StreamDataset routed through fit_dataset spills a row-block
+    store that BACKS the fitted model (not deleted), and the mapper
+    survives a pickle round trip (the store handle re-opens lazily)."""
+    import pickle
+
+    from keystone_tpu.loaders.stream import batched
+
+    x, y = _problem(seed=4)
+    est = _est(epochs=3)
+    sd = StreamDataset(batched(x, 64), n=x.shape[0])
+    oc = est.fit_dataset(sd, Dataset(y))
+    assert isinstance(oc, OutOfCoreKernelBlockLinearMapper)
+    assert os.path.isdir(oc.store_directory)  # the model's backing store
+    ref = est.fit_arrays(x, y)
+    xt = x[:16]
+    p_ref = np.asarray(ref.apply_batch(jnp.asarray(xt)))
+    p_oc = np.asarray(oc.apply_batch(jnp.asarray(xt)))
+    assert _r2(p_oc, p_ref) >= 0.999
+    clone = pickle.loads(pickle.dumps(oc))
+    np.testing.assert_array_equal(
+        np.asarray(clone.apply_batch(jnp.asarray(xt))), p_oc
+    )
+
+
+def test_host_stream_refused():
+    est = _est()
+    sd = StreamDataset([["a", "b"]], n=2, host=True)
+    with pytest.raises(TypeError, match="host-payload"):
+        est.fit_dataset(sd, Dataset(np.zeros((2, 1), np.float32)))
+
+
+# ------------------------------------------------ donation + flow control
+
+
+def test_oc_krr_steps_donate_carries():
+    """The donation pins: the (F, α) carries are CONSUMED by the diag
+    step and the F slice by the off-diag step; the staged row blocks
+    are NOT (the diag block is reread by the whole F pass, streamed
+    blocks free by refcount); the flow-control tick is NOT donated —
+    it must stay waitable after the donated outputs feed later steps."""
+    rng = np.random.default_rng(0)
+    bs, d, k = 16, 8, 2
+    xb = jnp.asarray(rng.normal(size=(bs, d)).astype(np.float32))
+    yb = jnp.asarray(rng.normal(size=(bs, k)).astype(np.float32))
+    fb = jnp.zeros((bs, k), jnp.float32)
+    ab = jnp.zeros((bs, k), jnp.float32)
+    ok = jnp.ones((bs,), jnp.float32)
+    ab2, fb2, dab, tick = _oc_krr_diag_step(
+        xb, fb, ab, yb, ok, jnp.float32(0.1), gamma=0.2
+    )
+    assert fb.is_deleted() and ab.is_deleted()
+    assert not xb.is_deleted() and not yb.is_deleted()
+    assert not tick.is_deleted()
+    jax.block_until_ready(tick)
+
+    xi = jnp.asarray(rng.normal(size=(bs, d)).astype(np.float32))
+    fi = jnp.zeros((bs, k), jnp.float32)
+    fi2, tick2 = _oc_krr_offdiag_step(fi, xi, xb, dab, ok, ok, gamma=0.2)
+    assert fi.is_deleted()
+    assert not xi.is_deleted() and not dab.is_deleted()
+    assert not xb.is_deleted()
+    assert not tick2.is_deleted()
+    jax.block_until_ready(tick2)
+
+
+# ------------------------------------------------ prefetch plumbing
+
+
+def _row_prefetch_spy(monkeypatch):
+    from keystone_tpu.workflow import blockstore as bs_mod
+
+    seen = []
+    orig = bs_mod.RowBlockStore.iter_blocks
+
+    def spy(self, order, prefetch=2):
+        seen.append(prefetch)
+        return orig(self, order, prefetch=prefetch)
+
+    monkeypatch.setattr(bs_mod.RowBlockStore, "iter_blocks", spy)
+    return seen
+
+
+def test_kernel_prefetch_plumbed_explicit(tmp_path, monkeypatch):
+    """fit_store(prefetch=) reaches the sweep's iter_blocks."""
+    seen = _row_prefetch_spy(monkeypatch)
+    x, y = _problem(seed=5)
+    est = _est(epochs=1)
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    est.fit_store(store, Dataset(y, n=x.shape[0]), prefetch=3)
+    assert seen and all(p == 3 for p in seen), seen
+
+
+def test_kernel_prefetch_env_and_bounds(tmp_path, monkeypatch):
+    """The kernel paths ride the SAME [1, 64]-bounded resolution as
+    _oc_bcd_fit: env override honored, garbage and out-of-range depths
+    rejected with the variable named."""
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "4")
+    seen = _row_prefetch_spy(monkeypatch)
+    x, y = _problem(seed=6)
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    _est(epochs=1).fit_store(store, Dataset(y, n=x.shape[0]))
+    assert seen and all(p == 4 for p in seen), seen
+
+    monkeypatch.setenv("KEYSTONE_OC_PREFETCH", "eight")
+    with pytest.raises(ValueError, match="KEYSTONE_OC_PREFETCH"):
+        _est(epochs=1).fit_store(store, Dataset(y, n=x.shape[0]))
+    monkeypatch.delenv("KEYSTONE_OC_PREFETCH")
+    with pytest.raises(ValueError, match="prefetch"):
+        _est(epochs=1).fit_store(
+            store, Dataset(y, n=x.shape[0]), prefetch=100
+        )
+
+
+# ------------------------------------- checkpoints + kernel.sweep chaos
+
+
+def test_kernel_checkpoint_resume_bit_identical(tmp_path):
+    """An injected crash at the kernel.sweep site mid-fit resumes from
+    the last completed epoch and the final α bit-matches the
+    uninterrupted fit; a corrupted NEWEST checkpoint falls back to the
+    rotated last-good one, still bit-identically (the shared durable
+    helper's contract)."""
+    x, y = _problem(seed=7, n=96, d=8, k=2)
+    est = _est(epochs=4)
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    labels = Dataset(jnp.asarray(y), n=x.shape[0])
+    ref = est.fit_store(store, labels, checkpoint_dir=str(tmp_path / "c0"))
+
+    ck = str(tmp_path / "ck")
+    plan = faults.parse_plan("kernel.sweep:raise:after=7:times=1")
+    with pytest.raises(faults.FaultInjected):
+        with faults.inject(plan):
+            est.fit_store(store, labels, checkpoint_dir=ck)
+    # at least two epochs completed before the crash → rotation exists
+    assert os.path.exists(os.path.join(ck, "krr_epoch.npz.1"))
+    res = est.fit_store(store, labels, checkpoint_dir=ck)
+    np.testing.assert_array_equal(np.asarray(res.alpha), np.asarray(ref.alpha))
+
+    # corrupt the newest checkpoint: the resume scan must fall back
+    with open(os.path.join(ck, "krr_epoch.npz"), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff\xff\xff")
+    res2 = est.fit_store(store, labels, checkpoint_dir=ck)
+    np.testing.assert_array_equal(
+        np.asarray(res2.alpha), np.asarray(ref.alpha)
+    )
+
+
+def test_kernel_checkpoint_rejects_different_problem(tmp_path):
+    """The content-based fingerprint: a checkpoint from different data
+    (or λ) must be ignored, not resumed into the wrong problem."""
+    x, y = _problem(seed=8, n=96, d=8, k=2)
+    est = _est(epochs=2)
+    ck = str(tmp_path / "ck")
+    store = RowBlockStore.from_array(str(tmp_path / "s1"), x, 32)
+    est.fit_store(store, Dataset(y, n=x.shape[0]), checkpoint_dir=ck)
+
+    x2 = x + 1.0
+    store2 = RowBlockStore.from_array(str(tmp_path / "s2"), x2, 32)
+    ref2 = est.fit_store(store2, Dataset(y, n=x.shape[0]))
+    got2 = est.fit_store(
+        store2, Dataset(y, n=x.shape[0]), checkpoint_dir=ck
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got2.alpha), np.asarray(ref2.alpha)
+    )
+
+
+def test_oc_sweep_survives_flaky_block_reads(tmp_path):
+    """Chaos over the shared blockstore.read site: one transient read
+    failure inside the gram-block stream is retried by the store's
+    hardened read path — the sweep completes and matches."""
+    x, y = _problem(seed=9)
+    est = _est(epochs=2)
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    ref = est.fit_store(store, Dataset(y, n=x.shape[0]))
+    def _injected():
+        # faults.stats() is process-cumulative — delta, not absolute
+        return faults.stats().get("blockstore.read", {}).get("injected", 0)
+
+    before = _injected()
+    plan = faults.parse_plan("blockstore.read:raise:after=5:times=2")
+    with faults.inject(plan):
+        got = est.fit_store(store, Dataset(y, n=x.shape[0]))
+        injected = _injected() - before
+    assert injected == 2
+    np.testing.assert_array_equal(np.asarray(got.alpha), np.asarray(ref.alpha))
+
+
+# ------------------------------------------------ telemetry
+
+
+def _read_ledger_events(dirpath):
+    runs = [f for f in os.listdir(dirpath) if f.startswith("run_")]
+    events = []
+    for r in runs:
+        with open(os.path.join(dirpath, r), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    return events
+
+
+@pytest.mark.obs
+def test_kernel_solver_telemetry(tmp_path):
+    """Per-epoch solver.epoch events for all three kernel sweeps —
+    in-core (static obs flag + debug.callback), out-of-core (host
+    loop), and the cached-block sweep (with cache_hits) — and the
+    obs-off numerics stay bit-identical to the observed run."""
+    from keystone_tpu.obs import ledger
+
+    x, y = _problem(seed=10, n=96, d=8, k=2)
+    est = _est(epochs=3)
+    m0 = est.fit_arrays(x, y)  # inert
+    store = RowBlockStore.from_array(str(tmp_path / "s"), x, 32)
+    cached = KernelRidgeRegressionEstimator(
+        GaussianKernelGenerator(0.05), lam=1e-4, block_size=32,
+        num_epochs=3, cache_kernel_blocks=True,
+    )
+
+    obs_dir = str(tmp_path / "obs")
+    ledger.start_run(obs_dir)
+    try:
+        m1 = est.fit_arrays(x, y)
+        est.fit_store(store, Dataset(y, n=x.shape[0]))
+        cached.fit_arrays(x, y)
+        jax.effects_barrier()
+    finally:
+        ledger.stop_run()
+
+    # observed vs inert: same bits (the flag only adds callbacks)
+    np.testing.assert_array_equal(np.asarray(m0.alpha), np.asarray(m1.alpha))
+
+    events = [
+        e
+        for e in _read_ledger_events(obs_dir)
+        if e.get("kind") == "event" and e.get("name") == "solver.epoch"
+    ]
+    by_solver = {}
+    for e in events:
+        by_solver.setdefault(e["attrs"]["solver"], []).append(e["attrs"])
+    assert len(by_solver.get("krr", [])) == 3  # in-core scan callbacks
+    oc = by_solver.get("krr.out_of_core", [])
+    assert len(oc) == 3
+    assert all(
+        a.get("epoch_seconds", 0) > 0 and "objective" in a for a in oc
+    )
+    ch = by_solver.get("krr.cached", [])
+    assert len(ch) == 3
+    # epoch 0 computes every column; epochs ≥ 2 reread from the cache
+    assert ch[0]["cache_hits"] == 0 and ch[-1]["cache_hits"] > 0
+    # the objective really converges epoch over epoch
+    assert oc[-1]["objective"] <= oc[0]["objective"]
+
+
+# ------------------------------------------------ Nyström tier
+
+
+def test_nystrom_accuracy_gate_vs_exact_krr():
+    """Nyström features + the existing linear block solver approximate
+    the exact blockwise KRR predictions on a small problem (the
+    accuracy gate), and the landmark draw is identical between the
+    in-core and streamed fit paths on one seed."""
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.models.block_ls import BlockLeastSquaresEstimator
+    from keystone_tpu.models.nystrom import NystromFeatures
+
+    rng = np.random.default_rng(0)
+    n, d, k = 400, 10, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.tanh(x @ w).astype(np.float32)
+    kern = GaussianKernelGenerator(0.08)
+    xt = rng.normal(size=(80, d)).astype(np.float32)
+
+    exact = KernelRidgeRegressionEstimator(
+        kern, lam=1e-4, block_size=100, num_epochs=20
+    ).fit_arrays(x, y)
+    p_exact = np.asarray(exact.apply_batch(jnp.asarray(xt)))
+
+    nys = NystromFeatures(kern, num_landmarks=300, reg=1e-7, seed=0)
+    fmap = nys.fit_arrays(x)
+    lin = BlockLeastSquaresEstimator(
+        block_size=128, num_iter=10, lam=1e-5, fit_intercept=False
+    ).fit_arrays(fmap.apply_batch(jnp.asarray(x)), y)
+    p_nys = np.asarray(
+        lin.apply_batch(fmap.apply_batch(jnp.asarray(xt)))
+    )
+    # the gate: Nyström tracks the exact predictions closely AND its
+    # held-out error stays within 1.5× the exact solver's
+    assert _r2(p_nys, p_exact) >= 0.9
+    yt = np.tanh(xt @ w).astype(np.float32)
+    mse_exact = float(((p_exact - yt) ** 2).mean())
+    mse_nys = float(((p_nys - yt) ** 2).mean())
+    assert mse_nys <= 1.5 * mse_exact, (mse_nys, mse_exact)
+
+    sd = StreamDataset(batched(x, 64), n=n)
+    fmap2 = nys.fit_dataset(sd)
+    np.testing.assert_array_equal(
+        np.asarray(fmap2.landmarks), np.asarray(fmap.landmarks)
+    )
+
+
+def test_nystrom_whitening_reconstructs_kernel():
+    """φ(L)·φ(L)ᵀ ≈ K_LL on the landmarks themselves — the defining
+    Nyström identity the whitening solve must satisfy."""
+    from keystone_tpu.models.nystrom import NystromFeatures
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    kern = GaussianKernelGenerator(0.1)
+    fmap = NystromFeatures(kern, num_landmarks=64, reg=1e-7).fit_arrays(x)
+    phi = np.asarray(fmap.apply_batch(fmap.landmarks))
+    kmm = np.asarray(kern(fmap.landmarks, fmap.landmarks))
+    np.testing.assert_allclose(phi @ phi.T, kmm, atol=5e-3)
+
+
+def test_nystrom_stream_short_delivery_raises():
+    from keystone_tpu.models.nystrom import NystromFeatures
+
+    x = np.zeros((10, 4), np.float32)
+    sd = StreamDataset([x[:5]], n=64)
+    with pytest.raises(ValueError, match="landmarks"):
+        NystromFeatures(GaussianKernelGenerator(0.1), 32).fit_dataset(sd)

@@ -33,6 +33,8 @@ INVENTORY = {
         "BlockWeightedLeastSquares", "DenseLBFGSwithL2", "SparseLBFGSwithL2",
         "LocalLeastSquaresEstimator", "KernelRidgeRegressionEstimator",
         "KernelRidgeRegression", "KernelBlockLinearMapper",
+        "OutOfCoreKernelBlockLinearMapper", "NystromFeatures",
+        "NystromFeatureMap",
         "GaussianKernelGenerator", "PCAEstimator", "DistributedPCAEstimator",
         "PCATransformer", "ZCAWhitenerEstimator", "GaussianMixtureModel",
         "GaussianMixtureModelEstimator", "KMeansPlusPlusEstimator",
@@ -72,6 +74,7 @@ INVENTORY = {
 PIPELINES = [
     "mnist_random_fft", "linear_pixels", "random_patch_cifar", "newsgroups",
     "timit", "imagenet_sift_lcs_fv", "voc_sift_fisher", "amazon_reviews",
+    "kernel_timit", "kernel_cifar",
 ]
 
 

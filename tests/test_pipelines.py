@@ -9,6 +9,8 @@ import pytest
 from keystone_tpu.pipelines import (
     AmazonReviewsPipeline,
     ImageNetSiftLcsFV,
+    KernelCifarPipeline,
+    KernelTimitPipeline,
     LinearPixels,
     MnistRandomFFT,
     NewsgroupsPipeline,
@@ -69,6 +71,45 @@ def test_timit_e2e():
         gamma=0.02,
     )
     result = TimitPipeline.run(cfg)
+    assert result["accuracy"] > 0.5, result
+
+
+def test_kernel_timit_e2e():
+    """The Nyström kernel variant (ISSUE 13) learns the same synthetic
+    TIMIT task the random-feature variant does, and its out-of-core
+    stream path reproduces the in-core metrics exactly (landmark draw
+    and solver route are stream-invariant)."""
+    cfg = KernelTimitPipeline.Config(
+        num_landmarks=96,
+        solver_block_size=96,
+        num_epochs=2,
+        num_classes=8,
+        synthetic_n=512,
+    )
+    result = KernelTimitPipeline.run(cfg)
+    assert result["accuracy"] > 0.5, result
+    streamed = KernelTimitPipeline.run(
+        KernelTimitPipeline.Config(
+            num_landmarks=96,
+            solver_block_size=96,
+            num_epochs=2,
+            num_classes=8,
+            synthetic_n=512,
+            stream=True,
+            stream_batch_size=128,
+        )
+    )
+    assert streamed["accuracy"] == result["accuracy"], (streamed, result)
+
+
+def test_kernel_cifar_e2e():
+    cfg = KernelCifarPipeline.Config(
+        num_landmarks=64,
+        solver_block_size=64,
+        num_epochs=2,
+        synthetic_n=256,
+    )
+    result = KernelCifarPipeline.run(cfg)
     assert result["accuracy"] > 0.5, result
 
 
@@ -161,6 +202,12 @@ def test_cli_list(capsys):
             synthetic_n=24, gmm_k=4, gmm_iters=3, pca_dims=8,
             descriptor_samples_per_image=16, solver_block_size=128,
             image_size=48, model_path=mp)),
+        lambda mp: (KernelTimitPipeline, KernelTimitPipeline.Config(
+            synthetic_n=256, num_classes=8, num_landmarks=64,
+            solver_block_size=64, num_epochs=1, model_path=mp)),
+        lambda mp: (KernelCifarPipeline, KernelCifarPipeline.Config(
+            synthetic_n=96, num_landmarks=48, solver_block_size=48,
+            num_epochs=1, model_path=mp)),
     ],
 )
 def test_model_path_roundtrip_across_apps(app_cfg, tmp_path):

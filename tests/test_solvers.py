@@ -454,7 +454,10 @@ def test_krr_cached_disk_tier_matches_recompute(monkeypatch, tmp_path):
     import os
 
     files = sorted(os.listdir(tmp_path / "kcache"))
-    assert sum(f.startswith("kcol_") for f in files) == 4, files
+    assert sum(f.endswith(".npy") for f in files) == 4, files
+    # the durable spill path publishes a BLAKE2b sidecar per column —
+    # read-time verification is what catches a torn spill block
+    assert sum(f.endswith(".npy.b2") for f in files) == 4, files
     assert "kcache_meta.json" in files
     np.testing.assert_allclose(
         np.asarray(cached.alpha), np.asarray(ref.alpha), atol=2e-4
@@ -501,13 +504,19 @@ def test_kernel_spill_dir_refuses_foreign_files(tmp_path):
     assert (d / "precious.txt").read_text() == "do not delete"
 
     # a dir holding ONLY cache-owned files from a stale fit is cleared
-    # per-file and reused
+    # per-file and reused — including the durable path's derivatives: a
+    # BLAKE2b sidecar and an atomic-write tmp abandoned by a crashed
+    # writer (neither may render a reusable cache dir "foreign")
     d2 = tmp_path / "stale"
     d2.mkdir()
     (d2 / "kcol_00000.npy").write_bytes(b"stale")
+    (d2 / "kcol_00000.npy.b2").write_bytes(b"stale-sidecar")
+    (d2 / "kcol_00001.npy.tmp.1234.5678").write_bytes(b"crashed-writer")
     (d2 / "kcache_meta.json").write_text("{}")
     BlockKernelMatrix(kern, x, block_size=16, spill_dir=str(d2))
     assert not (d2 / "kcol_00000.npy").exists()
+    assert not (d2 / "kcol_00000.npy.b2").exists()
+    assert not (d2 / "kcol_00001.npy.tmp.1234.5678").exists()
     assert (d2 / "kcache_meta.json").exists()
 
     # the fingerprint keys the FULL kernel identity: same gamma attr on

@@ -18,7 +18,10 @@ Usage (CPU-safe; any laptop)::
 Built-in workloads (synthetic, seconds-scale): ``bcd`` (checkpointed
 block coordinate descent), ``ooc`` (out-of-core streamed BCD — spills a
 FeatureBlockStore, exercising blockstore.*), ``lbfgs`` (chunk-
-checkpointed dense L-BFGS), ``stream`` (a resilient StreamDataset sweep).
+checkpointed dense L-BFGS), ``stream`` (a resilient StreamDataset
+sweep), ``kernel`` (checkpointed out-of-core kernel BCD — spills a
+RowBlockStore and sweeps gram blocks, exercising blockstore.* +
+kernel.sweep + ckpt.*).
 
 Latency plans (``delay=SECONDS`` / ``hang`` actions) are first-class:
 pair them with ``--stage-deadline`` / ``--stream-timeout`` (and
@@ -95,6 +98,45 @@ def _ooc(tmp, restarts):
         lambda: est.with_data(
             StreamDataset(batched(x, 64), n=x.shape[0]), Dataset(y)
         ),
+        max_restarts=restarts,
+    )
+
+
+def _kernel(tmp, restarts):
+    """Out-of-core kernel BCD under fault: the row-block spill rides
+    blockstore.read/write, each diag step fires kernel.sweep, and the
+    per-epoch (α, F) checkpoint rides ckpt.save/load — so a plan over
+    any of those proves the sweep resumes from the last completed epoch
+    instead of restarting (or worse, trusting torn state)."""
+    import numpy as np
+
+    from keystone_tpu.loaders.stream import batched
+    from keystone_tpu.models import KernelRidgeRegressionEstimator
+    from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+    from keystone_tpu.workflow import Dataset, StreamDataset, fit_with_recovery
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16)).astype(np.float32)
+    y = rng.normal(size=(128, 2)).astype(np.float32)
+    ckpt = os.path.join(tmp, "krr-ckpt")
+
+    class CheckpointedKRR(KernelRidgeRegressionEstimator):
+        def fit_dataset(self, data, labels=None):
+            return self.fit_stream_dataset(
+                data,
+                labels,
+                spill_dir=os.path.join(tmp, "krr-store"),
+                checkpoint_dir=ckpt,
+            )
+
+    est = CheckpointedKRR(
+        GaussianKernelGenerator(0.05), lam=1e-3, block_size=32, num_epochs=3
+    )
+    fit_with_recovery(
+        lambda: est.with_data(
+            StreamDataset(batched(x, 64), n=x.shape[0]), Dataset(y)
+        ),
+        state_dir=tmp,
         max_restarts=restarts,
     )
 
@@ -212,6 +254,7 @@ def _serve_artifacts(tmp, restarts):
 WORKLOADS = {
     "bcd": _bcd,
     "ooc": _ooc,
+    "kernel": _kernel,
     "lbfgs": _lbfgs,
     "stream": _stream,
     "serve_artifacts": _serve_artifacts,

@@ -133,6 +133,7 @@ SOLVER_SYNC_PREFIXES = (
     "keystone_tpu/models/block_ls.py",
     "keystone_tpu/models/block_weighted_ls.py",
     "keystone_tpu/models/lbfgs.py",
+    "keystone_tpu/models/kernel_ridge.py",
 )
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow-([a-z-]+)")
