@@ -210,6 +210,24 @@ HEDGE_FLOOR_MS = 10.0
 # warmth.  Both arms' first predictions must match bit-for-bit.
 ARTIFACT_LEGS = int(os.environ.get("BENCH_ARTIFACT_LEGS", "1"))
 ARTIFACT_AB_ROUNDS = int(os.environ.get("BENCH_ARTIFACT_ROUNDS", "2"))
+
+# --- multi-tenant leg (ISSUE 14): N co-served pipelines sharing a
+# featurization prefix through the cross-pipeline stage pool, vs the
+# IDENTICAL service with sharing disabled — in-process A/B,
+# order-alternating rounds with a discarded warmup (the
+# run_overhead_pair discipline: the claim is a ratio, so both arms
+# share process warmth).  The artifact tracks the aggregate-QPS
+# speedup (acceptance: ≥ 1.5× with a prefix-dominated workload), the
+# per-tenant p99 fairness ratio under equal offered load (acceptance:
+# ≤ 1.25), pool hit/eviction counts, and a shared-vs-unshared
+# bit-identity pin (sharing is an execution strategy, not a numerics
+# change).
+TENANT_LEGS = int(os.environ.get("BENCH_TENANT_LEGS", "1"))
+TENANT_COUNT = int(os.environ.get("BENCH_TENANT_COUNT", "3"))
+TENANT_QPS = float(os.environ.get("BENCH_TENANT_QPS", "12000"))
+TENANT_ROUNDS = int(os.environ.get("BENCH_TENANT_ROUNDS", "3"))
+TENANT_BRANCHES = int(os.environ.get("BENCH_TENANT_BRANCHES", "12"))
+TENANT_MAX_BATCH = int(os.environ.get("BENCH_TENANT_MAX_BATCH", "64"))
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -878,6 +896,23 @@ def main():
         )
         return
 
+    if "--leg-serve-tenants" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                serve_bench.run_tenants_ab(
+                    qps=TENANT_QPS,
+                    duration=SERVE_DURATION_S,
+                    rounds=TENANT_ROUNDS,
+                    tenants=TENANT_COUNT,
+                    branches=TENANT_BRANCHES,
+                    max_batch=TENANT_MAX_BATCH,
+                )
+            )
+        )
+        return
+
     if "--leg-serve-artifacts" in sys.argv:
         from tools import serve_bench
 
@@ -1100,6 +1135,17 @@ def main():
         else None
     )
 
+    # multi-tenant leg (ISSUE 14): shared-vs-unshared A/B over N
+    # co-served pipelines sharing a featurization prefix
+    tenant_leg = (
+        subprocess_leg(
+            "--leg-serve-tenants",
+            required=("aggregate_qps_shared", "predictions_identical"),
+        )
+        if TENANT_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1267,6 +1313,11 @@ def main():
         # p99_ratio < 1 = hedging rescued the straggler's queue;
         # qps_cost <= 0.05 = the acceptance budget
         out["serve_hedge"] = hedge_leg
+    if tenant_leg:
+        # speedup >= 1.5 = the shared stage pool pays (ISSUE 14
+        # acceptance); fairness_p99_ratio <= 1.25 = DRR fair share;
+        # predictions_identical pins shared-vs-unshared bit-parity
+        out["serve_tenants"] = tenant_leg
     if artifact_leg:
         # speedup > 1 on both legs = the artifact tier beats fresh
         # compilation for cold start AND supervisor heal;

@@ -133,6 +133,63 @@ def _stable_signature(obj, n, label, findings: List[Finding]):
     return s1
 
 
+def collision_signatures(graph: G.Graph) -> set:
+    """The set of transformer/estimator ``signature()`` values that
+    COLLIDE in ``graph``: ≥ 2 distinct instances report the signature
+    while differing in observable state.
+
+    This is the cross-pipeline sharing admission gate
+    (``workflow/cross.py``): the planner unions every co-served tenant
+    graph and refuses to mark any stage whose signature lands in this
+    set — a collision means ``params()`` under-specifies behavior, so a
+    shared-pool entry for one instance would silently answer for the
+    other.  Unstable/raising signatures are treated as colliding too
+    (identity that cannot be trusted cannot key a shared cache)."""
+    colliding: set = set()
+    by_sig: dict = {}
+    for n in graph.topological_nodes():
+        op = graph.operators[n]
+        if isinstance(op, G.TransformerOperator):
+            obj = op.transformer
+        elif isinstance(op, G.EstimatorOperator):
+            obj = op.estimator
+        else:
+            continue
+        try:
+            s1 = obj.signature()
+            s2 = obj.signature()
+            if s1 is not None:
+                hash(s1)
+        except Exception:
+            # raising/unhashable identity: nothing to key a refusal by
+            # — the planner's own (guarded) signature() call yields
+            # None for such nodes, so they are never pooled anyway
+            continue
+        if s1 is None:
+            continue  # params() is None: never pooled
+        if s1 != s2:
+            # unstable identity cannot be trusted to key a shared
+            # cache: refuse BOTH observed values
+            colliding.add(s1)
+            try:
+                colliding.add(s2)
+            except TypeError:
+                pass
+            continue
+        by_sig.setdefault(s1, []).append(obj)
+    for sig, group in by_sig.items():
+        if len(group) < 2:
+            continue
+        first = group[0]
+        for other in group[1:]:
+            if other is first:
+                continue
+            if _state_conflict(first, other):
+                colliding.add(sig)
+                break
+    return colliding
+
+
 def run(graph: G.Graph) -> List[Finding]:
     findings: List[Finding] = []
     by_sig: dict = {}

@@ -58,17 +58,29 @@ def _serve_main(argv) -> int:
     )
     ap.add_argument(
         "--model",
+        action="append",
         default=None,
-        help="path to a FittedPipeline saved via save()/fit_or_load()",
+        metavar="PATH | NAME=PATH",
+        help="path to a FittedPipeline saved via save()/fit_or_load().  "
+        "Repeatable with NAME=PATH pairs for a MULTI-TENANT deploy "
+        "(serve/tenants.py): every named model is co-served behind one "
+        "fleet, shared featurization prefixes computed once per flush "
+        "via the cross-pipeline stage pool; requests route by the "
+        "'tenant' body field.",
     )
     ap.add_argument(
         "--model-dir",
+        action="append",
         default=None,
-        metavar="DIR",
+        metavar="DIR | NAME=DIR",
         help="versioned model registry root (serve/registry.py): serve "
         "the CURRENT version (falling back past corrupt ones), enable "
         "POST /swap, and (with --watch) hot-swap newly published "
-        "versions live.  Exactly one of --model/--model-dir is required.",
+        "versions live.  Repeatable with NAME=DIR pairs for a "
+        "registry-backed multi-tenant deploy (each tenant serves its "
+        "registry's CURRENT version; /swap and --watch need a "
+        "single-tenant deploy).  At least one --model/--model-dir is "
+        "required; mixing named and unnamed entries is an error.",
     )
     ap.add_argument(
         "--replicas",
@@ -193,45 +205,47 @@ def _serve_main(argv) -> int:
         "Without it the first request per bucket compiles in-band.",
     )
     args = ap.parse_args(argv)
-    if (args.model is None) == (args.model_dir is None):
-        ap.error("exactly one of --model / --model-dir is required")
-    if args.watch is not None and args.model_dir is None:
-        ap.error("--watch requires --model-dir (a registry to poll)")
+    models = list(args.model or [])
+    model_dirs = list(args.model_dir or [])
+    if not models and not model_dirs:
+        ap.error("at least one of --model / --model-dir is required")
 
-    from keystone_tpu.serve import HttpFrontend, serve
+    def _named(spec: str) -> bool:
+        # NAME=PATH only when the prefix is a plain tenant name and the
+        # whole spec is not itself an existing path — a single
+        # --model ./runs/lr=0.1/model.pkl must stay a path
+        name, sep, _ = spec.partition("=")
+        return bool(sep) and bool(name) and os.sep not in name and not (
+            os.path.exists(spec)
+        )
 
-    registry = None
-    artifacts = None
-    if args.model_dir is not None:
-        from keystone_tpu.serve import ModelRegistry
+    named = [m for m in models + model_dirs if _named(m)]
+    multi = bool(named) or (len(models) + len(model_dirs)) > 1
+    if multi and len(named) != len(models) + len(model_dirs):
+        ap.error(
+            "multi-tenant deploys name every entry: --model NAME=PATH / "
+            "--model-dir NAME=DIR"
+        )
+    if not multi and models and model_dirs:
+        ap.error("pass one --model OR one --model-dir, not both")
+    if args.watch is not None and (multi or not model_dirs):
+        ap.error("--watch requires a single-tenant --model-dir deploy")
 
-        registry = ModelRegistry(args.model_dir)
-        fitted, version = registry.load()
-        if not args.no_artifacts:
-            # best-effort AOT tier: absent/corrupt artifacts mean this
-            # deploy compiles — never that it fails
-            artifacts = registry.load_artifacts(version)
-        source = f"{args.model_dir} ({version})"
-    else:
-        from keystone_tpu.workflow import FittedPipeline
+    from keystone_tpu.serve import HttpFrontend, serve, serve_multi
 
-        fitted = FittedPipeline.load(args.model)
-        version, source = "v0", args.model
     example = None
     if args.example_shape:
         import numpy as np
 
         shape = tuple(int(d) for d in args.example_shape.split(","))
         example = np.zeros(shape, np.float32)
-    svc = serve(
-        fitted,
+    serve_kw = dict(
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
         queue_bound=args.queue_bound,
         deadline_ms=args.deadline_ms,
         example=example,
         replicas=args.replicas,
-        version=version,
         recorder=not args.no_recorder,
         slo_ms=args.slo_ms,
         slo_target=args.slo_target,
@@ -241,8 +255,61 @@ def _serve_main(argv) -> int:
         restart_window_s=args.restart_window_s,
         hedge_ms=args.hedge_ms,
         bisect=not args.no_bisect,
-        artifacts=artifacts,
     )
+    registry = None
+    artifacts = None
+    if multi:
+        # registry multi-model deploy: each named entry loads a saved
+        # model (NAME=PATH) or a registry's CURRENT version (NAME=DIR);
+        # the fleet co-serves them with cross-pipeline prefix sharing
+        from keystone_tpu.serve import ModelRegistry
+        from keystone_tpu.workflow import FittedPipeline
+
+        tenants = {}
+        parts = []
+        for spec in models:
+            name, _, path = spec.partition("=")
+            tenants[name] = FittedPipeline.load(path)
+            parts.append(f"{name}={path}")
+        for spec in model_dirs:
+            name, _, root = spec.partition("=")
+            reg = ModelRegistry(root)
+            fitted, version = reg.load()
+            tenants[name] = fitted
+            parts.append(f"{name}={root} ({version})")
+            if not args.no_artifacts:
+                # the multi applier has no per-tenant bucket-program
+                # install (the walk serves), but the bundle's
+                # pre-seeded compile-cache entries — this PR's last
+                # cold rung — apply process-wide: seed them so the
+                # deploy's primes hit the cache tier
+                arts = reg.load_artifacts(version)
+                if arts:
+                    from keystone_tpu.utils.compile_cache import (
+                        seed_compile_cache,
+                    )
+
+                    seed_compile_cache(arts)
+        svc = serve_multi(tenants, **serve_kw)
+        version = "multi"
+        source = ", ".join(parts)
+    elif model_dirs:
+        from keystone_tpu.serve import ModelRegistry
+
+        registry = ModelRegistry(model_dirs[0])
+        fitted, version = registry.load()
+        if not args.no_artifacts:
+            # best-effort AOT tier: absent/corrupt artifacts mean this
+            # deploy compiles — never that it fails
+            artifacts = registry.load_artifacts(version)
+        source = f"{model_dirs[0]} ({version})"
+        svc = serve(fitted, version=version, artifacts=artifacts, **serve_kw)
+    else:
+        from keystone_tpu.workflow import FittedPipeline
+
+        fitted = FittedPipeline.load(models[0])
+        version, source = "v0", models[0]
+        svc = serve(fitted, version=version, **serve_kw)
     watcher = None
     if args.watch is not None:
         from keystone_tpu.serve import RegistryWatcher
@@ -366,7 +433,17 @@ def _export_main(argv) -> int:
         fitted, version = registry.load()
     frozen = fitted.freeze()
     bundle = frozen.export_artifacts(example=example, buckets=buckets)
-    n = len(bundle["blobs"])
+    ents = bundle["manifest"]["entries"]
+    n_cache = sum(
+        1 for e in ents.values() if e.get("kind") == "compile_cache"
+    )
+    n = len(bundle["blobs"]) - n_cache
+    if n_cache:
+        print(
+            f"captured {n_cache} persistent-compile-cache entr"
+            f"{'y' if n_cache == 1 else 'ies'} (pre-seeded backend "
+            "compiles ship with the bundle)"
+        )
     if args.model_dir is not None:
         from keystone_tpu.serve import ModelRegistry
 

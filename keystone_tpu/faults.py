@@ -17,8 +17,15 @@ Named **sites** are threaded through the codebase::
     stream.batch        loaders.stream.batched / resilient sources
     multihost.init      parallel.multihost.initialize
     executor.stage      GraphExecutor stage execution (inside retry scope)
-    serve.enqueue       serve.PipelineService.submit (admission path)
-    serve.batch         serve micro-batch flush (batcher worker thread)
+    serve.enqueue       serve.PipelineService.submit (admission path);
+                        multi-tenant services pass ctx ``tenant=NAME``,
+                        so ``serve.enqueue:ctx.tenant=a:raise`` refuses
+                        ONE tenant's admissions (blast-radius drills)
+    serve.batch         serve micro-batch flush (batcher worker thread);
+                        multi-tenant flushes ALSO fire once per co-
+                        flushed tenant with ctx ``tenant=NAME`` — a
+                        tenant-targeted fault fails that tenant's
+                        riders only, co-tenants deliver
     serve.worker        serve replica worker loop, per popped flush —
                         ``raise`` CRASHES the worker thread (the
                         in-hand flush is requeued for the supervisor's

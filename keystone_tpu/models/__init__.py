@@ -45,7 +45,9 @@ from keystone_tpu.models.kernel_ridge import (  # noqa: F401
     GaussianKernelGenerator,
     KernelBlockLinearMapper,
     KernelRidgeRegressionEstimator,
+    LinearKernelGenerator,
     OutOfCoreKernelBlockLinearMapper,
+    PolynomialKernelGenerator,
 )
 from keystone_tpu.models.nystrom import (  # noqa: F401
     NystromFeatureMap,

@@ -61,16 +61,28 @@ class BlockKernelMatrix:
             self._init_spill_dir(spill_dir)
 
     def _compute(self, a, b_rows):
-        """One gram gemm.  Gaussian generators route to the fused
-        Pallas distance-expansion→exp megakernel on capable backends
-        (``ops/gram_pallas``; solver-grade fits stream f32, scoring
-        generators ride the apply precision policy); duck-typed
-        generators — and every CPU/test path — keep the generator's
-        own XLA chain, bit-identically."""
-        from keystone_tpu.models.kernel_ridge import GaussianKernelGenerator
+        """One gram gemm.  First-class generators (Gaussian,
+        polynomial, linear — ``models/kernel_ridge.py``) route through
+        the ``ops/gram_pallas`` dispatcher: the fused megakernel on
+        capable backends (solver-grade fits stream f32, scoring
+        generators ride the apply precision policy), the generator's
+        own XLA chain — bit-identically — everywhere else.  Duck-typed
+        generators are never routed: the generator is called as-is."""
+        from keystone_tpu.models.kernel_ridge import (
+            GaussianKernelGenerator,
+            LinearKernelGenerator,
+            PolynomialKernelGenerator,
+        )
 
         kg = self.kernel_gen
-        if isinstance(kg, GaussianKernelGenerator):
+        if isinstance(
+            kg,
+            (
+                GaussianKernelGenerator,
+                PolynomialKernelGenerator,
+                LinearKernelGenerator,
+            ),
+        ):
             from keystone_tpu.ops import gram_pallas
 
             if gram_pallas.gram_pallas_enabled(int(self.x.shape[1])):
@@ -80,9 +92,9 @@ class BlockKernelMatrix:
                     from keystone_tpu.utils import precision
 
                     mxu = precision.apply_mode()
-                return gram_pallas.gram_block_pallas(
-                    a, b_rows, float(kg.gamma), mxu=mxu
-                )
+                out = gram_pallas.gram_block_for(kg, a, b_rows, mxu=mxu)
+                if out is not None:
+                    return out
         return kg(a, b_rows)
 
     def _init_spill_dir(self, spill_dir: str) -> None:

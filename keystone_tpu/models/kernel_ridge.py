@@ -55,6 +55,45 @@ class GaussianKernelGenerator:
         return jnp.exp(-self.gamma * sq)
 
 
+@dataclasses.dataclass(frozen=True)
+class LinearKernelGenerator:
+    """K(x, z) = x·zᵀ (KernelGenerator.scala's linear kernel).  Routed
+    through the ``ops/gram_pallas`` dispatcher by
+    :class:`~keystone_tpu.models.kernel_matrix.BlockKernelMatrix` like
+    the Gaussian generator — one fused f32-accumulated MXU pass on
+    Pallas-capable backends, this exact chain (bit-identical)
+    everywhere else."""
+
+    solver_grade: bool = True
+
+    def __call__(self, x: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
+        if self.solver_grade:
+            return sdot(x, z.T)
+        return jnp.matmul(x, z.T, preferred_element_type=jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class PolynomialKernelGenerator:
+    """K(x, z) = (α·x·zᵀ + c)^degree — the polynomial kernel, gemm
+    expansion form.  ``degree`` is a static int (one fit = one degree =
+    one compile, the ``gamma`` discipline).  Dispatcher-routed like the
+    Gaussian/linear generators: the Pallas megakernel fuses the gemm
+    with the affine+power epilogue in VMEM; the XLA fallback IS this
+    ``__call__`` (bit-identical by construction)."""
+
+    degree: int = 2
+    alpha: float = 1.0
+    c: float = 1.0
+    solver_grade: bool = True
+
+    def __call__(self, x: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
+        if self.solver_grade:
+            cross = sdot(x, z.T)
+        else:
+            cross = jnp.matmul(x, z.T, preferred_element_type=jnp.float32)
+        return (self.alpha * cross + self.c) ** int(self.degree)
+
+
 class KernelBlockLinearMapper(Transformer):
     """Predicts K(x_test, X_train)·α, streaming over train blocks so the
     test×train kernel never fully materializes

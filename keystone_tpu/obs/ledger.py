@@ -116,11 +116,13 @@ ATTR_VOCABULARY = {
     "request_ids",
     "restarts",
     "retries",
+    "refused",
     "rows",
     "rule",
     "seconds",
     "shared_bytes",
     "shared_nodes",
+    "shared_stages",
     "sick",
     "site",
     "solver",
@@ -128,6 +130,8 @@ ATTR_VOCABULARY = {
     "stats",
     "substitute",
     "tag",
+    "tenant",
+    "tenants",
     "to_state",
     "to_replica",
     "version",

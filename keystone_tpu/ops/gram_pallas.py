@@ -173,3 +173,156 @@ def gram_block(
             x, z, float(gamma), interpret=interpret, mxu=mxu
         )
     return _gram_block_xla(x, z, gamma, solver_grade=solver_grade)
+
+
+# ------------------------------------------------- polynomial / linear tier
+def _poly_gram_kernel(x_ref, z_ref, out_ref, *, alpha: float, c: float, degree: int):
+    # same VMEM discipline as the Gaussian kernel: operands may stream
+    # bf16, the contraction accumulates f32, and the affine + integer
+    # power epilogue never leaves VMEM
+    x = x_ref[:].astype(jnp.float32)  # (TN, d)
+    z = z_ref[:].astype(jnp.float32)  # (TM, d)
+    cross = jax.lax.dot_general(
+        x, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    out_ref[:] = (alpha * cross + c) ** degree
+
+
+@functools.partial(
+    jax.jit, static_argnames=("alpha", "c", "degree", "interpret", "mxu")
+)
+def poly_block_pallas(
+    x, z, alpha: float, c: float, degree: int, interpret: bool = False,
+    mxu: str = "f32",
+):
+    """K(x, z) = (α·x·zᵀ + c)^degree as one fused Pallas kernel —
+    the polynomial (and, at α=1, c=0, degree=1, linear) twin of
+    :func:`gram_block_pallas`; identical tiling/VMEM budget, identical
+    padding discipline (padding tiles compute garbage, sliced away)."""
+    n, d = x.shape
+    m = z.shape[0]
+    tn = _gram_tile(n, d)
+    tm = _gram_tile(m, d)
+    n_tiles = -(-n // tn)
+    m_tiles = -(-m // tm)
+    if n_tiles * tn != n:
+        x = jnp.pad(x, ((0, n_tiles * tn - n), (0, 0)))
+    if m_tiles * tm != m:
+        z = jnp.pad(z, ((0, m_tiles * tm - m), (0, 0)))
+    fdt = _precision().fdtype(mxu)
+    out = pl.pallas_call(
+        functools.partial(
+            _poly_gram_kernel,
+            alpha=float(alpha),
+            c=float(c),
+            degree=int(degree),
+        ),
+        grid=(n_tiles, m_tiles),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        in_specs=[
+            pl.BlockSpec((tn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((tn, tm), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * tn, m_tiles * tm), jnp.float32),
+        interpret=interpret,
+    )(x.astype(fdt), z.astype(fdt))
+    return out[:n, :m]
+
+
+def _poly_block_xla(x, z, alpha, c, degree, solver_grade: bool = True):
+    """The CPU/fallback chain — EXACTLY the ``PolynomialKernelGenerator``
+    graph, by construction (the ``_gram_block_xla`` discipline: the
+    fallback IS the generator, so it can never silently diverge)."""
+    from keystone_tpu.models.kernel_ridge import PolynomialKernelGenerator
+
+    return PolynomialKernelGenerator(
+        degree=int(degree), alpha=alpha, c=c, solver_grade=solver_grade
+    )(x, z)
+
+
+def _linear_block_xla(x, z, solver_grade: bool = True):
+    """Bit-identical fallback = the ``LinearKernelGenerator`` itself."""
+    from keystone_tpu.models.kernel_ridge import LinearKernelGenerator
+
+    return LinearKernelGenerator(solver_grade=solver_grade)(x, z)
+
+
+def poly_gram_block(
+    x,
+    z,
+    alpha: float = 1.0,
+    c: float = 1.0,
+    degree: int = 2,
+    solver_grade: bool = True,
+    mxu: str = "f32",
+    use_pallas=None,
+    interpret: bool = False,
+):
+    """Polynomial-kernel gram block through the same Pallas/XLA gating
+    as :func:`gram_block` (``gram_pallas_enabled`` +
+    ``KEYSTONE_GRAM_PALLAS=0`` escape hatch + ``GRAM_MAX_D`` bound)."""
+    if use_pallas is None:
+        use_pallas = gram_pallas_enabled(int(x.shape[-1]))
+    if use_pallas:
+        return poly_block_pallas(
+            x, z, float(alpha), float(c), int(degree),
+            interpret=interpret, mxu=mxu,
+        )
+    return _poly_block_xla(x, z, alpha, c, degree, solver_grade=solver_grade)
+
+
+def linear_gram_block(
+    x,
+    z,
+    solver_grade: bool = True,
+    mxu: str = "f32",
+    use_pallas=None,
+    interpret: bool = False,
+):
+    """Linear-kernel gram block: rides the polynomial megakernel at
+    (α=1, c=0, degree=1) on Pallas targets; the XLA fallback is the
+    ``LinearKernelGenerator`` chain, bit-identical."""
+    if use_pallas is None:
+        use_pallas = gram_pallas_enabled(int(x.shape[-1]))
+    if use_pallas:
+        return poly_block_pallas(
+            x, z, 1.0, 0.0, 1, interpret=interpret, mxu=mxu
+        )
+    return _linear_block_xla(x, z, solver_grade=solver_grade)
+
+
+def gram_block_for(kernel_gen, x, z, mxu: str = "f32", use_pallas=None,
+                   interpret: bool = False):
+    """Route a kernel GENERATOR instance through the matching
+    dispatcher — the single entry ``BlockKernelMatrix`` uses, so every
+    first-class generator (Gaussian, polynomial, linear) shares the
+    Pallas/XLA gating and duck-typed generators stay untouched.
+    Returns None for generators with no dispatcher route (the caller
+    falls back to calling the generator directly)."""
+    from keystone_tpu.models.kernel_ridge import (
+        GaussianKernelGenerator,
+        LinearKernelGenerator,
+        PolynomialKernelGenerator,
+    )
+
+    sg = getattr(kernel_gen, "solver_grade", True)
+    if isinstance(kernel_gen, GaussianKernelGenerator):
+        return gram_block(
+            x, z, float(kernel_gen.gamma), solver_grade=sg, mxu=mxu,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+    if isinstance(kernel_gen, PolynomialKernelGenerator):
+        return poly_gram_block(
+            x, z, alpha=float(kernel_gen.alpha), c=float(kernel_gen.c),
+            degree=int(kernel_gen.degree), solver_grade=sg, mxu=mxu,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+    if isinstance(kernel_gen, LinearKernelGenerator):
+        return linear_gram_block(
+            x, z, solver_grade=sg, mxu=mxu, use_pallas=use_pallas,
+            interpret=interpret,
+        )
+    return None

@@ -30,11 +30,19 @@ from keystone_tpu.serve.service import (  # noqa: F401
     default_buckets,
     serve,
 )
+from keystone_tpu.serve.tenants import (  # noqa: F401
+    MultiTenantApplier,
+    MultiTenantService,
+    UnknownTenant,
+    serve_multi,
+)
 
 __all__ = [
     "FleetUnavailable",
     "HttpFrontend",
     "ModelRegistry",
+    "MultiTenantApplier",
+    "MultiTenantService",
     "Overloaded",
     "PipelineService",
     "PoisonRequest",
@@ -44,7 +52,9 @@ __all__ = [
     "RegistryError",
     "RegistryWatcher",
     "ServiceClosed",
+    "UnknownTenant",
     "default_buckets",
     "serve",
     "serve_http",
+    "serve_multi",
 ]

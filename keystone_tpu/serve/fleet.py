@@ -146,24 +146,36 @@ def _clone_and_place(pipeline, device):
     ``FittedPipeline.save``/``load`` already pin — so replicas share no
     transformer instances and therefore no per-instance jit caches:
     each replica compiles (and keeps hot) its own bucket programs
-    against its own device."""
+    against its own device.  Multi-tenant appliers expose ``graphs()``
+    (one graph per tenant); plain pipelines/appliers hold one
+    ``graph``."""
     clone = pickle.loads(pickle.dumps(pipeline))
     if device is not None:
-        for op in clone.graph.operators.values():
-            t = getattr(op, "transformer", None)
-            if t is not None:
-                _place_on_device(t, device)
+        graphs_fn = getattr(clone, "graphs", None)
+        graphs = graphs_fn() if callable(graphs_fn) else [clone.graph]
+        seen: dict = {}
+        for g in graphs:
+            for op in g.operators.values():
+                t = getattr(op, "transformer", None)
+                if t is not None:
+                    # ONE _seen map across tenant graphs: a featurizer
+                    # instance shared by two tenants' graphs must get
+                    # one placed copy at both sites
+                    _place_on_device(t, device, _seen=seen)
     return clone
 
 
 def _as_applier(pipeline):
     from keystone_tpu.workflow.pipeline import FrozenApplier
 
-    return (
-        pipeline
-        if isinstance(pipeline, FrozenApplier)
-        else FrozenApplier(pipeline)
-    )
+    # serve_applier marks duck-typed appliers (the multi-tenant
+    # MultiTenantApplier) that already implement the frozen-apply
+    # contract and must not be re-wrapped
+    if isinstance(pipeline, FrozenApplier) or getattr(
+        pipeline, "serve_applier", False
+    ):
+        return pipeline
+    return FrozenApplier(pipeline)
 
 
 _SENTINEL = object()
@@ -238,14 +250,16 @@ class Replica:
         return not (self.quarantined or self.dead or self._retired)
 
     # ------------------------------------------------------------ apply
-    def apply(self, ds, deadline=None, prime: bool = False):
+    def apply(self, ds, deadline=None, prime: bool = False, **kw):
         """Run the frozen graph over one padded batch on THIS replica.
         Live flushes pass through the ``serve.replica`` fault site;
         priming warm-ups (``prime=True``) do not — chaos plans target
-        traffic, not warm-up."""
+        traffic, not warm-up.  Extra keywords pass through to the
+        applier (the multi-tenant path threads per-flush ``segments``
+        and ``tenants`` this way)."""
         if not prime:
             fault_point("serve.replica", replica=self.index)
-        return self.applier(ds, deadline=deadline)
+        return self.applier(ds, deadline=deadline, **kw)
 
     # ----------------------------------------------------------- worker
     def start(self, runner: Callable, obs_context=None) -> None:

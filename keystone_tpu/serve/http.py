@@ -3,7 +3,10 @@
 Endpoints (JSON unless noted):
 
 - ``POST /predict`` — body ``{"instances": [<datum>, ...]}`` (or
-  ``{"instance": <datum>}``), optional ``"deadline_ms"``.  Replies
+  ``{"instance": <datum>}``), optional ``"deadline_ms"`` and
+  ``"tenant"`` (multi-tenant services — ``serve/tenants.py`` — route
+  by it; single-tenant services answer 400; a tenant whose own
+  admission breaker is open answers 429).  Replies
   ``{"predictions": [...]}``.  Status codes carry the admission/deadline
   contract: **429** when admission control rejects (``Overloaded``,
   with a ``Retry-After`` hint), **504** when the request was shed past
@@ -282,6 +285,10 @@ class _Handler(BaseHTTPRequestHandler):
             arr = np.asarray(instances, dtype=np.float32)
             deadline_ms = body.get("deadline_ms")
             deadline = None if deadline_ms is None else float(deadline_ms) / 1000.0
+            # multi-tenant routing: the body names its tenant; a
+            # single-tenant service refuses a tenant (TypeError → 400)
+            tenant = body.get("tenant")
+            tenant = None if tenant is None else str(tenant)
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             self._send(
                 400, {"error": f"bad request: {e}", "request_id": rid}, headers=hdrs
@@ -298,7 +305,9 @@ class _Handler(BaseHTTPRequestHandler):
         if len(ids) > 1:
             id_body["request_ids"] = ids
         try:
-            futs = self.service.submit_many(arr, deadline=deadline, request_ids=ids)
+            futs = self.service.submit_many(
+                arr, deadline=deadline, request_ids=ids, tenant=tenant
+            )
         except Overloaded as e:
             # Retry-After from the EWMA flush-completion estimate the
             # shedding path maintains: the header is delta-seconds (an
@@ -324,7 +333,16 @@ class _Handler(BaseHTTPRequestHandler):
         except ServiceClosed as e:
             self._send(503, {"error": str(e), **id_body}, headers=hdrs)
             return
-        except TypeError as e:  # shape mismatch: the CLIENT's fault
+        except guard.CircuitOpenError as e:
+            # THIS tenant's admission breaker is open (repeated
+            # failures): back off — co-served tenants are unaffected
+            self._send(
+                429,
+                {"error": str(e), "retry_after_seconds": 1.0, **id_body},
+                headers=hdrs + (("Retry-After", "1"),),
+            )
+            return
+        except TypeError as e:  # shape mismatch / bad tenant: CLIENT fault
             self._send(
                 400, {"error": f"bad request: {e}", **id_body}, headers=hdrs
             )

@@ -108,6 +108,15 @@ of force-routing: submits raise
 first successful restart — or a breaker's half-open probe — re-admits
 traffic.
 
+**Multi-tenant serving (ISSUE 14)** — ``serve/tenants.py`` subclasses
+this service to co-serve N pipelines behind one batcher + fleet:
+per-tenant admission queues/quotas/deadlines/breakers, deficit-round-
+robin combined flushes, and the cross-pipeline shared stage pool
+(``workflow/stage_pool.py``) computing shared featurization prefixes
+once per flush.  The tenant hooks below (``_resolve_tenant``,
+``_check_bound_locked``, ``_push_locked``, ``_account_tenant``, ...)
+are inert on this base class — the single-tenant path is unchanged.
+
 The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 ``python -m keystone_tpu.cli serve``; the load generator is
 ``tools/serve_bench.py``.
@@ -234,13 +243,14 @@ def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "future", "t_submit", "request_id")
+    __slots__ = ("x", "deadline", "future", "t_submit", "request_id", "tenant")
 
     def __init__(
         self,
         x,
         deadline: Optional[guard.Deadline],
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
         self.x = x
         self.deadline = deadline
@@ -249,6 +259,9 @@ class _Request:
         #: trace identity; None when tracing is off for this request —
         #: every trace hook takes the None id as its inert no-op
         self.request_id = request_id
+        #: multi-tenant routing label (serve/tenants.py); None on the
+        #: single-tenant service — every tenant hook is inert then
+        self.tenant = tenant
 
 
 class _Flush:
@@ -404,9 +417,18 @@ class PipelineService:
         # too, not just the CLI entry points.  Env-gated
         # (KEYSTONE_COMPILE_CACHE=0 disables) and never clobbers an
         # already-configured cache dir.
-        from keystone_tpu.utils.compile_cache import ensure_compilation_cache
+        from keystone_tpu.utils.compile_cache import (
+            ensure_compilation_cache,
+            seed_compile_cache,
+        )
 
         ensure_compilation_cache()
+        if artifacts:
+            # the bundle may ship persistent-compile-cache entries
+            # (export's pre-seeded rung): install them BEFORE any
+            # replica primes, so the first deploy on a fresh host skips
+            # the backend compile of the deserialized modules too
+            seed_compile_cache(artifacts)
         self._pool = ReplicaPool(
             pipeline,
             replicas=replicas,
@@ -674,27 +696,92 @@ class PipelineService:
             )
 
     # ------------------------------------------------------------- submit
-    def submit(self, x, deadline=None, request_id: Optional[str] = None) -> Future:
+    def submit(
+        self,
+        x,
+        deadline=None,
+        request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> Future:
         """Enqueue one datum; returns a Future resolving to its result
         row (numpy).  ``deadline``: seconds or a ``guard.Deadline``
         (default: the service's ``deadline_ms``).  ``request_id``: the
         trace identity (default: generated when the flight recorder is
-        on — resolve the outcome later via ``/requestz/<id>``).  Raises
+        on — resolve the outcome later via ``/requestz/<id>``).
+        ``tenant``: multi-tenant routing label — refused (TypeError) on
+        a single-tenant service; see ``serve/tenants.py``.  Raises
         :class:`Overloaded` when the queue is at bound and
         :class:`ServiceClosed` after shutdown began."""
         return self._submit_all(
-            [x], deadline, None if request_id is None else [request_id]
+            [x],
+            deadline,
+            None if request_id is None else [request_id],
+            tenant=tenant,
         )[0]
 
-    def submit_many(self, xs, deadline=None, request_ids=None) -> list:
+    def submit_many(self, xs, deadline=None, request_ids=None, tenant=None) -> list:
         """Enqueue a sequence of datums; returns their Futures in order.
         One shared deadline resolution (all requests of the call carry
         the same absolute expiry) and ATOMIC admission: either every
         datum is enqueued or none is — a partial enqueue would leave
         orphaned requests executing for a caller that saw the error.
         ``request_ids``: per-datum trace identities (default: generated
-        when the flight recorder is on)."""
-        return self._submit_all(list(xs), deadline, request_ids)
+        when the flight recorder is on).  ``tenant``: multi-tenant
+        routing label (single-tenant services refuse it)."""
+        return self._submit_all(list(xs), deadline, request_ids, tenant=tenant)
+
+    # ------------------------------------------------------ tenant hooks
+    # The multi-tenant service (serve/tenants.py) overrides these; on
+    # the base single-tenant service every one is inert (or refuses),
+    # so the PR-5..13 admission path is unchanged.
+    def _resolve_tenant(self, tenant: Optional[str]) -> Optional[str]:
+        if tenant is not None:
+            raise TypeError(
+                f"service {self.name!r} is single-tenant; tenant="
+                f"{tenant!r} refused (serve_multi builds tenant routing)"
+            )
+        return None
+
+    def _default_deadline_for(self, tenant: Optional[str]):
+        return self.default_deadline_s
+
+    def _check_bound_locked(self, n_new: int, tenant: Optional[str]) -> None:
+        """Admission bound check; must hold ``self._cond``."""
+        if len(self._q) + n_new > self.queue_bound:
+            metrics.inc("serve.rejected", n_new)
+            raise Overloaded(
+                f"service {self.name!r} queue at bound "
+                f"({self.queue_bound}); retry later"
+            )
+
+    def _push_locked(self, reqs: list, tenant: Optional[str]) -> int:
+        """Enqueue admitted requests; must hold ``self._cond``.
+        Returns the post-push queue depth (the enqueue annotation).
+        The gauge is set under the lock: written outside it, a stale
+        pre-flush depth could overwrite the batcher's newer value."""
+        self._q.extend(reqs)
+        depth = len(self._q)
+        metrics.set_gauge("serve.queue_depth", depth)
+        return depth
+
+    def _account_admission(
+        self, tenant: Optional[str], outcome: str, n: int
+    ) -> None:
+        """Per-tenant admission-terminal accounting hook (inert here)."""
+
+    def _account_tenant(self, req, outcome: str, seconds: float) -> None:
+        """Per-tenant request-terminal accounting hook (inert here)."""
+
+    def _fail_queued_locked(self, make_exc) -> None:
+        """Fail every queued request; must hold ``self._cond``.  The
+        multi-tenant service overrides this to drain its per-tenant
+        queues."""
+        while self._q:
+            self._fail(self._q.popleft(), make_exc())
+        metrics.set_gauge("serve.queue_depth", 0)
+
+    def _queue_depth_locked(self) -> int:
+        return len(self._q)
 
     def _resolve_request_ids(self, n: int, request_ids) -> List[Optional[str]]:
         if request_ids is not None:
@@ -708,7 +795,7 @@ class PipelineService:
             return [new_request_id() for _ in range(n)]
         return [None] * n
 
-    def _submit_all(self, xs, deadline, request_ids=None) -> list:
+    def _submit_all(self, xs, deadline, request_ids=None, tenant=None) -> list:
         if not xs:
             return []
         rids = self._resolve_request_ids(len(xs), request_ids)
@@ -716,11 +803,17 @@ class PipelineService:
         try:
             if self._closing:
                 raise ServiceClosed(f"service {self.name!r} is closed")
+            tenant = self._resolve_tenant(tenant)
             dl = guard.as_deadline(
-                deadline if deadline is not None else self.default_deadline_s
+                deadline
+                if deadline is not None
+                else self._default_deadline_for(tenant)
             )
+            # ctx.tenant rides the fault site so chaos plans can target
+            # ONE tenant's admission path (blast-radius isolation)
+            tctx = {} if tenant is None else {"tenant": tenant}
             for _ in xs:
-                fault_point("serve.enqueue")
+                fault_point("serve.enqueue", **tctx)
             arrs = [np.asarray(x) for x in xs]
             # the poison quarantine cache: content previously isolated
             # by bisection is refused BEFORE it reaches a device (and
@@ -778,34 +871,29 @@ class PipelineService:
                             f"request shape {tuple(arr.shape)} != service item "
                             f"shape {item_shape}"
                         )
-                if len(self._q) + len(arrs) > self.queue_bound:
-                    metrics.inc("serve.rejected", len(arrs))
-                    raise Overloaded(
-                        f"service {self.name!r} queue at bound "
-                        f"({self.queue_bound}); retry later"
-                    )
+                self._check_bound_locked(len(arrs), tenant)
                 self._item_shape, self._dtype = item_shape, dtype
                 reqs = [
                     _Request(
-                        a if a.dtype == dtype else a.astype(dtype), dl, rid
+                        a if a.dtype == dtype else a.astype(dtype),
+                        dl,
+                        rid,
+                        tenant=tenant,
                     )
                     for a, rid in zip(arrs, rids)
                 ]
+                # push, then annotate — both UNDER the queue lock: the
+                # batcher pops under this same lock, so once we
+                # release, the flush path's finish() cannot run ahead
+                # of the enqueue event (annotated after the lock, a
+                # preempted submitter could lose the event — or
+                # resurrect an evicted id as a phantom trace)
+                depth = self._push_locked(reqs, tenant)
                 if rec is not None:
-                    # annotate UNDER the queue lock, BEFORE the extend:
-                    # the batcher pops under this same lock, so once we
-                    # release, the flush path's finish() cannot run
-                    # ahead of the enqueue event (annotated after the
-                    # lock, a preempted submitter could lose the event
-                    # — or resurrect an evicted id as a phantom trace)
-                    depth = len(self._q) + len(reqs)
                     for rid in rids:
-                        rec.annotate(rid, "serve.enqueue", queue_depth=depth)
-                self._q.extend(reqs)
-                # gauge set under the lock: written outside it, a stale
-                # pre-flush depth could overwrite the batcher's newer value
-                # and report a full queue on an idle service
-                metrics.set_gauge("serve.queue_depth", len(self._q))
+                        rec.annotate(
+                            rid, "serve.enqueue", queue_depth=depth, **tctx
+                        )
                 self._cond.notify_all()
         except BaseException as e:
             # terminal outcome at admission: the trace (if any) must not
@@ -813,7 +901,18 @@ class PipelineService:
             # shed one.  Finished OUTSIDE the queue lock.
             if isinstance(e, PoisonRequest):
                 outcome = "poison"
-            elif isinstance(e, (Overloaded, ServiceClosed, FleetUnavailable)):
+            elif isinstance(
+                e,
+                (
+                    Overloaded,
+                    ServiceClosed,
+                    FleetUnavailable,
+                    # a tenant breaker's refusal is backpressure (the
+                    # HTTP layer answers 429 + Retry-After), not an
+                    # error: charged to rejected counters/traces
+                    guard.CircuitOpenError,
+                ),
+            ):
                 outcome = "rejected"
             else:
                 outcome = "error"
@@ -825,6 +924,7 @@ class PipelineService:
             if not isinstance(e, (TypeError, ValueError)):
                 for _ in xs:
                     self._fail_win.observe(0.0)
+            self._account_admission(tenant, outcome, len(xs))
             err = f"{type(e).__name__}: {e}"
             for rid in rids:
                 if rid is not None:
@@ -838,6 +938,7 @@ class PipelineService:
                     )
             raise
         metrics.inc("serve.submitted", len(reqs))
+        self._account_admission(tenant, "submitted", len(reqs))
         return [r.future for r in reqs]
 
     @property
@@ -890,7 +991,7 @@ class PipelineService:
         if ewma <= 0.0:
             return 1.0
         with self._cond:
-            depth = len(self._q)
+            depth = self._queue_depth_locked()
         flushes = -(-max(1, depth) // self.max_batch)  # ceil division
         return ewma * flushes / max(1, self._pool.size)
 
@@ -1043,6 +1144,14 @@ class PipelineService:
             with ledger.span("serve.swap", version=version):
                 fault_point("serve.swap", version=version)
                 t0 = time.monotonic()
+                if artifacts:
+                    # shipped compile-cache entries install before the
+                    # staged generation primes (same rung as cold start)
+                    from keystone_tpu.utils.compile_cache import (
+                        seed_compile_cache,
+                    )
+
+                    seed_compile_cache(artifacts)
                 staged = self._pool.stage(pipeline, version, artifacts=artifacts)
                 try:
                     if prime and self._item_shape is not None:
@@ -1100,12 +1209,9 @@ class PipelineService:
         with self._cond:
             self._closing = True
             if not drain:
-                while self._q:
-                    req = self._q.popleft()
-                    self._fail(
-                        req, ServiceClosed("service closed before execution")
-                    )
-                metrics.set_gauge("serve.queue_depth", 0)
+                self._fail_queued_locked(
+                    lambda: ServiceClosed("service closed before execution")
+                )
             self._cond.notify_all()
         # stop the healers first: a supervisor restarting (or a hedge
         # monitor re-enqueueing into) a pool that close() is tearing
@@ -1148,15 +1254,12 @@ class PipelineService:
             # still-queued futures rather than leave their callers
             # blocked forever
             with self._cond:
-                while self._q:
-                    self._fail(
-                        self._q.popleft(),
-                        ServiceClosed(
-                            "service closed with the batcher wedged; "
-                            "request never executed"
-                        ),
+                self._fail_queued_locked(
+                    lambda: ServiceClosed(
+                        "service closed with the batcher wedged; "
+                        "request never executed"
                     )
-                metrics.set_gauge("serve.queue_depth", 0)
+                )
         # retire the replica workers: each drains its already-routed
         # flushes first, so drained == every admitted future resolved.
         # A wedged replica worker hands back its abandoned flushes
@@ -1244,18 +1347,20 @@ class PipelineService:
         terminal, no phantom SLO burn."""
         if req.future.done():
             return
+        waited = time.monotonic() - req.t_submit
         # client faults (shape mismatch, poison content — the 4xx
         # family) do not burn the server's SLO error budget
         if not isinstance(exc, (TypeError, ValueError)):
-            self._fail_win.observe(time.monotonic() - req.t_submit)
+            self._fail_win.observe(waited)
+        if isinstance(exc, guard.DeadlineExceeded):
+            outcome = "shed"
+        elif isinstance(exc, PoisonRequest):
+            outcome = "poison"
+        else:
+            outcome = "error"
+        self._account_tenant(req, outcome, waited)
         rid = req.request_id
         if rid is not None:
-            if isinstance(exc, guard.DeadlineExceeded):
-                outcome = "shed"
-            elif isinstance(exc, PoisonRequest):
-                outcome = "poison"
-            else:
-                outcome = "error"
             rec = self.recorder
             if rec is not None:
                 rec.finish(
@@ -1414,7 +1519,6 @@ class PipelineService:
                 request_ids=trace_ids,
             ):
                 fault_point("serve.batch")
-                stacked = np.stack([req.x for req in live])
                 batch_deadline = None
                 if self._degrade:
                     # the LOOSEST rider's deadline (and only when every
@@ -1428,9 +1532,7 @@ class PipelineService:
                     dls = [r.deadline for r in live if r.deadline is not None]
                     if dls and len(dls) == len(live):
                         batch_deadline = max(dls, key=lambda d: d.at)
-                out = self._apply_rows(
-                    stacked, deadline=batch_deadline, replica=replica
-                )
+                out = self._apply_reqs(live, replica, batch_deadline)
         except BaseException as e:  # one bad batch must not kill the worker
             metrics.inc("serve.batch_errors")
             logger.warning(
@@ -1508,6 +1610,7 @@ class PipelineService:
                 # bench's "completed beat their deadlines" claim is honest
                 metrics.inc("serve.deadline_miss")
             metrics.inc("serve.completed")
+            self._account_tenant(req, outcome, done_t - req.t_submit)
             if req.request_id is not None:
                 if rec is not None:
                     rec.finish(
@@ -1580,11 +1683,7 @@ class PipelineService:
             try:
                 applies += 1
                 t0 = time.monotonic()
-                out = self._apply_rows(
-                    np.stack([req.x for req in reqs]),
-                    deadline=batch_deadline,
-                    replica=replica,
-                )
+                out = self._apply_reqs(reqs, replica, batch_deadline)
             except BaseException as ge:
                 if not _poison_suspect(ge):
                     # infrastructure failed the RE-RUN: this group's
@@ -1647,6 +1746,17 @@ class PipelineService:
         return False if infra_failed else True
 
     # -------------------------------------------------------------- apply
+    def _apply_reqs(self, reqs, replica, deadline):
+        """One flush's apply body: stack the riders' rows and run the
+        frozen graph.  Returns something indexable per rider (ndarray
+        rows here).  The multi-tenant service overrides this with the
+        segment-aware shared-pool apply — both the flush happy path and
+        bisection's re-runs route through it, so poison isolation works
+        identically per tenant."""
+        return self._apply_rows(
+            np.stack([req.x for req in reqs]), deadline=deadline, replica=replica
+        )
+
     def _bucket_for(self, k: int) -> int:
         for b in self.buckets:
             if b >= k:
@@ -1660,6 +1770,7 @@ class PipelineService:
         replica=None,
         prime: bool = False,
         source_box: Optional[list] = None,
+        **apply_kw,
     ) -> np.ndarray:
         """Pad ``(k, ...)`` rows up to the smallest bucket >= k (the
         ``iter_row_chunks`` pad discipline — zero pad rows, outputs
@@ -1701,9 +1812,14 @@ class PipelineService:
             and has(tuple(ds.array.shape), ds.array.dtype)
         ):
             prog_key = (tuple(ds.array.shape), ds.array.dtype)
-        out = rep.apply(ds, deadline=deadline, prime=prime)
+        out = rep.apply(ds, deadline=deadline, prime=prime, **apply_kw)
         if prog_key is not None and has(*prog_key):
             source_box.append("artifact")
+        if isinstance(out, dict):
+            # multi-tenant applier: one full-batch output per tenant
+            # (heads differ in output width, so there is no single
+            # stacked array to return)
+            return {t: np.asarray(d.array)[:k] for t, d in out.items()}
         return np.asarray(out.array)[:k]
 
 

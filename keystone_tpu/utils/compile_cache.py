@@ -51,6 +51,18 @@ def enable_compilation_cache(
         prev_dir = jax.config.jax_compilation_cache_dir
         jax.config.update("jax_compilation_cache_dir", d)
         dir_updated = True
+        if prev_dir and prev_dir != d:
+            # jax lazily binds ONE cache object to the first dir it
+            # initializes; without a reset, later dir changes silently
+            # keep reading/writing the old directory (observed: a
+            # second export in one process captured zero entries — they
+            # landed in the first test's dir)
+            try:
+                from jax._src.compilation_cache import reset_cache
+
+                reset_cache()
+            except Exception:
+                pass  # older jax: the single-dir behavior stands
         # persist EVERYTHING (threshold 0): even sub-second eager-op
         # compiles pay a device-RPC round-trip per program in tunneled
         # environments, and dozens of them add tens of seconds
@@ -91,6 +103,95 @@ def ensure_compilation_cache() -> Optional[str]:
     if existing:
         return existing
     return enable_compilation_cache()
+
+
+def snapshot_cache_entries() -> Optional[set]:
+    """The active cache dir's current file set (None: no active dir) —
+    the 'before' side of :func:`collect_new_entries`."""
+    try:
+        import jax
+
+        d = jax.config.jax_compilation_cache_dir
+    except Exception:
+        return None
+    if not d or not os.path.isdir(d):
+        return None
+    return set(os.listdir(d))
+
+
+def collect_new_entries(before: Optional[set]) -> dict:
+    """Files the active cache dir gained since ``before`` was
+    snapshotted, as ``{filename: bytes}`` — the export path captures
+    the persistent-cache entries its backend compiles mint, so a
+    freeze-artifact bundle can SHIP them (the artifact ladder's last
+    cold rung: a fresh host's first deploy then skips even the backend
+    compile of the deserialized module)."""
+    if before is None:
+        return {}
+    import jax
+
+    d = jax.config.jax_compilation_cache_dir
+    if not d or not os.path.isdir(d):
+        return {}
+    out = {}
+    for name in sorted(set(os.listdir(d)) - before):
+        path = os.path.join(d, name)
+        try:
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    out[name] = f.read()
+        except OSError:
+            continue  # capture is best-effort; the entry just re-compiles
+    return out
+
+
+def seed_compile_cache(bundle: Optional[dict]) -> int:
+    """Install an artifact bundle's shipped compile-cache entries into
+    the active persistent cache dir (missing files only — an existing
+    entry is never clobbered).  Returns how many files were written.
+    Best-effort end to end: no active cache, no shipped entries, or an
+    unwritable dir all degrade to plain compilation, never fail a
+    deploy.  Counted as ``serve.cache_seeded``."""
+    manifest = (bundle or {}).get("manifest") or {}
+    blobs = (bundle or {}).get("blobs") or {}
+    entries = {
+        key: ent
+        for key, ent in (manifest.get("entries") or {}).items()
+        if ent.get("kind") == "compile_cache"
+    }
+    if not entries:
+        return 0
+    d = ensure_compilation_cache()
+    if not d:
+        return 0
+    seeded = 0
+    for key, ent in entries.items():
+        data = blobs.get(key)
+        name = ent.get("name")
+        if data is None or not name or os.sep in str(name):
+            continue
+        path = os.path.join(d, str(name))
+        if os.path.exists(path):
+            continue
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            seeded += 1
+        except OSError as e:
+            logger.warning("compile-cache seed of %s failed: %s", name, e)
+    if seeded:
+        from keystone_tpu.obs import metrics
+
+        metrics.inc("serve.cache_seeded", seeded)
+        logger.info(
+            "seeded %d persistent-compile-cache entr%s from the artifact "
+            "bundle",
+            seeded,
+            "y" if seeded == 1 else "ies",
+        )
+    return seeded
 
 
 def cache_active() -> bool:

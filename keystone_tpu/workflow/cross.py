@@ -1,0 +1,200 @@
+"""Cross-pipeline CSE: plan stage sharing between co-served pipelines.
+
+The per-pipeline optimizer's ``EquivalentNodeMergeRule`` merges equal
+prefixes WITHIN one graph; this pass is its across-graphs twin for the
+multi-tenant serving fleet.  Given the frozen graphs of N co-served
+tenants it:
+
+1. computes a **normalized prefix signature** per node — the
+   ``Graph.prefix_signature`` structural hash with every open source
+   mapped to the same placeholder, so "SIFT over the request batch" is
+   one value no matter which tenant's graph it sits in;
+2. finds the signatures present in ≥ 2 tenants' graphs (the shared
+   stages);
+3. runs the PR-6 **signature-collision pass** over the disjoint UNION
+   of all graphs as the admission gate: a stage whose transformer
+   signature collides there (equal signature, observably different
+   state — ``params()`` under-specifies) is refused sharing outright —
+   counted, never shared, never wrong;
+4. keeps only the sharing **frontier**: a shared node is marked iff in
+   at least one graph some consumer of it is NOT shared (the deepest
+   shared stages).  The executor consults the pool top-down, so a
+   frontier hit prunes the whole prefix walk — marking interior nodes
+   would only publish intermediates no other tenant reads.
+
+The "rewrite to pool lookups" is the resulting per-tenant
+``{node id → signature}`` map: the multi-tenant applier hands it to
+each :class:`~keystone_tpu.workflow.executor.GraphExecutor`, whose walk
+then reads marked nodes through the
+:class:`~keystone_tpu.workflow.stage_pool.SharedStagePool` instead of
+recomputing them.  Nothing is stamped on shared operator instances
+(pipelines built from one featurizer object can share them across
+graphs) and the plan is plain data — it pickles with the applier into
+every replica clone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import reduce
+from typing import Dict, Optional
+
+from keystone_tpu.workflow import graph as G
+
+#: every open source normalizes to this placeholder in prefix
+#: signatures: co-served serve graphs all hang off "the request batch"
+_SOURCE = ("source", 0)
+
+
+def normalized_prefix_signature(
+    g: G.Graph, target, memo: Optional[dict] = None
+) -> Optional[tuple]:
+    """``Graph.prefix_signature`` with sources normalized (and without
+    the per-graph unique fallback: an unshareable node is simply None).
+    None = the node (or something in its prefix) declares no stable
+    signature — it can never key a cross-pipeline cache entry."""
+    if memo is None:
+        memo = {}
+    if target in memo:
+        return memo[target]
+    if isinstance(target, G.SourceId):
+        memo[target] = _SOURCE
+        return _SOURCE
+    op = g.operators[target]
+    try:
+        sig = op.signature()
+    except Exception:
+        sig = None  # a raising identity can never key a shared entry
+    if sig is None:
+        memo[target] = None
+        return None
+    # an over-HBM-budget node (no_memoize) must not be pinned into the
+    # pool either: the cache rule already ruled its output unaffordable
+    if getattr(op, "no_memoize", False):
+        memo[target] = None
+        return None
+    deps = tuple(
+        normalized_prefix_signature(g, d, memo) for d in g.dependencies[target]
+    )
+    if any(d is None for d in deps):
+        memo[target] = None
+        return None
+    out = ("node", sig, deps)
+    memo[target] = out
+    return out
+
+
+@dataclasses.dataclass
+class SharingPlan:
+    """The cross-pipeline pass's output (plain data; pickles with the
+    multi-tenant applier into replica clones)."""
+
+    #: tenant -> {NodeId: normalized prefix signature} for pooled nodes
+    node_sigs: Dict[str, Dict[G.NodeId, tuple]]
+    #: every pooled signature
+    shared: frozenset
+    #: signature -> number of tenant graphs containing it
+    consumers: Dict[tuple, int]
+    #: how many shared candidates the collision gate refused
+    refused: int
+
+    def sigs_for(self, tenants) -> Dict[tuple, int]:
+        """Per-signature consumer counts restricted to one flush's
+        tenants — the ``begin_flush`` declaration."""
+        out: Dict[tuple, int] = {}
+        for t in set(tenants):
+            for sig in set(self.node_sigs.get(t, {}).values()):
+                out[sig] = out.get(sig, 0) + 1
+        return {s: n for s, n in out.items() if n >= 2}
+
+    def shared_stage_count(self) -> int:
+        return len(self.shared)
+
+
+def plan_sharing(graphs: Dict[str, G.Graph]) -> SharingPlan:
+    """Plan cross-pipeline stage sharing over co-served tenant graphs.
+
+    Single-tenant (or no overlap) degenerates to an empty plan — the
+    executor path is then byte-identical to the pre-pool walk (pinned
+    by tests/test_multitenant.py)."""
+    from keystone_tpu.obs import metrics
+
+    per_node: Dict[str, Dict[G.NodeId, tuple]] = {}
+    sig_tenants: Dict[tuple, set] = {}
+    for tenant, g in graphs.items():
+        memo: dict = {}
+        sigs: Dict[G.NodeId, tuple] = {}
+        for n in g.topological_nodes():
+            op = g.operators[n]
+            # pooled values are stage OUTPUTS a later stage consumes:
+            # transformer applications and gathers; datasets/datums are
+            # literals and estimator nodes never appear in frozen graphs
+            if not isinstance(op, (G.TransformerOperator, G.GatherOperator)):
+                continue
+            s = normalized_prefix_signature(g, n, memo)
+            if s is None or s == _SOURCE:
+                continue
+            sigs[n] = s
+            sig_tenants.setdefault(s, set()).add(tenant)
+        per_node[tenant] = sigs
+    shared = {s for s, ts in sig_tenants.items() if len(ts) >= 2}
+    if not shared:
+        return SharingPlan({t: {} for t in graphs}, frozenset(), {}, 0)
+
+    # ---- admission gate: the PR-6 collision pass over the UNION graph
+    from keystone_tpu.analysis.signatures import collision_signatures
+
+    union = reduce(lambda a, b: a.union(b)[0], graphs.values(), G.Graph())
+    colliding = collision_signatures(union)
+    refused = 0
+    if colliding:
+        admitted = set()
+        for s in shared:
+            # s = ("node", op.signature(), deps); op.signature() wraps
+            # the object signature as ("transform"|"fit", obj_sig)
+            obj_sig = s[1][1] if len(s[1]) == 2 else None
+            if obj_sig in colliding or _prefix_tainted(s, colliding):
+                refused += 1
+            else:
+                admitted.add(s)
+        shared = admitted
+    if refused:
+        metrics.inc("serve.pool_refusals", refused)
+
+    # ---- keep the sharing frontier only
+    frontier: set = set()
+    for tenant, g in graphs.items():
+        sigs = per_node[tenant]
+        for n, s in sigs.items():
+            if s not in shared:
+                continue
+            deps_on_n = g.dependents(n)
+            if not deps_on_n or any(
+                isinstance(d, G.SinkId) or sigs.get(d) not in shared
+                for d in deps_on_n
+            ):
+                frontier.add(s)
+    node_sigs = {
+        tenant: {n: s for n, s in sigs.items() if s in frontier}
+        for tenant, sigs in per_node.items()
+    }
+    consumers = {
+        s: len(sig_tenants[s]) for s in frontier if s in sig_tenants
+    }
+    return SharingPlan(node_sigs, frozenset(frontier), consumers, refused)
+
+
+def _prefix_tainted(psig: tuple, colliding: set) -> bool:
+    """Does any stage in the prefix signature carry a colliding object
+    signature?  A safe frontier over a poisoned interior stage would
+    still share the poisoned computation."""
+    if not isinstance(psig, tuple) or not psig or psig[0] != "node":
+        return False
+    op_sig = psig[1]
+    if (
+        isinstance(op_sig, tuple)
+        and len(op_sig) == 2
+        and op_sig[1] in colliding
+    ):
+        return True
+    return any(_prefix_tainted(d, colliding) for d in psig[2])

@@ -55,6 +55,9 @@ class GraphExecutor:
         profile: bool = False,
         node_retries: Optional[int] = None,
         deadline=None,
+        stage_pool=None,
+        pool_token=None,
+        pool_sigs=None,
     ):
         """``node_retries``: re-run a failed stage up to this many times
         before propagating (SURVEY §5 "failure detection/elastic
@@ -78,7 +81,19 @@ class GraphExecutor:
         ``optional=True`` / ``with_fallback``, degraded — like any
         transient fault.  With neither a deadline nor
         ``KEYSTONE_BREAKER_THRESHOLD`` configured the per-stage cost is
-        one ``None`` check (no watchdog thread, no breaker lookup)."""
+        one ``None`` check (no watchdog thread, no breaker lookup).
+
+        ``stage_pool``/``pool_token``/``pool_sigs``: the cross-pipeline
+        shared-stage tier (ISSUE 14 — the cache-ownership inversion).
+        Per-run memoization stays in ``self.results`` exactly as
+        before, but nodes listed in ``pool_sigs`` (``{NodeId:
+        normalized prefix signature}``, planned by ``workflow/cross.py``)
+        additionally read through and publish into the process-wide
+        :class:`~keystone_tpu.workflow.stage_pool.SharedStagePool`
+        under ``(signature, pool_token)`` — so co-served tenant walks
+        over the same flush compute each shared prefix ONCE, and a pool
+        hit prunes the whole prefix sub-walk.  All three default to
+        None/empty: the pre-pool walk is byte-identical (pinned)."""
         from keystone_tpu.utils import guard
 
         self.graph = graph
@@ -93,6 +108,12 @@ class GraphExecutor:
         self.deadline = guard.as_deadline(deadline)
         self._stage_seconds = guard.stage_deadline_seconds()
         self._breaker_threshold = guard.stage_breaker_threshold()
+        #: the shared-stage tier is active only when ALL THREE are
+        #: given: a pool without a token could leak results across
+        #: different request batches
+        self._pool = stage_pool if pool_token is not None else None
+        self._pool_token = pool_token
+        self._pool_sigs: Dict[G.NodeId, tuple] = dict(pool_sigs or {})
 
     def execute(self, target: G.GraphId):
         if isinstance(target, G.SinkId):
@@ -107,6 +128,20 @@ class GraphExecutor:
                 f"unbound source {target}: apply the pipeline to data before executing"
             )
         op = self.graph.operators[target]
+        # shared-stage pool read-through BEFORE the dep walk: a hit on
+        # the sharing frontier prunes the whole prefix sub-walk (that
+        # pruning IS the multi-tenant win — the first co-served tenant
+        # computed it this flush).  Key = (content-addressed prefix
+        # signature, flush token): results can never leak across
+        # different request batches.
+        pool_sig = (
+            self._pool_sigs.get(target) if self._pool is not None else None
+        )
+        if pool_sig is not None:
+            hit, pooled = self._pool.get((pool_sig, self._pool_token))
+            if hit:
+                self.results[target] = pooled
+                return pooled
         deps = [self._eval(d) for d in self.graph.dependencies[target]]
         from keystone_tpu.obs import ledger, metrics
         from keystone_tpu.utils import guard
@@ -245,6 +280,12 @@ class GraphExecutor:
             if self.profile:
                 _sync_expr(result)
                 self.timings[target] = time.perf_counter() - t0
+        if pool_sig is not None and not degraded:
+            # publish for the flush's co-served tenants.  NEVER publish
+            # a degraded result: a substitute's output is this run's
+            # compromise, not the stage's value — sharing it would
+            # silently degrade every other tenant too.
+            self._pool.put((pool_sig, self._pool_token), result)
         if not getattr(op, "no_memoize", False):
             # no_memoize nodes (over the HBM budget — workflow/profiling.py)
             # recompute per consumer instead of pinning their output

@@ -214,6 +214,25 @@ def device_hbm_budget(fraction: float = 0.5) -> int:
     return int((16 << 30) * fraction)
 
 
+def pool_budget_bytes(fraction: float = 0.25) -> int:
+    """The shared stage pool's default HBM budget
+    (``workflow/stage_pool.py``): a quarter of the device limit by
+    default — the pool holds transient per-flush featurized outputs
+    NEXT TO every tenant's resident model weights and the serve
+    batches, so it gets a deliberately smaller slice than the fit-time
+    cache budget.  ``KEYSTONE_POOL_BUDGET_BYTES`` overrides outright
+    (the eviction tests provoke pressure on small data with it)."""
+    import os
+
+    env = os.environ.get("KEYSTONE_POOL_BUDGET_BYTES", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            logger.warning("KEYSTONE_POOL_BUDGET_BYTES=%r is not an int", env)
+    return device_hbm_budget(fraction=fraction)
+
+
 #: Footprint estimate of the LAST ProfilingAutoCacheRule pass, read by
 #: Pipeline.fit's auto-out-of-core decision (workflow/pipeline.py §
 #: _auto_out_of_core).  A module global rather than a graph annotation:

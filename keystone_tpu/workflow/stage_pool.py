@@ -1,0 +1,319 @@
+"""Process-wide shared stage pool: cross-pipeline stage-result cache.
+
+KeystoneML's headline optimization (ICDE 2017 §4) is common-subexpression
+elimination plus cost-based cache placement — but both ran *per
+pipeline*.  A multi-tenant serving fleet runs many pipelines over the
+same featurization prefix (one SIFT/FV/Nyström front end feeding N
+heads), and per-pipeline CSE recomputes that prefix once per tenant per
+request batch.  This module inverts stage-result cache ownership: the
+per-run :class:`~keystone_tpu.workflow.executor.GraphExecutor` memo
+stays (it is the per-walk working set), but results of stages marked
+shareable by the cross-pipeline pass (``workflow/cross.py``) are read
+through and published into ONE process-wide pool, so co-served tenants
+compute each shared prefix once per flush.
+
+Keying is content-addressed, riding the existing ``signature()``
+machinery end to end::
+
+    entry key = (normalized prefix signature, flush token)
+
+- the **prefix signature** is the structural hash of the stage and its
+  whole input subgraph (``Graph.prefix_signature`` semantics with
+  sources normalized), i.e. *what* is computed — two tenants' SIFT
+  prefixes share it exactly when CSE would have merged them inside one
+  pipeline;
+- the **flush token** identifies *which data* the stage ran over — the
+  multi-tenant batcher stamps one token per combined flush, so entries
+  can never leak across different request batches (and a hedged/healed
+  re-run of the same flush shares the token and therefore the work).
+
+Lifecycle: :meth:`SharedStagePool.begin_flush` declares the flush's
+per-signature consumer counts (how many co-flushed tenants contain the
+stage); each hit decrements the entry's remaining-consumer refcount and
+the entry is freed at zero (HBM is returned as soon as the last tenant
+has read it, not at flush end); :meth:`SharedStagePool.end_flush` drops
+whatever is left.  Publishing past the byte budget evicts — unpinned
+first, least-recently-used first — and an evicted-but-needed entry is
+simply a miss: the consumer recomputes (counted, never wrong).
+``pin``/``auto_pin`` implement the ProfilingAutoCacheRule placement
+discipline at pool granularity: the signatures whose byte estimates
+earn their residency under the budget are evicted last.
+
+Safety is the PR-6 signature-collision pass: the cross-pipeline planner
+runs it over the UNION of co-served graphs and refuses to mark any
+stage whose signature collides (equal signature, observably different
+state) — a refused stage is counted (``serve.pool_refusals``) and runs
+per-tenant, never shared, never wrong.
+
+Thread-safety: one lock around the entry map; stage *computation* runs
+outside it (tenant walks of one flush are sequential on the replica
+worker, and distinct flushes never share a token, so there is no
+same-key compute race to arbitrate).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from typing import Dict, Optional, Tuple
+
+from keystone_tpu.obs import metrics
+
+#: in-process pool lookup by token: replica clones are pickle
+#: round-trips WITHIN one process (serve/fleet), and a private pool
+#: holds a lock — unpicklable — so the applier serializes the token and
+#: the clone re-resolves the same pool here.  Weak values: a retired
+#: pool dies with its service (cross-process unpickles miss and fall
+#: back to the default pool).
+_POOL_REGISTRY: "weakref.WeakValueDictionary[int, SharedStagePool]" = (
+    weakref.WeakValueDictionary()
+)
+_POOL_TOKENS = itertools.count(1)
+
+
+def pool_by_token(token) -> Optional["SharedStagePool"]:
+    """The live pool registered under ``token`` (None: unknown/dead —
+    the caller falls back to :func:`default_pool`)."""
+    if token is None:
+        return None
+    return _POOL_REGISTRY.get(token)
+
+#: the pool key: (normalized prefix signature, flush token)
+PoolKey = Tuple[tuple, object]
+
+
+def expr_nbytes(expr) -> int:
+    """Byte estimate of one pooled stage result (the eviction unit):
+    the device array's real footprint for dataset results, 0 for
+    host/stream results (they hold no HBM worth accounting)."""
+    ds = getattr(expr, "dataset", None)
+    if ds is None:
+        return 0
+    try:
+        if ds.is_host:
+            return 0
+        arr = ds.array
+        return int(arr.size) * int(arr.dtype.itemsize)
+    except Exception:
+        return 0
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "remaining", "last_use", "sig")
+
+    def __init__(self, value, nbytes: int, remaining: int, sig):
+        self.value = value
+        self.nbytes = int(nbytes)
+        self.remaining = int(remaining)
+        self.last_use = time.monotonic()
+        self.sig = sig
+
+
+class SharedStagePool:
+    """Bounded, refcounted, process-wide stage-result cache.
+
+    ``budget_bytes``: one HBM budget for every resident entry (default:
+    ``workflow.profiling.pool_budget_bytes()`` — a fraction of the real
+    device limit, leaving the serve batches and model weights their
+    room).  ``name`` labels the pool's gauges."""
+
+    def __init__(self, budget_bytes: Optional[int] = None, name: str = "serve"):
+        if budget_bytes is None:
+            from keystone_tpu.workflow.profiling import pool_budget_bytes
+
+            budget_bytes = pool_budget_bytes()
+        self.budget_bytes = int(budget_bytes)
+        self.name = name
+        #: in-process identity for clone re-resolution (pool_by_token)
+        self.token = next(_POOL_TOKENS)
+        _POOL_REGISTRY[self.token] = self
+        self._lock = threading.Lock()
+        self._entries: Dict[PoolKey, _Entry] = {}
+        self._bytes = 0
+        #: signatures pinned by the placement decision: evicted last
+        self._pinned: set = set()
+        #: token -> {sig: consumer count} declared by begin_flush
+        self._flushes: Dict[object, Dict[tuple, int]] = {}
+        #: observed output bytes per signature (feeds auto_pin)
+        self.sig_bytes: Dict[tuple, int] = {}
+        #: per-signature registered tenant counts (live tenants whose
+        #: graph contains the signature) — refcounts ACROSS tenants, as
+        #: opposed to the per-flush remaining-consumer counts
+        self._sig_tenants: Dict[tuple, set] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------- registration
+    def register_tenant(self, tenant: str, sigs) -> None:
+        """Declare a live tenant's shareable signatures (service
+        construction).  An entry whose signature has no registered
+        tenant left is first in line for eviction."""
+        with self._lock:
+            for s in sigs:
+                self._sig_tenants.setdefault(s, set()).add(tenant)
+
+    def unregister_tenant(self, tenant: str) -> None:
+        with self._lock:
+            for s, owners in list(self._sig_tenants.items()):
+                owners.discard(tenant)
+                if not owners:
+                    del self._sig_tenants[s]
+
+    def sig_refcount(self, sig) -> int:
+        """How many registered tenants share ``sig`` right now."""
+        with self._lock:
+            return len(self._sig_tenants.get(sig, ()))
+
+    # ------------------------------------------------------------ pinning
+    def pin(self, sig) -> None:
+        with self._lock:
+            self._pinned.add(sig)
+
+    def auto_pin(self, budget_fraction: float = 0.5) -> int:
+        """Greedy pin placement under a fraction of the pool budget —
+        the AutoCacheRule discipline at pool granularity: signatures
+        ranked by compute saved per byte pinned, approximated as
+        (consumers − 1) / observed bytes, admitted until the pin budget
+        is spent.  Needs observed byte estimates (a primed flush or the
+        first live one).  Returns how many signatures were pinned."""
+        with self._lock:
+            budget = self.budget_bytes * max(0.0, min(1.0, budget_fraction))
+            ranked = sorted(
+                (
+                    (s, b)
+                    for s, b in self.sig_bytes.items()
+                    if len(self._sig_tenants.get(s, ())) >= 2
+                ),
+                key=lambda sb: -(
+                    (len(self._sig_tenants.get(sb[0], ())) - 1)
+                    / max(sb[1], 1)
+                ),
+            )
+            self._pinned.clear()
+            spent = 0
+            for s, b in ranked:
+                if spent + b > budget:
+                    continue
+                spent += b
+                self._pinned.add(s)
+            return len(self._pinned)
+
+    # ------------------------------------------------------ flush lifecycle
+    def begin_flush(self, token, sig_consumers: Dict[tuple, int]) -> None:
+        """Declare one combined flush: ``sig_consumers`` maps each
+        shareable signature to the number of co-flushed tenants whose
+        graph contains it (the per-entry refcount ceiling)."""
+        with self._lock:
+            self._flushes[token] = dict(sig_consumers)
+
+    def end_flush(self, token) -> None:
+        """Drop the flush's declaration and any leftover entries (a
+        consumer pruned deeper in the walk never read them)."""
+        with self._lock:
+            self._flushes.pop(token, None)
+            for key in [k for k in self._entries if k[1] == token]:
+                self._drop(key)
+            metrics.set_gauge("serve.pool_bytes", float(self._bytes))
+
+    # ----------------------------------------------------------- get / put
+    def get(self, key: PoolKey):
+        """``(hit, value)`` — a hit decrements the entry's remaining
+        consumer count and frees it at zero."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                metrics.inc("serve.pool_misses")
+                return False, None
+            self.hits += 1
+            e.last_use = time.monotonic()
+            e.remaining -= 1
+            value = e.value
+            if e.remaining <= 0:
+                self._drop(key)
+            metrics.inc("serve.pool_hits")
+            metrics.set_gauge("serve.pool_bytes", float(self._bytes))
+            return True, value
+
+    def put(self, key: PoolKey, value, nbytes: Optional[int] = None) -> bool:
+        """Publish one computed stage result.  Returns False (and stores
+        nothing) when the flush declared no further consumer for the
+        signature, or when the entry alone exceeds the whole budget."""
+        sig, token = key
+        if nbytes is None:
+            nbytes = expr_nbytes(value)
+        with self._lock:
+            self.sig_bytes[sig] = int(nbytes)
+            consumers = self._flushes.get(token, {}).get(sig, 1)
+            remaining = consumers - 1  # the producer is a consumer too
+            if remaining <= 0:
+                return False
+            if nbytes > self.budget_bytes:
+                # one entry bigger than the whole budget: never resident
+                self.evictions += 1
+                metrics.inc("serve.pool_evictions")
+                return False
+            self._evict_until(self.budget_bytes - int(nbytes))
+            self._entries[key] = _Entry(value, nbytes, remaining, sig)
+            self._bytes += int(nbytes)
+            metrics.set_gauge("serve.pool_bytes", float(self._bytes))
+            return True
+
+    # ----------------------------------------------------------- internals
+    def _drop(self, key: PoolKey) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+
+    def _evict_until(self, budget: int) -> None:
+        """Must hold the lock.  Evict until resident bytes fit
+        ``budget``: entries whose signature has no registered tenant
+        first, then unpinned LRU, then pinned LRU (only when nothing
+        else is left — pinned is a priority, not an exemption)."""
+        if self._bytes <= budget:
+            return
+        order = sorted(
+            self._entries.items(),
+            key=lambda kv: (
+                len(self._sig_tenants.get(kv[1].sig, ())) > 0,
+                kv[1].sig in self._pinned,
+                kv[1].last_use,
+            ),
+        )
+        for key, e in order:
+            if self._bytes <= budget:
+                return
+            self._drop(key)
+            self.evictions += 1
+            metrics.inc("serve.pool_evictions")
+
+    # -------------------------------------------------------------- status
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "resident_bytes": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pinned_sigs": len(self._pinned),
+                "registered_sigs": len(self._sig_tenants),
+            }
+
+
+#: the process-wide default pool (the "one HBM budget" of the design);
+#: services may construct private pools (tests do)
+_DEFAULT: Optional[SharedStagePool] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_pool() -> SharedStagePool:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SharedStagePool()
+        return _DEFAULT

@@ -672,3 +672,130 @@ def test_cli_export_publishes_registry_version(tmp_path, exported):
     # the published pair actually serves from the artifact tier
     ap = fitted.freeze()
     assert ap.install_artifacts(arts) == len(BUCKETS)
+
+
+# ------------------------------------------- pre-seeded compile cache tier
+def test_export_captures_and_seeds_compile_cache(tmp_path, monkeypatch):
+    """With a persistent compile cache active, export_artifacts ships
+    the backend-compile cache entries alongside the bucket programs;
+    seed_compile_cache installs them byte-identically on a fresh host's
+    cache dir — the ladder's last cold rung."""
+    import jax
+
+    from keystone_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+        seed_compile_cache,
+    )
+
+    cache_dir = str(tmp_path / "xla-cache")
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache(cache_dir)
+        # a UNIQUE pipeline (fresh weights → fresh HLO): a program this
+        # process already compiled hits jax's in-memory cache and never
+        # touches the on-disk cache, so capture finds nothing to ship
+        bundle = _pipeline(seed=41).freeze().export_artifacts(
+            example=_example(), buckets=BUCKETS
+        )
+        cache_ents = {
+            k: e
+            for k, e in bundle["manifest"]["entries"].items()
+            if e.get("kind") == "compile_cache"
+        }
+        assert cache_ents, "active cache during export must capture entries"
+        for k, e in cache_ents.items():
+            assert e["file"].startswith("cache")
+            assert bundle["blobs"][k]
+        shipped = {e["name"]: bundle["blobs"][k] for k, e in cache_ents.items()}
+
+        # a "fresh host": empty cache dir — seeding installs the files
+        fresh = str(tmp_path / "fresh-cache")
+        jax.config.update("jax_compilation_cache_dir", fresh)
+        os.makedirs(fresh, exist_ok=True)
+        n = seed_compile_cache(bundle)
+        assert n == len(cache_ents)
+        for name, data in shipped.items():
+            with open(os.path.join(fresh, name), "rb") as f:
+                assert f.read() == data
+        # idempotent: a second seed never clobbers (and writes nothing)
+        assert seed_compile_cache(bundle) == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_cache_entries_never_register_as_bucket_programs(tmp_path):
+    """install_artifacts skips compile-cache entries: only row-keyed
+    bucket programs register, and the bundle stays install-compatible
+    with pre-seed readers (rows entries unchanged)."""
+    import jax
+
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache(str(tmp_path / "xla-cache"))
+        frozen = _pipeline().freeze()
+        bundle = frozen.export_artifacts(example=_example(), buckets=BUCKETS)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    target = _pipeline().freeze()
+    # identical pipeline params → identical signature; install succeeds
+    n = target.install_artifacts(
+        bundle, signature=bundle["manifest"]["signature"]
+    )
+    assert n == len(BUCKETS)
+    assert target.installed_buckets() == len(BUCKETS)
+
+
+def test_registry_roundtrips_cache_entries(tmp_path):
+    """Cache entries ride the registry's durable artifact layout like
+    any other blob (checksummed, corrupt-tolerant)."""
+    import jax
+
+    from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compilation_cache(str(tmp_path / "xla-cache"))
+        pipe = _pipeline(seed=42)  # unique HLO: see the capture test
+        bundle = pipe.freeze().export_artifacts(
+            example=_example(), buckets=BUCKETS
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    n_cache = sum(
+        1
+        for e in bundle["manifest"]["entries"].values()
+        if e.get("kind") == "compile_cache"
+    )
+    assert n_cache >= 1
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v = reg.publish(pipe, artifacts=bundle)
+    loaded = reg.load_artifacts(v)
+    got_cache = {
+        k: e
+        for k, e in loaded["manifest"]["entries"].items()
+        if e.get("kind") == "compile_cache"
+    }
+    assert len(got_cache) == n_cache
+    for k in got_cache:
+        assert loaded["blobs"][k] == bundle["blobs"][k]
+
+
+def test_export_without_cache_ships_no_cache_entries(monkeypatch):
+    """No active persistent cache → the bundle simply has no cache
+    rung (and nothing fails)."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        bundle = _pipeline().freeze().export_artifacts(
+            example=_example(), buckets=BUCKETS
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    assert not any(
+        e.get("kind") == "compile_cache"
+        for e in bundle["manifest"]["entries"].values()
+    )

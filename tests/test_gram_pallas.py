@@ -171,3 +171,130 @@ def test_block_kernel_matrix_routes_through_pallas(monkeypatch):
     km2 = BlockKernelMatrix(OtherKernel(), x, block_size=16)
     out = np.asarray(km2.column_block(0))
     assert calls == [] and (out == 1.0).all()
+
+
+# --------------------------------------------- polynomial / linear kernels
+def test_poly_pallas_matches_generator_f32():
+    from keystone_tpu.models.kernel_ridge import PolynomialKernelGenerator
+    from keystone_tpu.ops.gram_pallas import poly_block_pallas
+
+    x, z = _setup(d=10)
+    gen = PolynomialKernelGenerator(degree=3, alpha=0.5, c=1.25)
+    ref = np.asarray(gen(x, z))
+    got = np.asarray(
+        poly_block_pallas(x, z, 0.5, 1.25, 3, interpret=True)
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_poly_and_linear_xla_fallback_bit_identical():
+    """The dispatcher's CPU path IS the generator for the new kernels
+    too — solver-grade and scoring variants both."""
+    from keystone_tpu.models.kernel_ridge import (
+        LinearKernelGenerator,
+        PolynomialKernelGenerator,
+    )
+    from keystone_tpu.ops.gram_pallas import (
+        linear_gram_block,
+        poly_gram_block,
+    )
+
+    x, z = _setup()
+    for solver_grade in (True, False):
+        pg = PolynomialKernelGenerator(
+            degree=2, alpha=0.7, c=0.3, solver_grade=solver_grade
+        )
+        np.testing.assert_array_equal(
+            np.asarray(
+                poly_gram_block(
+                    x, z, alpha=0.7, c=0.3, degree=2,
+                    solver_grade=solver_grade, use_pallas=False,
+                )
+            ),
+            np.asarray(pg(x, z)),
+        )
+        lg = LinearKernelGenerator(solver_grade=solver_grade)
+        np.testing.assert_array_equal(
+            np.asarray(
+                linear_gram_block(
+                    x, z, solver_grade=solver_grade, use_pallas=False
+                )
+            ),
+            np.asarray(lg(x, z)),
+        )
+
+
+def test_linear_rides_poly_megakernel_identity():
+    """linear = poly at (α=1, c=0, degree=1): the interpret-mode kernel
+    matches the generator to f32 rounding."""
+    from keystone_tpu.models.kernel_ridge import LinearKernelGenerator
+    from keystone_tpu.ops.gram_pallas import poly_block_pallas
+
+    x, z = _setup(d=8)
+    ref = np.asarray(LinearKernelGenerator()(x, z))
+    got = np.asarray(poly_block_pallas(x, z, 1.0, 0.0, 1, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_gram_block_for_routes_every_first_class_generator(monkeypatch):
+    """The generator-dispatch entry covers Gaussian, polynomial, and
+    linear under one gating; unknown generators return None (caller
+    falls back to the generator itself)."""
+    from keystone_tpu.models.kernel_ridge import (
+        LinearKernelGenerator,
+        PolynomialKernelGenerator,
+    )
+
+    x, z = _setup(d=8)
+    # off-pallas: bit-identical to each generator
+    for gen in (
+        GaussianKernelGenerator(0.2),
+        PolynomialKernelGenerator(degree=2, alpha=0.9, c=0.1),
+        LinearKernelGenerator(),
+    ):
+        got = gram_pallas.gram_block_for(gen, x, z, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(gen(x, z)))
+
+    class Duck:
+        def __call__(self, a, b):
+            return jnp.zeros((a.shape[0], b.shape[0]), jnp.float32)
+
+    assert gram_pallas.gram_block_for(Duck(), x, z) is None
+
+
+def test_block_kernel_matrix_routes_poly_and_linear(monkeypatch):
+    """BlockKernelMatrix rides the poly megakernel for the new
+    generators on capable backends (same gating as Gaussian)."""
+    from keystone_tpu.models.kernel_matrix import BlockKernelMatrix
+    from keystone_tpu.models.kernel_ridge import (
+        LinearKernelGenerator,
+        PolynomialKernelGenerator,
+    )
+
+    calls = []
+    orig = gram_pallas.poly_block_pallas
+
+    def interp(xa, za, alpha, c, degree, interpret=False, mxu="f32"):
+        calls.append((alpha, c, degree, mxu))
+        return orig(xa, za, alpha, c, degree, interpret=True, mxu=mxu)
+
+    monkeypatch.setattr(gram_pallas, "poly_block_pallas", interp)
+    monkeypatch.setattr(gram_pallas, "pallas_supported", lambda x=None: True)
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(48, 8)).astype(np.float32))
+    pg = PolynomialKernelGenerator(degree=2, alpha=0.5, c=1.0)
+    km = BlockKernelMatrix(pg, x, block_size=16)
+    col = np.asarray(km.column_block(0))
+    assert calls == [(0.5, 1.0, 2, "f32")]
+    np.testing.assert_allclose(col, np.asarray(pg(x, x[:16])), rtol=1e-5, atol=1e-5)
+
+    calls.clear()
+    km2 = BlockKernelMatrix(LinearKernelGenerator(), x, block_size=16)
+    np.testing.assert_allclose(
+        np.asarray(km2.column_block(1)),
+        np.asarray(LinearKernelGenerator()(x, x[16:32])),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    assert calls == [(1.0, 0.0, 1, "f32")]

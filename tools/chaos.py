@@ -251,6 +251,70 @@ def _serve_artifacts(tmp, restarts):
         svc.close()
 
 
+def _tenants(tmp, restarts):
+    """Multi-tenant blast-radius isolation: two tenants sharing a
+    featurization prefix behind one fleet, a wave of traffic per tenant
+    under the active plan.  Tenant-targeted plans
+    (``serve.enqueue:ctx.tenant=a:raise`` /
+    ``serve.batch:ctx.tenant=a:raise``) may fail tenant ``a``'s
+    requests — every failure must be TYPED (no hung future), and
+    tenant ``b``'s wave must complete 100% clean: one tenant's
+    poison/overload can never shed another's traffic.  Raises (chaos
+    exit 1) on any cross-tenant failure or unresolved future."""
+    import numpy as np
+
+    from keystone_tpu.serve import serve_multi
+    from tools.serve_bench import build_tenant_models
+
+    dim = 16
+    models = build_tenant_models(tenants=2, dim=dim, branches=3)
+    # chaos plans say ctx.tenant=a / ctx.tenant=b
+    models = {"a": models.pop("t0"), "b": models.pop("t1")}
+    svc = serve_multi(
+        models,
+        max_batch=8,
+        max_wait_ms=2.0,
+        queue_bound=64,
+        example=np.zeros((dim,), np.float32),
+        name="chaos_tenants",
+    )
+    rng = np.random.default_rng(5)
+    xs = rng.normal(size=(24, dim)).astype(np.float32)
+    try:
+        futs = {"a": [], "b": []}
+        for i in range(xs.shape[0]):
+            for t in ("a", "b"):
+                try:
+                    futs[t].append(svc.submit(xs[i], tenant=t))
+                except Exception:
+                    # admission refusal IS a typed terminal (the
+                    # targeted tenant's faults land here too)
+                    futs[t].append(None)
+        b_failures = 0
+        for t, fs in futs.items():
+            for f in fs:
+                if f is None:
+                    if t == "b":
+                        b_failures += 1
+                    continue
+                try:
+                    y = np.asarray(f.result(timeout=30.0))
+                    if not np.all(np.isfinite(y)):
+                        raise RuntimeError(f"tenant {t} non-finite result")
+                except RuntimeError:
+                    raise
+                except Exception:
+                    if t == "b":
+                        b_failures += 1
+        if b_failures:
+            raise RuntimeError(
+                f"cross-tenant blast radius: {b_failures} tenant-b "
+                "request(s) failed under a tenant-a-targeted plan"
+            )
+    finally:
+        svc.close()
+
+
 WORKLOADS = {
     "bcd": _bcd,
     "ooc": _ooc,
@@ -258,6 +322,7 @@ WORKLOADS = {
     "lbfgs": _lbfgs,
     "stream": _stream,
     "serve_artifacts": _serve_artifacts,
+    "tenants": _tenants,
 }
 
 

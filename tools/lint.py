@@ -24,7 +24,11 @@ Rules:
   name: an interpolated name (f-string/concat/``.format``) or an
   underscore-delimited integer segment (``serve.replica_0_flushes``)
   mints one metric series per entity, fragmenting dashboards and
-  unbounding the registry — both are violations;
+  unbounding the registry — both are violations.  Tenant-scoped names
+  (any ``tenant`` word segment, e.g. ``serve.tenant_submitted``) must
+  additionally carry a ``tenant=`` label at the record site: tenant
+  fan-out rides ``{tenant=}`` labels, never interpolated or
+  per-tenant metric names;
 - ``metric-kind``  — one metric name is used as one instrument kind
   across the whole tree (the static twin of
   ``obs.metrics.MetricKindError``);
@@ -101,6 +105,13 @@ METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: labels, one name per quantity (digits glued to a word — ``bf16``,
 #: ``p99`` — are fine)
 METRIC_INDEX_SEGMENT_RE = re.compile(r"(^|_)\d+(_|$)")
+
+#: a tenant-scoped metric name (any ``tenant`` word in a segment:
+#: ``serve.tenant_submitted``): per-tenant fan-out must ride a
+#: ``tenant=`` LABEL on the same call — a tenant name baked into the
+#: metric name (or a tenant-scoped series recorded without its label)
+#: mints/merges series per tenant and fragments every dashboard
+METRIC_TENANT_WORD_RE = re.compile(r"(^|[._])tenants?(_|$|\.)")
 
 #: metrics-registry write methods → instrument kind
 _METRIC_KINDS = {
@@ -466,6 +477,23 @@ def lint_source(
                             "into the name — per-replica/per-entity "
                             "fan-out must ride labels (one name per "
                             "quantity)",
+                        )
+                    )
+                elif (
+                    METRIC_TENANT_WORD_RE.search(mname)
+                    and recv[1] != "remove_gauge"
+                    and not any(kw.arg == "tenant" for kw in node.keywords)
+                    and not _allowed(lines, lineno, "metric-name")
+                ):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "metric-name",
+                            f"tenant-scoped metric {mname!r} recorded "
+                            "without a tenant= label — per-tenant "
+                            "fan-out rides {tenant=} labels, never the "
+                            "metric name",
                         )
                     )
                 kind = _METRIC_KINDS[recv[1]]
