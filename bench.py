@@ -228,6 +228,32 @@ TENANT_QPS = float(os.environ.get("BENCH_TENANT_QPS", "12000"))
 TENANT_ROUNDS = int(os.environ.get("BENCH_TENANT_ROUNDS", "3"))
 TENANT_BRANCHES = int(os.environ.get("BENCH_TENANT_BRANCHES", "12"))
 TENANT_MAX_BATCH = int(os.environ.get("BENCH_TENANT_MAX_BATCH", "64"))
+
+# --- process fleet leg (ISSUE 15): thread-vs-process A/B on a
+# COMPUTE-BOUND workload — a deterministic pure-Python featurizer that
+# holds the GIL (like real tokenize/ngram stages), offered above
+# capacity so achieved QPS measures capacity.  Worker threads serialize
+# on the GIL through that stage; worker processes compute in parallel,
+# so on an N-core host the process fleet's speedup approaches
+# min(workers, cores) while threads stay pinned near 1 core.  The leg
+# reports the scheduler-affinity core count and gates the >= 1.8x
+# acceptance only where >= 2 cores exist (a 1-core host cannot express
+# the claim; there the gate is process overhead <= 30%).  Thread/
+# process predictions must match bit-for-bit, and the autoscale
+# sub-leg must scale 1 -> N under open-loop load and back down idle
+# with zero dropped or hung requests.  NOTE: the PR-8 fleet leg above
+# is STALL-dominated by construction (batch_delay_ms is an injected,
+# GIL-RELEASING sleep) — its fleet_speedup measures router concurrency
+# over emulated device stalls and was never a multi-core hardware
+# claim; THIS leg is the multi-core compute claim.
+PROC_LEGS = int(os.environ.get("BENCH_PROC_LEGS", "1"))
+PROC_WORKERS = int(os.environ.get("BENCH_PROC_WORKERS", "2"))
+PROC_QPS = float(os.environ.get("BENCH_PROC_QPS", "2500"))
+PROC_ROUNDS = int(os.environ.get("BENCH_PROC_ROUNDS", "3"))
+PROC_DURATION_S = float(os.environ.get("BENCH_PROC_DURATION", "2.5"))
+PROC_BURN_ROUNDS = int(os.environ.get("BENCH_PROC_BURN", "2000"))
+AUTOSCALE_QPS = float(os.environ.get("BENCH_AUTOSCALE_QPS", "2000"))
+AUTOSCALE_DURATION_S = float(os.environ.get("BENCH_AUTOSCALE_DURATION", "4"))
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -913,6 +939,30 @@ def main():
         )
         return
 
+    if "--leg-serve-procs" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                {
+                    "procs_ab": serve_bench.run_procs_ab(
+                        qps=PROC_QPS,
+                        duration=PROC_DURATION_S,
+                        rounds=PROC_ROUNDS,
+                        workers=PROC_WORKERS,
+                        burn_rounds=PROC_BURN_ROUNDS,
+                    ),
+                    "autoscale": serve_bench.run_autoscale_scenario(
+                        qps=AUTOSCALE_QPS,
+                        duration=AUTOSCALE_DURATION_S,
+                        max_workers=max(2, PROC_WORKERS),
+                        burn_rounds=PROC_BURN_ROUNDS,
+                    ),
+                }
+            )
+        )
+        return
+
     if "--leg-serve-artifacts" in sys.argv:
         from tools import serve_bench
 
@@ -1146,6 +1196,16 @@ def main():
         else None
     )
 
+    # process fleet leg (ISSUE 15): thread-vs-process A/B on the
+    # compute-bound workload + the 1→N→1 autoscale scenario
+    proc_leg = (
+        subprocess_leg(
+            "--leg-serve-procs", required=("procs_ab", "autoscale")
+        )
+        if PROC_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1308,7 +1368,23 @@ def main():
                 fv["fleet_speedup"] = round(
                     float(fv["achieved_qps"]) / single, 2
                 )
+        # the honest framing of fleet_speedup (PR-8's report implied a
+        # hardware-scaling claim; it never was one): the emulated model
+        # is an injected GIL-RELEASING sleep, so the ratio measures
+        # router/queue concurrency over device stalls.  Multi-core
+        # COMPUTE scaling is the serve_procs section's claim.
+        fv["scaling_note"] = (
+            "stall-dominated by construction (batch_delay_ms releases "
+            "the GIL): measures router concurrency, not multi-core "
+            "compute — see serve_procs for the compute-bound claim"
+        )
         out["serve_fleet"] = fv
+    if proc_leg:
+        # the ISSUE-15 acceptance: >= 1.8x thread->process speedup on a
+        # compute-bound workload where >= 2 cores exist (cores_limited
+        # marks hosts that cannot express the claim), bit-identical
+        # predictions, and a clean 1→N→1 autoscale scenario
+        out["serve_procs"] = proc_leg
     if hedge_leg:
         # p99_ratio < 1 = hedging rescued the straggler's queue;
         # qps_cost <= 0.05 = the acceptance budget

@@ -91,6 +91,28 @@ def _serve_main(argv) -> int:
         "to the least-loaded replica whose breaker admits work",
     )
     ap.add_argument(
+        "--workers",
+        type=int,
+        default=0,
+        help="PROCESS fleet (serve/procfleet.py): serve with this many "
+        "one-replica worker processes instead of worker threads — each "
+        "loads the model + AOT artifacts, primes, and computes applies "
+        "over a shared-memory wire, so a multi-core host's throughput "
+        "is bounded by cores, not the GIL.  0 (default) = the threaded "
+        "fleet.  Exclusive with --replicas > 1; single-tenant only.",
+    )
+    ap.add_argument(
+        "--autoscale",
+        default=None,
+        metavar="MIN:MAX",
+        help="SLO-driven autoscaling (serve/autoscale.py): a control "
+        "thread watches windowed occupancy, queue depth, SLO burn, and "
+        "the shared-pool hit rate, growing the fleet to MAX under "
+        "pressure, retiring idle workers down to MIN, and retuning the "
+        "dispatch window live (visible in GET /statusz).  Pair with "
+        "--workers (the floor spawns as processes).",
+    )
+    ap.add_argument(
         "--watch",
         type=float,
         default=None,
@@ -239,14 +261,31 @@ def _serve_main(argv) -> int:
 
         shape = tuple(int(d) for d in args.example_shape.split(","))
         example = np.zeros(shape, np.float32)
+    autoscale = None
+    if args.autoscale:
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            autoscale = dict(min_workers=int(lo), max_workers=int(hi))
+        except ValueError:
+            ap.error("--autoscale takes MIN:MAX (e.g. 1:4)")
+    if args.workers and args.replicas > 1:
+        ap.error("--workers (process fleet) and --replicas are exclusive")
+    if args.workers and multi:
+        ap.error("--workers is single-tenant only (the shared stage "
+                 "pool needs in-process walks)")
+    fleet_kw = (
+        dict(workers=args.workers)
+        if args.workers
+        else dict(replicas=args.replicas)
+    )
     serve_kw = dict(
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
         queue_bound=args.queue_bound,
         deadline_ms=args.deadline_ms,
         example=example,
-        replicas=args.replicas,
         recorder=not args.no_recorder,
+        **fleet_kw,
         slo_ms=args.slo_ms,
         slo_target=args.slo_target,
         supervise=not args.no_supervise,
@@ -255,6 +294,7 @@ def _serve_main(argv) -> int:
         restart_window_s=args.restart_window_s,
         hedge_ms=args.hedge_ms,
         bisect=not args.no_bisect,
+        autoscale=autoscale,
     )
     registry = None
     artifacts = None

@@ -71,6 +71,7 @@ DEFAULT_KEEP_SEGMENTS = 8
 #: a key here when introducing a genuinely new attribute; a one-off
 #: escape is a trailing ``# lint: allow-attr``.
 ATTR_VOCABULARY = {
+    "action",
     "apply_seconds",
     "attempt",
     "attempts",
@@ -94,11 +95,13 @@ ATTR_VOCABULARY = {
     "it",
     "key",
     "late",
+    "leader",
     "n",
     "no_memoize_demotions",
     "node",
     "node_id",
     "objective",
+    "occupancy",
     "outcome",
     "path",
     "pause_seconds",
@@ -136,6 +139,7 @@ ATTR_VOCABULARY = {
     "to_replica",
     "version",
     "waited_seconds",
+    "workers",
 }
 
 #: per-process run discriminator: time.time() alone has 1-second

@@ -10,11 +10,23 @@ whether or not a service exists in the process (pinned by
 tests/test_serve.py).
 """
 
+from keystone_tpu.serve.autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    Autoscaler,
+    Signals,
+)
 from keystone_tpu.serve.fleet import (  # noqa: F401
     FleetUnavailable,
     Replica,
     ReplicaPool,
     ReplicaSupervisor,
+)
+from keystone_tpu.serve.procfleet import (  # noqa: F401
+    ProcessReplica,
+    RemoteApplier,
+    WorkerCrashed,
+    WorkerHandle,
+    WorkerSpawnError,
 )
 from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
 from keystone_tpu.serve.registry import (  # noqa: F401
@@ -38,8 +50,16 @@ from keystone_tpu.serve.tenants import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
     "FleetUnavailable",
     "HttpFrontend",
+    "ProcessReplica",
+    "RemoteApplier",
+    "Signals",
+    "WorkerCrashed",
+    "WorkerHandle",
+    "WorkerSpawnError",
     "ModelRegistry",
     "MultiTenantApplier",
     "MultiTenantService",

@@ -51,6 +51,18 @@ The single-replica default (``replicas=1``, no devices) wraps the given
 pipeline's applier directly — no clone, no placement — so the PR-5
 service behavior, program counts, and byte-identity pins are exactly
 unchanged.
+
+**Backends (ISSUE 15).**  ``backend="thread"`` (default) is everything
+above.  ``backend="process"`` keeps this module's entire control plane
+— the router, flow control, swap, supervision — and swaps each slot's
+COMPUTE for a worker process (``serve/procfleet.py``): ``_build_one``
+spawns a :class:`~keystone_tpu.serve.procfleet.ProcessReplica` from a
+staged deploy-payload file (one per generation; workers load + prime
+from it), generations move the payload at ``commit()``, and
+``add_replica``/``remove_replica``/``set_window`` give the autoscaler
+its levers.  A replica's worker-thread queue/claim semantics are
+IDENTICAL in both backends — the parent thread blocks in the wire
+protocol's ``recv`` (GIL released) while the child computes.
 """
 
 from __future__ import annotations
@@ -224,6 +236,13 @@ class Replica:
         #: how many times this SLOT has been restarted (carried onto
         #: replacements by the supervisor, so /statusz shows history)
         self.restarts = 0
+        #: pool-installed callback for a crash-handler flush that can no
+        #: longer be requeued here (the slot was drained/retired in the
+        #: race window): the pool re-dispatches it onto a survivor so
+        #: its riders never strand.  None = requeue-in-place only (the
+        #: pre-process-fleet behavior; the threaded crash handler always
+        #: wins the race because is_dead() needs the thread EXITED).
+        self.on_stranded: Optional[Callable] = None
         self._q: list = []
         self._cond = threading.Condition()
         self._worker: Optional[threading.Thread] = None
@@ -272,48 +291,66 @@ class Replica:
 
         def loop():
             ledger.restore_context(obs_context)
-            while True:
-                with self._cond:
-                    while not self._q:
-                        self._cond.wait()
-                    item = self._q.pop(0)
-                if item is _SENTINEL:
-                    return
-                self.inflight = item
-                self.heartbeat.beat()
-                try:
-                    # the worker-level fault site: a ``raise`` here is a
-                    # WORKER CRASH (the thread dies; the in-hand flush is
-                    # requeued at the front so the supervisor's
-                    # replacement serves it — zero futures lost), and a
-                    # ``hang`` wedges the worker (inflight set, heartbeat
-                    # going stale) for the supervisor to detect.
-                    fault_point("serve.worker", replica=self.index)
-                    runner(self, item)
-                except BaseException as e:
-                    # anything escaping here is a worker crash from
-                    # BEFORE the runner claimed the flush (the injected
-                    # serve.worker fault, or a pre-claim bug — the
-                    # runner fails its own riders for post-claim
-                    # escapes), so the front-requeue is always safe:
-                    # the supervisor's replacement worker pops it with
-                    # the claim intact and serves it.  The thread
-                    # exits; the supervisor detects the death via
-                    # is_dead().
+            try:
+                while True:
                     with self._cond:
-                        self._q.insert(0, item)
-                    self.inflight = None
-                    self.dead_error = f"{type(e).__name__}: {e}"
-                    self.dead = True
-                    logger.error(
-                        "replica %d worker crashed: %s",
-                        self.index,
-                        self.dead_error,
-                    )
-                    return
-                finally:
-                    self.inflight = None
+                        while not self._q:
+                            self._cond.wait()
+                        item = self._q.pop(0)
+                    if item is _SENTINEL:
+                        return
+                    self.inflight = item
                     self.heartbeat.beat()
+                    try:
+                        # the worker-level fault site: a ``raise`` here is a
+                        # WORKER CRASH (the thread dies; the in-hand flush is
+                        # requeued at the front so the supervisor's
+                        # replacement serves it — zero futures lost), and a
+                        # ``hang`` wedges the worker (inflight set, heartbeat
+                        # going stale) for the supervisor to detect.
+                        fault_point("serve.worker", replica=self.index)
+                        runner(self, item)
+                    except BaseException as e:
+                        # anything escaping here is a worker crash whose
+                        # flush is safely re-runnable: either it was never
+                        # claimed (the injected serve.worker fault, a
+                        # pre-claim bug — the runner fails its own riders
+                        # for ordinary post-claim escapes), or the runner
+                        # un-claimed it before re-raising (a WorkerCrashed
+                        # process death).  Front-requeue so the
+                        # supervisor's replacement pops it next — UNLESS
+                        # the slot was already drained/retired (a
+                        # process-death sweep can win that race): then
+                        # hand it to the pool's stranded re-dispatch so
+                        # its riders never hang in a dead queue.  The
+                        # thread exits; the supervisor detects the death
+                        # via is_dead().
+                        with self._cond:
+                            if not self._retired or self.on_stranded is None:
+                                # requeue in place: the normal path (a
+                                # live slot — the replacement pops it),
+                                # and the no-callback fallback for a
+                                # retired slot (join() collects it for
+                                # the caller to fail typed — never
+                                # dropped on the floor)
+                                self._q.insert(0, item)
+                                item = None
+                        self.inflight = None
+                        self.dead_error = f"{type(e).__name__}: {e}"
+                        self.dead = True
+                        logger.error(
+                            "replica %d worker crashed: %s",
+                            self.index,
+                            self.dead_error,
+                        )
+                        if item is not None and self.on_stranded is not None:
+                            self.on_stranded(item)
+                        return
+                    finally:
+                        self.inflight = None
+                        self.heartbeat.beat()
+            finally:
+                self._on_worker_exit()
 
         self._worker = threading.Thread(
             target=loop,
@@ -321,6 +358,10 @@ class Replica:
             name=f"{self.pool_name}-replica{self.index}",
         )
         self._worker.start()
+
+    def _on_worker_exit(self) -> None:
+        """Worker-thread exit hook (sentinel drain or crash) — no-op
+        for thread replicas; process replicas reap their child here."""
 
     def enqueue(self, batch) -> None:
         with self._cond:
@@ -405,6 +446,8 @@ class ReplicaPool:
         dispatch_window: int = 2,
         heartbeat_s: float = DEFAULT_HEARTBEAT_SECONDS,
         artifacts: Optional[dict] = None,
+        backend: str = "thread",
+        worker_opts: Optional[dict] = None,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -412,7 +455,23 @@ class ReplicaPool:
             raise ValueError(
                 f"dispatch_window must be >= 1, got {dispatch_window}"
             )
+        if backend not in ("thread", "process"):
+            raise ValueError(
+                f"backend must be 'thread' or 'process', got {backend!r}"
+            )
+        if backend == "process" and devices is not None:
+            raise ValueError(
+                "backend='process' owns device placement in the workers; "
+                "devices= applies to the thread backend only"
+            )
         self.name = name
+        #: replica backend: "thread" (the PR-8..14 in-process fleet,
+        #: byte-for-byte) or "process" (serve/procfleet.py — one worker
+        #: process per replica over the shared-memory wire protocol)
+        self.backend = backend
+        #: process-backend knobs (buckets/item_shape/dtype prime the
+        #: worker at spawn; ready_timeout bounds spawn→ready)
+        self._worker_opts = dict(worker_opts or {})
         self._lock = threading.Lock()
         #: the fitted pipeline (or applier) the CURRENT generation was
         #: built from — the supervisor re-clones replacement replicas
@@ -458,13 +517,90 @@ class ReplicaPool:
         self._draining = False
         self._runner: Optional[Callable] = None
         self._obs_ctx = None
+        self._on_stranded: Optional[Callable] = None
         self.version = version
-        self.replicas: List[Replica] = self._build(
-            pipeline, int(replicas), devices, version
-        )
+        #: process backend: the staged deploy-payload files workers
+        #: load (one per generation; swept with the pool)
+        self._payload_dir: Optional[str] = None
+        self._payload_seq = 0
+        self._payload_path: Optional[str] = None
+        self._staged_payload_path: Optional[str] = None
+        if backend == "process":
+            import tempfile
+
+            self._payload_dir = tempfile.mkdtemp(prefix=f"ksw-{name}-")
+            self._payload_path = self._stage_payload(pipeline, artifacts)
+        try:
+            self.replicas: List[Replica] = self._build(
+                pipeline, int(replicas), devices, version
+            )
+        except BaseException:
+            # a failed build leaves no pool handle to close(): sweep the
+            # staged payload dir here (spawned workers were already
+            # reaped by _build_process_many's error path)
+            if self._payload_dir is not None:
+                import shutil
+
+                shutil.rmtree(self._payload_dir, ignore_errors=True)
+                self._payload_dir = None
+            raise
 
     # ------------------------------------------------------------ build
+    def _stage_payload(self, source, artifacts) -> str:
+        """Write one generation's worker deploy payload (process
+        backend): workers of the generation — initial, staged,
+        scale-up, supervisor heals — all load this one file."""
+        from keystone_tpu.serve.procfleet import stage_payload
+
+        self._payload_seq += 1
+        return stage_payload(
+            self._payload_dir, self._payload_seq, source, artifacts
+        )
+
+    def _build_process_one(
+        self, index: int, version: str, payload_path: Optional[str] = None
+    ) -> Replica:
+        """Spawn one worker process and wrap it in a routing slot.
+        The worker loads + primes from the staged payload; the ready
+        handshake bounds the wait."""
+        from keystone_tpu.serve import procfleet
+
+        opts = self._worker_opts
+        t0 = time.monotonic()
+        handle = procfleet.WorkerHandle(
+            self.name,
+            index,
+            payload_path or self._payload_path,
+            buckets=opts.get("buckets"),
+            item_shape=opts.get("item_shape"),
+            dtype=opts.get("dtype"),
+            ready_timeout=opts.get(
+                "ready_timeout", procfleet.DEFAULT_READY_TIMEOUT_S
+            ),
+            max_slab_bytes=opts.get(
+                "max_slab_bytes", procfleet.wire.DEFAULT_MAX_SLAB_BYTES
+            ),
+        )
+        metrics.observe(
+            "serve.worker_spawn_seconds", time.monotonic() - t0
+        )
+        installed = int(handle.ready_info.get("artifact_buckets", 0))
+        if installed:
+            metrics.inc("serve.artifact_hits", installed)
+        elif self._artifacts or self._staged_artifacts:
+            metrics.inc("serve.artifact_fallbacks")
+        return procfleet.ProcessReplica(
+            index,
+            handle,
+            version=version,
+            pool_name=self.name,
+            heartbeat_timeout=self._heartbeat_s,
+        )
+
     def _devices_for(self, n: int, devices) -> list:
+        if self.backend == "process":
+            # workers own their devices; the router holds no placement
+            return [None] * n
         if devices is not None:
             devices = list(devices)
             if not devices:
@@ -480,6 +616,7 @@ class ReplicaPool:
     def _build_one(
         self, source, index: int, device, version, n: int,
         force_clone: bool = False, artifacts=_SENTINEL,
+        payload_path: Optional[str] = None,
     ) -> Replica:
         """One replica for slot ``index``: the direct-wrap fast path for
         a 1-replica deviceless pool, the clone+place path otherwise —
@@ -489,7 +626,14 @@ class ReplicaPool:
         and two threads must never share transformer instances / jit
         caches).  ``artifacts`` (default: the pool's current bundle):
         AOT bucket programs installed into the fresh applier — a failed
-        install NEVER fails the build; the replica compiles instead."""
+        install NEVER fails the build; the replica compiles instead.
+        Process backend: spawn a worker from ``payload_path`` (default:
+        the live generation's staged payload) — cloning/placement/
+        artifact install all happen inside the worker."""
+        if self.backend == "process":
+            return self._build_process_one(
+                index, version, payload_path=payload_path
+            )
         if device is None and n == 1 and not force_clone:
             applier = _as_applier(source)
         else:
@@ -548,7 +692,41 @@ class ReplicaPool:
             metrics.inc("serve.artifact_hits", n)
         return n
 
+    def _build_process_many(
+        self, n: int, version: str, payload_path: Optional[str]
+    ) -> List[Replica]:
+        """Spawn a whole generation's workers CONCURRENTLY: each pays a
+        fresh interpreter + runtime import + prime, and paying them
+        serially would make construction and swap wall-clock ~n× one
+        cold start.  On any spawn failure the already-ready workers are
+        reaped before the error propagates — no half-born generation."""
+        if n == 1:
+            return [self._build_process_one(0, version, payload_path)]
+        from concurrent.futures import ThreadPoolExecutor
+
+        results: List[Optional[Replica]] = [None] * n
+        errors: List[BaseException] = []
+
+        def one(i: int) -> None:
+            try:
+                results[i] = self._build_process_one(
+                    i, version, payload_path
+                )
+            except BaseException as e:
+                errors.append(e)
+
+        with ThreadPoolExecutor(max_workers=n) as ex:
+            list(ex.map(one, range(n)))
+        if errors:
+            for r in results:
+                if r is not None:
+                    r.handle.shutdown()
+            raise errors[0]
+        return [r for r in results if r is not None]
+
     def _build(self, pipeline, n: int, devices, version) -> List[Replica]:
+        if self.backend == "process":
+            return self._build_process_many(n, version, self._payload_path)
         devs = self._devices_for(n, devices)
         return [
             self._build_one(pipeline, i, dev, version, n)
@@ -568,16 +746,25 @@ class ReplicaPool:
         return self._artifacts is not None
 
     # ----------------------------------------------------------- router
-    def start(self, runner: Callable, obs_context=None) -> None:
+    def start(
+        self, runner: Callable, obs_context=None, on_stranded=None
+    ) -> None:
         """Start every replica worker; ``runner(replica, batch)`` is the
         service's flush body (shed + pad + apply + resolve futures).
         ``obs_context`` (a ``ledger.capture_context`` token) is restored
         in every worker — including staged generations built later — so
-        span parenting survives the replica threads."""
+        span parenting survives the replica threads.  ``on_stranded``:
+        the service's re-dispatch for a crash-handler flush whose slot
+        was drained in the race window (process backend)."""
         self._runner = runner
         self._obs_ctx = obs_context
+        self._on_stranded = on_stranded
         for r in self.replicas:
-            r.start(runner, obs_context)
+            self._start_replica(r)
+
+    def _start_replica(self, r: Replica) -> None:
+        r.on_stranded = self._on_stranded
+        r.start(self._runner, self._obs_ctx)
 
     def dispatch(self, batch) -> Replica:
         """Route one batch: least outstanding work first among
@@ -808,7 +995,14 @@ class ReplicaPool:
         and :meth:`commit` makes it the pool's bundle for later heals."""
         devices = [r.device for r in self.replicas]
         n = len(devices)
-        if n == 1 and devices[0] is None:
+        if self.backend == "process":
+            # a fresh generation of workers off a fresh payload,
+            # spawned concurrently: the old workers keep serving their
+            # (already-loaded) payload throughout
+            path = self._stage_payload(pipeline, artifacts)
+            staged = self._build_process_many(n, version, path)
+            self._staged_payload_path = path
+        elif n == 1 and devices[0] is None:
             # staged single-replica generations still clone: the OLD
             # generation keeps serving the caller's applier while the
             # staged one primes, so they must not share jit caches
@@ -837,7 +1031,7 @@ class ReplicaPool:
         self._staged_artifacts_set = True
         if self._runner is not None:
             for r in staged:
-                r.start(self._runner, self._obs_ctx)
+                self._start_replica(r)
         return staged
 
     def commit(self, staged: List[Replica], version: str) -> float:
@@ -877,6 +1071,21 @@ class ReplicaPool:
                     self._artifacts = self._staged_artifacts
                     self._staged_artifacts = None
                     self._staged_artifacts_set = False
+                if self._staged_payload_path is not None:
+                    # the worker payload moves with the generation:
+                    # future heals/scale-ups spawn from the new file.
+                    # The old file is unlinked — its workers loaded it
+                    # long ago.
+                    old_payload = self._payload_path
+                    self._payload_path = self._staged_payload_path
+                    self._staged_payload_path = None
+                    if old_payload and old_payload != self._payload_path:
+                        try:
+                            import os
+
+                            os.unlink(old_payload)
+                        except OSError:
+                            pass
                 # a fresh generation is healthy by construction: clear
                 # the unavailability hint so admission re-opens
                 self._known_unavailable = False
@@ -913,13 +1122,14 @@ class ReplicaPool:
             n = len(self.replicas)
             source, version = self._source, self.version
             artifacts = self._artifacts
+            payload = self._payload_path
         fresh = self._build_one(
             source, old.index, old.device, version, n, force_clone=True,
-            artifacts=artifacts,
+            artifacts=artifacts, payload_path=payload,
         )
         fresh.restarts = old.restarts + 1
         if self._runner is not None:
-            fresh.start(self._runner, self._obs_ctx)
+            self._start_replica(fresh)
         return fresh
 
     def adopt_replacement(self, old: Replica, fresh: Replica):
@@ -989,6 +1199,121 @@ class ReplicaPool:
         metrics.set_gauge("serve.quarantined", 1.0, replica=replica.index)
         return replica.drain_queue()
 
+    # ---------------------------------------------------------- scaling
+    @property
+    def window(self) -> int:
+        return self._window
+
+    def set_window(self, n: int) -> int:
+        """Retune the dispatch window live (the autoscaler's second
+        lever): raising it deepens per-replica queueing before the
+        batcher blocks; lowering it tightens backpressure.  Returns the
+        clamped value.  Waiters are woken — a batcher blocked at the
+        old window re-evaluates immediately."""
+        n = max(1, int(n))
+        with self._cond:
+            self._window = n
+            self._cond.notify_all()
+        metrics.set_gauge("serve.dispatch_window", float(n))
+        return n
+
+    def next_index(self) -> int:
+        with self._lock:
+            taken = {r.index for r in self.replicas}
+        i = 0
+        while i in taken:
+            i += 1
+        return i
+
+    def add_replica(self, primer: Optional[Callable] = None) -> Replica:
+        """Grow the fleet by one: build (spawn, for the process
+        backend) → ``primer(replica)`` (the service's bucket prime) →
+        admit under the router lock.  The new slot takes the lowest
+        free index and a fresh CLOSED breaker.  Build/prime happen
+        OUTSIDE the router lock — the live fleet keeps serving."""
+        with self._lock:
+            if self._draining:
+                raise RuntimeError(
+                    f"pool {self.name!r} is closing; scale-up refused"
+                )
+            n = len(self.replicas)
+            source, version = self._source, self.version
+            artifacts = self._artifacts
+            payload = self._payload_path
+        index = self.next_index()
+        device = None
+        if self.backend == "thread" and any(
+            r.device is not None for r in self.replicas
+        ):
+            import jax
+
+            local = jax.local_devices()
+            device = local[index % len(local)]
+        fresh = self._build_one(
+            source, index, device, version, n + 1, force_clone=True,
+            artifacts=artifacts, payload_path=payload,
+        )
+        if self._runner is not None:
+            self._start_replica(fresh)
+        if primer is not None:
+            try:
+                primer(fresh)
+            except BaseException:
+                fresh.retire()
+                raise
+        with self._cond:
+            if self._draining:
+                admitted = False
+            else:
+                self.replicas.append(fresh)
+                self._known_unavailable = False
+                admitted = True
+                self._cond.notify_all()
+        if not admitted:
+            fresh.retire()
+            raise RuntimeError(
+                f"pool {self.name!r} closed during scale-up"
+            )
+        metrics.set_gauge("serve.workers", float(self.size))
+        return fresh
+
+    def remove_replica(self, timeout: float = 30.0) -> Optional[List]:
+        """Shrink the fleet by one — gracefully: the HIGHEST-index
+        routable replica leaves the routing list under the router lock
+        (no new work lands on it), then drains its already-queued
+        flushes and exits; the child process (process backend) is
+        reaped by the worker-exit hook.  Returns flushes left behind by
+        a worker that would not drain within ``timeout`` (the caller
+        re-dispatches or fails them), or None when the fleet is already
+        at one replica (the floor — a pool never scales to zero)."""
+        with self._cond:
+            cands = [r for r in self.replicas if r.routable()]
+            if len(cands) <= 1 or len(self.replicas) <= 1:
+                return None
+            victim = max(cands, key=lambda r: r.index)
+            self.replicas.remove(victim)
+            self._cond.notify_all()
+        victim.retire()
+        left = victim.join(max(0.1, float(timeout)))
+        if victim._worker is not None and victim._worker.is_alive():
+            # the victim would not drain within the timeout (a wedged
+            # apply): it left the routing list at retire, the
+            # supervisor skips retired slots, and a thread backend has
+            # no child to kill — surface the in-hand flush so the
+            # caller resolves its riders instead of stranding them
+            # forever.  (Process backend: join already killed the
+            # child, so this path is thread-only.)
+            stuck = victim.inflight
+            if stuck is not None:
+                left.append(stuck)
+        for gauge in ("serve.replica_outstanding", "serve.replica_queue_share"):
+            try:
+                metrics.REGISTRY.remove_gauge(gauge, replica=victim.index)
+            except Exception:
+                pass
+        metrics.set_gauge("serve.workers", float(self.size))
+        return left
+
     # ------------------------------------------------------------ close
     def begin_drain(self) -> None:
         """Release a ``dispatch`` blocked at the dispatch window: with
@@ -1004,7 +1329,9 @@ class ReplicaPool:
 
     def close(self, timeout: float = 30.0) -> List:
         """Retire and join every replica; returns batches abandoned by
-        wedged workers (the service fails their futures)."""
+        wedged workers (the service fails their futures).  Process
+        backend: each replica's join reaps its child (bye → join →
+        terminate → kill), and the staged payload files are swept."""
         self.begin_drain()
         with self._lock:
             replicas = list(self.replicas)
@@ -1014,6 +1341,11 @@ class ReplicaPool:
         deadline = time.monotonic() + timeout
         for r in replicas:
             abandoned.extend(r.join(max(0.1, deadline - time.monotonic())))
+        if self._payload_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._payload_dir, ignore_errors=True)
+            self._payload_dir = None
         return abandoned
 
     def statuses(self) -> List[dict]:
@@ -1240,34 +1572,14 @@ class ReplicaSupervisor:
 
     def _redistribute(self, flushes: List, replica: Replica, why: str) -> None:
         """Re-dispatch flushes stranded on a healed/quarantined/raced
-        slot onto the survivors.  A copy that is no longer QUEUED is
-        skipped entirely — its claimed winner (a hedge twin, the old
-        worker itself) owns delivery, and failing its riders here would
-        503 requests another replica is about to answer.  Window limits
-        are ignored: extra queueing on a living survivor beats failing
-        admitted work.  Only when NO routable replica exists do the
-        riders fail typed."""
-        svc = self.service
+        slot onto the survivors — the service's single shared
+        stranded-work policy (``_handle_stranded_flush``): skip claimed
+        copies, window-ignoring hedge dispatch, typed failure (aborted
+        first) only when no routable survivor exists."""
         for flush in flushes:
-            unflushed = getattr(flush, "unflushed", None)
-            if unflushed is not None and not unflushed():
-                continue  # claimed/done/aborted elsewhere: not ours
-            target = svc._pool.hedge_dispatch(
-                flush, exclude_index=None, respect_window=False
+            self.service._handle_stranded_flush(
+                flush, why=f"replica {replica.index} {why}"
             )
-            if target is None:
-                # abort BEFORE failing: left QUEUED, a still-pending
-                # hedge timer could resurrect the flush onto a later-
-                # healed replica and spend device time on riders
-                # already answered 503
-                getattr(flush, "abort", lambda: False)()
-                svc.fail_flush(
-                    flush,
-                    FleetUnavailable(
-                        f"replica {replica.index} {why} and no routable "
-                        "survivor could absorb its queue"
-                    ),
-                )
 
     def _abandon(self, flush, replica: Replica, reason: str) -> None:
         """Fail a wedged worker's in-hand flush so its callers unblock.

@@ -163,6 +163,11 @@ class _Handler(BaseHTTPRequestHandler):
                     "max_batch": svc.max_batch,
                     "buckets": list(svc.buckets),
                     "version": svc.version,
+                    # process-fleet visibility: backend + worker count,
+                    # so a balancer (or operator curl) sees the fleet
+                    # shape without parsing the per-replica list
+                    "backend": svc._pool.backend,
+                    "workers": svc.replicas,
                     "replicas": svc.replica_statuses(),
                 },
                 headers=(

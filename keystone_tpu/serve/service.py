@@ -117,6 +117,18 @@ once per flush.  The tenant hooks below (``_resolve_tenant``,
 ``_check_bound_locked``, ``_push_locked``, ``_account_tenant``, ...)
 are inert on this base class — the single-tenant path is unchanged.
 
+**Process fleet + autoscaling (ISSUE 15)** — ``workers=N`` promotes
+replica COMPUTE into worker processes (``serve/procfleet.py`` over the
+``serve/wire.py`` shared-memory protocol) behind this same control
+plane, so a multi-core host's throughput is bounded by cores, not the
+GIL; a worker death mid-flush raises :class:`WorkerCrashed`, the flush
+is un-claimed and requeued, and the supervisor's replacement serves it
+— zero lost futures.  ``autoscale={...}`` starts a
+:class:`~keystone_tpu.serve.autoscale.Autoscaler` control thread that
+resizes the fleet (``scale_to``) and retunes the dispatch window from
+windowed occupancy, queue depth, SLO burn, and the shared-pool hit
+rate.  ``workers=0`` (default) is the threaded path, byte-for-byte.
+
 The HTTP front end is ``keystone_tpu/serve/http.py``; the CLI entry is
 ``python -m keystone_tpu.cli serve``; the load generator is
 ``tools/serve_bench.py``.
@@ -144,6 +156,7 @@ from keystone_tpu.serve.fleet import (
     ReplicaPool,
     ReplicaSupervisor,
 )
+from keystone_tpu.serve.procfleet import WorkerCrashed
 from keystone_tpu.utils import guard
 
 logger = logging.getLogger(__name__)
@@ -320,6 +333,19 @@ class _Flush:
                 return True
             return False
 
+    def unclaim(self) -> bool:
+        """Return a RUNNING flush to QUEUED — the process-death path
+        ONLY: the claiming runner's worker died before any result was
+        produced or delivered, so a front-requeue plus a fresh claim on
+        the supervisor's replacement re-runs it safely (already-resolved
+        riders are skipped by the delivery paths).  True when the claim
+        was actually returned."""
+        with self._lock:
+            if self._state == _Flush.RUNNING:
+                self._state = _Flush.QUEUED
+                return True
+            return False
+
 
 class _HedgeMonitor:
     """A single timer thread watching dispatched-but-unflushed flushes:
@@ -407,11 +433,27 @@ class PipelineService:
         hedge_ms: Optional[float] = None,
         bisect: bool = True,
         artifacts: Optional[dict] = None,
+        workers: int = 0,
+        worker_opts: Optional[dict] = None,
+        autoscale: Optional[dict] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if queue_bound < 1:
             raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        workers = int(workers or 0)
+        if workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
+        if workers > 0 and replicas != 1:
+            raise ValueError(
+                "workers= (process fleet) and replicas= (thread fleet) "
+                "are exclusive; pass exactly one"
+            )
+        if workers > 0 and devices is not None:
+            raise ValueError(
+                "workers= owns device placement in the worker processes; "
+                "devices= applies to the thread fleet only"
+            )
         # the persistent-compile-cache tier of the prime fallback ladder
         # (artifact → cache → compile): auto-enabled for library callers
         # too, not just the CLI entry points.  Env-gated
@@ -429,6 +471,44 @@ class PipelineService:
             # replica primes, so the first deploy on a fresh host skips
             # the backend compile of the deserialized modules too
             seed_compile_cache(artifacts)
+        # the bucket/shape contract is resolved BEFORE the pool builds:
+        # process workers prime their padding buckets at spawn, so the
+        # worker_opts must carry the final bucket set and item shape
+        self.max_batch = int(max_batch)
+        self.buckets = (
+            tuple(sorted({int(b) for b in buckets}))
+            if buckets
+            else default_buckets(self.max_batch)
+        )
+        if self.buckets[-1] < self.max_batch:
+            # a flush larger than every bucket would have nowhere to pad
+            self.buckets = self.buckets + (self.max_batch,)
+        #: admission-time shape/dtype contract, learned from ``example``
+        #: (or the first request): a mismatched request fails ITS submit,
+        #: never the whole batch it would have ridden in
+        self._item_shape: Optional[tuple] = None
+        self._dtype = None
+        if example is not None:
+            ex = np.asarray(example)
+            self._item_shape = tuple(ex.shape)
+            self._dtype = ex.dtype
+        #: process fleet (workers > 0): replicas are worker PROCESSES
+        #: behind the same router — multi-core compute stops measuring
+        #: the GIL.  workers == 0 is the PR-14 threaded path, untouched.
+        self.workers = workers
+        if workers > 0:
+            pool_backend = "process"
+            replicas = workers
+            pool_worker_opts = dict(worker_opts or {})
+            pool_worker_opts.setdefault("buckets", list(self.buckets))
+            pool_worker_opts.setdefault("item_shape", self._item_shape)
+            pool_worker_opts.setdefault(
+                "dtype",
+                None if self._dtype is None else np.dtype(self._dtype).str,
+            )
+        else:
+            pool_backend = "thread"
+            pool_worker_opts = None
         self._pool = ReplicaPool(
             pipeline,
             replicas=replicas,
@@ -437,6 +517,8 @@ class PipelineService:
             name=name,
             heartbeat_s=heartbeat_s,
             artifacts=artifacts,
+            backend=pool_backend,
+            worker_opts=pool_worker_opts,
         )
         #: the flight recorder: True (default) = a fresh bounded
         #: recorder, False/None = tracing fully off (request ids stay
@@ -471,17 +553,8 @@ class PipelineService:
         #: restored in the batcher and every replica worker, so ledger
         #: spans emitted there nest under the constructor's open span
         self._obs_ctx = ledger.capture_context()
-        self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
         self.queue_bound = int(queue_bound)
-        self.buckets = (
-            tuple(sorted({int(b) for b in buckets}))
-            if buckets
-            else default_buckets(self.max_batch)
-        )
-        if self.buckets[-1] < self.max_batch:
-            # a flush larger than every bucket would have nowhere to pad
-            self.buckets = self.buckets + (self.max_batch,)
         self.default_deadline_s = (
             None if not deadline_ms else float(deadline_ms) / 1000.0
         )
@@ -497,11 +570,6 @@ class PipelineService:
         #: serializes concurrent swap() calls (watcher + admin endpoint)
         self._swap_lock = threading.Lock()
         self._swap_seq = 0
-        #: admission-time shape/dtype contract, learned from ``example``
-        #: (or the first request): a mismatched request fails ITS submit,
-        #: never the whole batch it would have ridden in
-        self._item_shape: Optional[tuple] = None
-        self._dtype = None
         #: batch-failure bisection (poison-request isolation) on the
         #: flush error path; the quarantine cache short-circuits repeat
         #: offenders at admission (content-keyed, LRU-bounded)
@@ -509,11 +577,12 @@ class PipelineService:
         self._poison_cache: "OrderedDict[bytes, float]" = OrderedDict()
         self._poison_lock = threading.Lock()
         if example is not None:
-            ex = np.asarray(example)
-            self._item_shape = tuple(ex.shape)
-            self._dtype = ex.dtype
             self.prime()
-        self._pool.start(self._run_flush, obs_context=self._obs_ctx)
+        self._pool.start(
+            self._run_flush,
+            obs_context=self._obs_ctx,
+            on_stranded=self._handle_stranded_flush,
+        )
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name=f"{name}-batcher"
         )
@@ -544,6 +613,24 @@ class PipelineService:
             if supervise
             else None
         )
+        #: SLO-driven autoscaling (default OFF): ``autoscale=`` is a
+        #: config dict for :class:`~keystone_tpu.serve.autoscale.
+        #: Autoscaler` (min_workers/max_workers/interval_s/...), whose
+        #: control thread adds workers under queue/SLO pressure,
+        #: retires idle ones, and retunes the dispatch window live
+        self.autoscaler = None
+        if autoscale:
+            from keystone_tpu.serve.autoscale import Autoscaler
+
+            try:
+                self.autoscaler = Autoscaler(self, **dict(autoscale)).start()
+            except BaseException:
+                # a bad autoscale config must not leak the already-built
+                # fleet (live worker PROCESSES for the process backend,
+                # plus the batcher/supervisor threads) with no handle
+                self.close(drain=False, timeout=10.0)
+                raise
+        metrics.set_gauge("serve.workers", float(self._pool.size))
 
     # ------------------------------------------------------------ priming
     def prime(self, replicas=None, have_artifacts: Optional[bool] = None) -> None:
@@ -668,6 +755,33 @@ class PipelineService:
         for req in flush.riders:
             self._fail(req, exc, batch=flush.bid)
 
+    def _handle_stranded_flush(
+        self, flush, why: str = "replica died"
+    ) -> None:
+        """THE stranded-work re-dispatch policy — one copy, shared by
+        the crash-handler race path, scale-down leftovers, and the
+        supervisor's heal/quarantine redistribution: a copy that is no
+        longer QUEUED is skipped (its claimed winner owns delivery);
+        otherwise re-dispatch onto a survivor, window ignored — extra
+        queueing on a living replica beats failing admitted work; only
+        with NO routable survivor do the riders fail typed, aborted
+        FIRST so a pending hedge timer can never resurrect a flush
+        whose riders were already answered."""
+        if not getattr(flush, "unflushed", lambda: False)():
+            return  # claimed/done/aborted elsewhere: not ours to place
+        target = self._pool.hedge_dispatch(
+            flush, exclude_index=None, respect_window=False
+        )
+        if target is None:
+            getattr(flush, "abort", lambda: False)()
+            self.fail_flush(
+                flush,
+                FleetUnavailable(
+                    f"{why} and no routable survivor could absorb "
+                    "its queue"
+                ),
+            )
+
     # ------------------------------------------------------------ hedging
     def _hedge_delay_s(self) -> float:
         """The re-dispatch delay: the configured floor, lifted to a
@@ -783,6 +897,27 @@ class PipelineService:
     def _queue_depth_locked(self) -> int:
         return len(self._q)
 
+    # ------------------------------------------------------- dedup hooks
+    # In-flight request dedup (serve/tenants.py enables it): identical
+    # concurrent payloads are computed once and fanned out.  Every hook
+    # is inert on the base service — zero cost on the single-tenant
+    # path.
+    def _dedup_keys(self, arrs) -> Optional[list]:
+        """Content keys for this submit (None = dedup off)."""
+        return None
+
+    def _dedup_match(self, tenant, keys) -> dict:
+        """``{datum index: leader _Request}`` for already-in-flight
+        identical payloads; must hold ``self._cond``."""
+        return {}
+
+    def _dedup_register(self, tenant, keys, reqs, followers) -> None:
+        """Register the call's leaders in the in-flight map; must hold
+        ``self._cond``."""
+
+    def _dedup_attach(self, followers: dict, reqs: list) -> None:
+        """Wire follower futures to their leaders (outside the lock)."""
+
     def _resolve_request_ids(self, n: int, request_ids) -> List[Optional[str]]:
         if request_ids is not None:
             rids = [None if r is None else str(r) for r in request_ids]
@@ -815,15 +950,21 @@ class PipelineService:
             for _ in xs:
                 fault_point("serve.enqueue", **tctx)
             arrs = [np.asarray(x) for x in xs]
+            # content keys for in-flight dedup (None unless the service
+            # enables dedup) — hashed OUTSIDE the lock, and SHARED with
+            # the poison check below: both key on the same digest, and
+            # hashing payloads is the expensive part of this path
+            dd_keys = self._dedup_keys(arrs)
             # the poison quarantine cache: content previously isolated
             # by bisection is refused BEFORE it reaches a device (and
             # before it can fail a co-batched flush again).  Zero cost
             # until something has actually been quarantined.
             if self._poison_cache:
-                # digests computed OUTSIDE the lock: hashing payloads is
-                # the expensive part, and serializing every submitter
-                # thread on it would tax exactly the high-QPS path
-                keys = [_content_key(a) for a in arrs]
+                keys = (
+                    dd_keys
+                    if dd_keys is not None
+                    else [_content_key(a) for a in arrs]
+                )
                 now = time.monotonic()
                 with self._poison_lock:
                     hit = False
@@ -852,6 +993,7 @@ class PipelineService:
                     f"service {self.name!r}: no replica can serve",
                     retry_after_seconds=self._pool.retry_after_unavailable(),
                 )
+            followers: dict = {}
             with self._cond:
                 if self._closing:
                     raise ServiceClosed(f"service {self.name!r} is closed")
@@ -871,7 +1013,11 @@ class PipelineService:
                             f"request shape {tuple(arr.shape)} != service item "
                             f"shape {item_shape}"
                         )
-                self._check_bound_locked(len(arrs), tenant)
+                if dd_keys is not None:
+                    followers = self._dedup_match(tenant, dd_keys)
+                # followers ride their leader's computation: they occupy
+                # no queue slot, which is exactly the capacity win
+                self._check_bound_locked(len(arrs) - len(followers), tenant)
                 self._item_shape, self._dtype = item_shape, dtype
                 reqs = [
                     _Request(
@@ -882,19 +1028,37 @@ class PipelineService:
                     )
                     for a, rid in zip(arrs, rids)
                 ]
+                if dd_keys is not None:
+                    self._dedup_register(tenant, dd_keys, reqs, followers)
                 # push, then annotate — both UNDER the queue lock: the
                 # batcher pops under this same lock, so once we
                 # release, the flush path's finish() cannot run ahead
                 # of the enqueue event (annotated after the lock, a
                 # preempted submitter could lose the event — or
                 # resurrect an evicted id as a phantom trace)
-                depth = self._push_locked(reqs, tenant)
+                push_reqs = (
+                    reqs
+                    if not followers
+                    else [r for i, r in enumerate(reqs) if i not in followers]
+                )
+                depth = self._push_locked(push_reqs, tenant)
                 if rec is not None:
-                    for rid in rids:
+                    # followers are never enqueued: their trace gets the
+                    # serve.dedup annotation instead (a phantom enqueue
+                    # event would misreport queue behavior for exactly
+                    # the requests dedup diverts)
+                    enqueued_rids = (
+                        rids
+                        if not followers
+                        else [r.request_id for r in push_reqs]
+                    )
+                    for rid in enqueued_rids:
                         rec.annotate(
                             rid, "serve.enqueue", queue_depth=depth, **tctx
                         )
                 self._cond.notify_all()
+            if followers:
+                self._dedup_attach(followers, reqs)
         except BaseException as e:
             # terminal outcome at admission: the trace (if any) must not
             # dangle open — a rejected request is as explainable as a
@@ -995,6 +1159,111 @@ class PipelineService:
         flushes = -(-max(1, depth) // self.max_batch)  # ceil division
         return ewma * flushes / max(1, self._pool.size)
 
+    # ------------------------------------------------------------- scaling
+    def occupancy(self) -> float:
+        """Windowed fleet busy fraction: total batch-apply seconds over
+        the last window divided by (window × replicas).  ~1.0 means
+        every replica computed wall-to-wall; the autoscaler's primary
+        utilization signal, and a ``/statusz`` field."""
+        s = self._batch_win.summary()
+        denom = s["window_seconds"] * max(1, self._pool.size)
+        occ = min(1.0, (s["sum"] or 0.0) / denom) if denom > 0 else 0.0
+        metrics.set_gauge("serve.occupancy", occ)
+        return occ
+
+    def slo_burn_rate(self) -> Optional[float]:
+        """The windowed SLO error-budget burn rate (None when no
+        objective is configured) — the same number ``/statusz`` embeds,
+        exposed directly for the autoscaler."""
+        if self._slo_s is None:
+            return None
+        lat = self._lat_win.summary()
+        n_ok = lat["count"]
+        n_fail = self._fail_win.summary()["count"]
+        n = n_ok + n_fail
+        if n == 0:
+            return 0.0
+        bad = (
+            self._lat_win.fraction_above(self._slo_s) * n_ok + n_fail
+        ) / n
+        budget = 1.0 - self._slo_target
+        return None if budget <= 0.0 else bad / budget
+
+    def scale_to(self, n: int, timeout: float = 60.0) -> int:
+        """Resize the fleet to ``n`` replicas (grow: spawn → prime →
+        admit; shrink: graceful retire-and-drain, leftovers
+        re-dispatched).  Serialized under the swap lock so a concurrent
+        blue/green swap never races a resize.  Returns the resulting
+        size."""
+        n = max(1, int(n))
+        with self._swap_lock:
+            if self._closing:
+                raise ServiceClosed(f"service {self.name!r} is closed")
+            while self._pool.size < n:
+                t0 = time.monotonic()
+                fresh = self._pool.add_replica(primer=self.prime_replacement)
+                metrics.inc("serve.scale_ups")
+                self._scale_event(
+                    "up", fresh.index, time.monotonic() - t0
+                )
+            while self._pool.size > n:
+                t0 = time.monotonic()
+                left = self._pool.remove_replica(timeout=timeout)
+                if left is None:
+                    break  # at the floor
+                metrics.inc("serve.scale_downs")
+                for flush in left:
+                    if getattr(flush, "unflushed", lambda: False)():
+                        self._handle_stranded_flush(
+                            flush, why="replica retired during scale-down"
+                        )
+                    else:
+                        # a CLAIMED flush the victim never delivered (a
+                        # wedged worker that outlived the drain
+                        # timeout): fail its riders typed — late
+                        # delivery into resolved futures is tolerated,
+                        # exactly the supervisor's abandonment contract
+                        getattr(flush, "abort", lambda: False)()
+                        self.fail_flush(
+                            flush,
+                            FleetUnavailable(
+                                "replica retired during scale-down with "
+                                "a flush still in hand"
+                            ),
+                        )
+                self._scale_event("down", None, time.monotonic() - t0)
+        metrics.set_gauge("serve.workers", float(self._pool.size))
+        return self._pool.size
+
+    def _scale_event(self, action: str, replica, seconds: float) -> None:
+        ledger.event(
+            "serve.scale",
+            action=action,
+            replica=replica,
+            workers=self._pool.size,
+            seconds=round(seconds, 6),
+        )
+        rec = self.recorder
+        if rec is not None:
+            rec.ops(
+                "serve.scale",
+                action=action,
+                replica=replica,
+                workers=self._pool.size,
+                seconds=round(seconds, 6),
+            )
+        logger.info(
+            "scaled %s %r to %d replica(s) in %.2fs",
+            action,
+            self.name,
+            self._pool.size,
+            seconds,
+        )
+
+    def set_dispatch_window(self, n: int) -> int:
+        """Retune the router's dispatch window live (autoscaler lever)."""
+        return self._pool.set_window(n)
+
     # ------------------------------------------------------------- statusz
     @staticmethod
     def _ms(window_summary: dict) -> dict:
@@ -1024,6 +1293,10 @@ class PipelineService:
             "name": self.name,
             "status": "closed" if self._closed else "ok",
             "version": self.version,
+            "backend": self._pool.backend,
+            "workers": self._pool.size,
+            "dispatch_window": self._pool.window,
+            "occupancy": round(self.occupancy(), 4),
             "queue_depth": self.queue_depth,
             "queue_bound": self.queue_bound,
             "max_batch": self.max_batch,
@@ -1050,6 +1323,10 @@ class PipelineService:
                     "serve.artifact_hits",
                     "serve.artifact_misses",
                     "serve.artifact_fallbacks",
+                    "serve.worker_crashes",
+                    "serve.scale_ups",
+                    "serve.scale_downs",
+                    "serve.dedup_hits",
                 )
             },
             # the AOT tier at a glance: was a bundle configured, how
@@ -1070,6 +1347,9 @@ class PipelineService:
             "replicas": replica_stats,
             "supervisor": (
                 None if self.supervisor is None else self.supervisor.status()
+            ),
+            "autoscaler": (
+                None if self.autoscaler is None else self.autoscaler.status()
             ),
             "recorder": None if rec is None else rec.stats(),
         }
@@ -1215,7 +1495,10 @@ class PipelineService:
             self._cond.notify_all()
         # stop the healers first: a supervisor restarting (or a hedge
         # monitor re-enqueueing into) a pool that close() is tearing
-        # down would race the retirement below
+        # down would race the retirement below — and the autoscaler
+        # before both, so no resize races the drain
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         if self._hedge is not None:
@@ -1406,6 +1689,16 @@ class PipelineService:
         ok: Optional[bool] = False
         try:
             ok = self._run_batch(flush, replica)
+        except WorkerCrashed:
+            # the replica's worker PROCESS died under this flush: no
+            # result was produced or delivered, so return the claim and
+            # re-raise — the replica worker loop's crash handler
+            # front-requeues the flush and marks the slot dead, and the
+            # supervisor's replacement re-claims and serves it.  Zero
+            # lost futures, same contract as a thread crash.
+            flush.unclaim()
+            metrics.inc("serve.worker_crashes", replica=replica.index)
+            raise
         except BaseException as e:
             # an escape past _run_batch's own containment (a delivery-
             # layer bug): the claim is SPENT, so a worker-crash requeue
@@ -1460,7 +1753,20 @@ class PipelineService:
         predicted = self._ewma_batch_s
         live = []
         for req in batch:
-            if not req.future.set_running_or_notify_cancel():
+            fut = req.future
+            if fut.done():
+                # resolved on a previous attempt (a worker-crash re-run:
+                # shed/cancelled/failed riders keep their outcome)
+                continue
+            if fut.running():
+                # already claimed by a previous attempt on a crashed
+                # worker — still owed a result; no state transition to
+                # make (and set_running_or_notify_cancel on a RUNNING
+                # future logs CRITICAL + raises)
+                running = True
+            else:
+                running = fut.set_running_or_notify_cancel()
+            if not running:
                 # the caller cancelled while the request was queued:
                 # don't spend a padded row on it (and, marked RUNNING,
                 # a surviving request can no longer be cancelled out
@@ -1533,6 +1839,10 @@ class PipelineService:
                     if dls and len(dls) == len(live):
                         batch_deadline = max(dls, key=lambda d: d.at)
                 out = self._apply_reqs(live, replica, batch_deadline)
+        except WorkerCrashed:
+            # process death is NOT a batch error: the flush will be
+            # re-run whole on the slot's replacement (see _run_flush)
+            raise
         except BaseException as e:  # one bad batch must not kill the worker
             metrics.inc("serve.batch_errors")
             logger.warning(
@@ -1685,6 +1995,11 @@ class PipelineService:
                 t0 = time.monotonic()
                 out = self._apply_reqs(reqs, replica, batch_deadline)
             except BaseException as ge:
+                if isinstance(ge, WorkerCrashed):
+                    # the worker process died mid-bisect: propagate so
+                    # the whole flush re-runs on the replacement
+                    # (already-resolved riders are skipped there)
+                    raise
                 if not _poison_suspect(ge):
                     # infrastructure failed the RE-RUN: this group's
                     # riders get the real error, and the replica is
@@ -1793,6 +2108,22 @@ class PipelineService:
         bucket = self._bucket_for(k)
         padded, _mask, _start = next(iter(iter_row_chunks(stacked, None, bucket)))
         rep = replica if replica is not None else self._pool.replicas[0]
+        if getattr(rep.applier, "remote_worker", False):
+            # process fleet: the padded HOST batch goes straight to the
+            # worker over the shared-memory wire — the router performs
+            # no device transfer and holds the GIL only for the memcpy.
+            # The n kwarg rides through Replica.apply to the remote
+            # applier; prime is consumed BY Replica.apply (it skips the
+            # serve.replica fault site for warm-ups — the worker's
+            # apply is identical either way).
+            out = rep.apply(
+                padded, deadline=deadline, prime=prime, n=k, **apply_kw
+            )
+            if source_box is not None and rep.applier.has_bucket_program(
+                tuple(padded.shape), padded.dtype
+            ):
+                source_box.append("artifact")
+            return np.asarray(out.array)[:k]
         if rep.device is not None:
             # fleet path: commit the batch to THIS replica's device —
             # the default Dataset sharding spans every local device,
@@ -1848,6 +2179,9 @@ def serve(
     hedge_ms: Optional[float] = None,
     bisect: bool = True,
     artifacts: Optional[dict] = None,
+    workers: int = 0,
+    worker_opts: Optional[dict] = None,
+    autoscale: Optional[dict] = None,
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -1906,6 +2240,21 @@ def serve(
       :class:`PoisonRequest`, HTTP 422) while innocent co-batched
       riders complete; the content-keyed quarantine cache then refuses
       repeat offenders at admission.
+    - ``workers`` — the PROCESS fleet (default 0 = the threaded fleet,
+      byte-for-byte the pre-process path): ``workers=N`` runs N
+      one-replica worker processes behind the same router — each loads
+      the deploy payload + AOT artifacts, primes, and serves applies
+      over a shared-memory wire (``serve/wire.py``), so a multi-core
+      host's throughput is bounded by cores, not the GIL.  Exclusive
+      with ``replicas``/``devices``.  ``worker_opts`` tunes spawn
+      (``ready_timeout``, ``max_slab_bytes``).
+    - ``autoscale`` — SLO-driven autoscaling (default OFF): a config
+      dict for :class:`~keystone_tpu.serve.autoscale.Autoscaler`
+      (``min_workers``/``max_workers``/``interval_s``/thresholds).  A
+      control thread watches windowed occupancy, queue depth, SLO
+      error-budget burn, and the shared-pool hit rate; it grows the
+      fleet (spawn → prime-from-artifacts → admit), retires idle
+      replicas (drain → join), and retunes the dispatch window live.
     - ``artifacts`` — an AOT artifact bundle
       (``FrozenApplier.export_artifacts`` / registry
       ``load_artifacts``): every replica installs the pre-lowered
@@ -1940,4 +2289,7 @@ def serve(
         hedge_ms=hedge_ms,
         bisect=bisect,
         artifacts=artifacts,
+        workers=workers,
+        worker_opts=worker_opts,
+        autoscale=autoscale,
     )

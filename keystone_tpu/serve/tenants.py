@@ -44,8 +44,10 @@ from __future__ import annotations
 
 import itertools
 import logging
+import threading
 import time
 from collections import deque
+from concurrent.futures import InvalidStateError
 from typing import Dict, Optional
 
 import numpy as np
@@ -235,8 +237,16 @@ class MultiTenantService(PipelineService):
         tenant_queue_bound: Optional[Dict[str, int]] = None,
         tenant_deadline_ms: Optional[Dict[str, float]] = None,
         tenant_breaker_threshold: Optional[int] = None,
+        dedup: bool = False,
         **kw,
     ):
+        if kw.get("workers"):
+            raise NotImplementedError(
+                "multi-tenant serving runs in-process (the shared stage "
+                "pool and per-tenant containment need the executor walk); "
+                "workers= (process fleet) applies to single-tenant "
+                "services"
+            )
         applier = MultiTenantApplier(models, pool=pool, share=share)
         self.tenants = tuple(applier.appliers)
         self._mt_applier = applier
@@ -258,6 +268,14 @@ class MultiTenantService(PipelineService):
             )
             for t in self.tenants
         }
+        #: cross-request in-flight dedup (opt-in): identical concurrent
+        #: payloads for the SAME tenant are computed once — the
+        #: follower's future resolves from the leader's result,
+        #: bit-identical.  Keyed per tenant: two tenants' identical
+        #: payloads run different models and must never share.
+        self._dedup = bool(dedup)
+        self._dedup_lock = threading.Lock()
+        self._dedup_inflight: Dict[tuple, object] = {}
         self._tenant_bounds = dict(tenant_queue_bound or {})
         self._tenant_deadline_s = {
             t: float(ms) / 1000.0
@@ -396,6 +414,111 @@ class MultiTenantService(PipelineService):
         self._tfail[t].observe(seconds)
         if brk is not None:
             brk.record_failure()
+
+    # --------------------------------------------------------------- dedup
+    def _dedup_keys(self, arrs):
+        """Per-datum content digests (outside the admission lock —
+        hashing payloads is the expensive part)."""
+        if not self._dedup:
+            return None
+        from keystone_tpu.serve.service import _content_key
+
+        return [_content_key(a) for a in arrs]
+
+    def _dedup_match(self, tenant, keys) -> dict:
+        """Map datum index → in-flight leader (an earlier unresolved
+        request with identical content) or — for a duplicate WITHIN
+        this call — the leading datum's index (resolved to its request
+        by :meth:`_dedup_register` once the requests exist).  Holds the
+        admission lock; the map lock nests inside."""
+        followers: dict = {}
+        local: dict = {}
+        with self._dedup_lock:
+            for i, k in enumerate(keys):
+                mk = (tenant, k)
+                if mk in local:
+                    followers[i] = local[mk]  # datum index of the leader
+                    continue
+                cand = self._dedup_inflight.get(mk)
+                if cand is not None and not cand.future.done():
+                    followers[i] = cand
+                else:
+                    local[mk] = i  # this datum leads for mk
+        return followers
+
+    def _dedup_register(self, tenant, keys, reqs, followers) -> None:
+        # resolve within-call followers (datum-index placeholders) to
+        # their leader request objects now that requests exist
+        for i, leader in list(followers.items()):
+            if isinstance(leader, int):
+                followers[i] = reqs[leader]
+        with self._dedup_lock:
+            for i, req in enumerate(reqs):
+                if i in followers:
+                    continue
+                mk = (tenant, keys[i])
+                self._dedup_inflight[mk] = req
+                req.future.add_done_callback(self._dedup_cleanup(mk, req))
+
+    def _dedup_cleanup(self, mk, req):
+        def cb(_fut):
+            with self._dedup_lock:
+                if self._dedup_inflight.get(mk) is req:
+                    del self._dedup_inflight[mk]
+
+        return cb
+
+    def _dedup_attach(self, followers: dict, reqs: list) -> None:
+        """Fan the leader's outcome out to each follower (outside the
+        admission lock).  Success delivers a COPY of the leader's
+        result row — bit-identical, and a caller mutating its response
+        can never corrupt a co-rider's.  Failure propagates the
+        leader's typed error through the standard failure terminal."""
+        metrics.inc("serve.dedup_hits", len(followers))
+        rec = self.recorder
+        for i, leader in followers.items():
+            req = reqs[i]
+            if rec is not None and req.request_id is not None:
+                rec.annotate(
+                    req.request_id,
+                    "serve.dedup",
+                    leader=leader.request_id,
+                )
+
+            def deliver(lf, req=req, leader=leader):
+                try:
+                    exc = lf.exception()
+                except BaseException as e:  # a cancelled leader
+                    exc = e
+                if exc is not None:
+                    self._fail(req, exc, leader=leader.request_id)
+                    return
+                waited = time.monotonic() - req.t_submit
+                metrics.inc("serve.completed")
+                self._lat_win.observe(waited)
+                self._account_tenant(req, "completed", waited)
+                if req.request_id is not None:
+                    if rec is not None:
+                        rec.finish(
+                            req.request_id,
+                            "completed",
+                            only_live=True,
+                            leader=leader.request_id,
+                        )
+                    if ledger.active() is not None:
+                        ledger.event(
+                            "serve.request",
+                            request_id=req.request_id,
+                            outcome="completed",
+                            leader=leader.request_id,
+                            seconds=round(waited, 6),
+                        )
+                try:
+                    req.future.set_result(np.copy(lf.result()))
+                except InvalidStateError:
+                    pass  # the follower was cancelled meanwhile
+
+            leader.future.add_done_callback(deliver)
 
     # ------------------------------------------------------------ batching
     def _next_batch(self):
@@ -612,6 +735,7 @@ def serve_multi(
     tenant_queue_bound: Optional[Dict[str, int]] = None,
     tenant_deadline_ms: Optional[Dict[str, float]] = None,
     tenant_breaker_threshold: Optional[int] = None,
+    dedup: bool = False,
     **kw,
 ) -> MultiTenantService:
     """Stand up a multi-tenant :class:`MultiTenantService`.
@@ -627,6 +751,13 @@ def serve_multi(
     off).  Remaining keywords are :func:`keystone_tpu.serve.serve`'s
     (``max_batch``, ``deadline_ms``, ``replicas``, ``example``, ...).
 
+    ``dedup=True`` enables cross-request in-flight dedup: identical
+    concurrent payloads for the same tenant are computed ONCE — later
+    arrivals ride the in-flight leader's computation, consume no queue
+    slot, and resolve bit-identically from its result (counted as
+    ``serve.dedup_hits``).  Off by default: coupled outcomes (a shed
+    leader sheds its followers) are a semantic opt-in.
+
     Requests are routed with ``svc.submit(x, tenant="name")`` / HTTP
     ``POST /predict`` with ``"tenant"`` in the body."""
     return MultiTenantService(
@@ -636,5 +767,6 @@ def serve_multi(
         tenant_queue_bound=tenant_queue_bound,
         tenant_deadline_ms=tenant_deadline_ms,
         tenant_breaker_threshold=tenant_breaker_threshold,
+        dedup=dedup,
         **kw,
     )

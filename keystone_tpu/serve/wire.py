@@ -1,0 +1,332 @@
+"""Worker wire protocol: control frames + shared-memory payload slabs.
+
+The process fleet (``serve/procfleet.py``) moves batch payloads between
+the router process and its worker processes.  Pickling every array over
+a pipe would put the serialization cost back on the hot path the whole
+promotion exists to remove, so the protocol splits control from data:
+
+- **Control frames** — tiny JSON dicts (an op, a slab reference, an
+  error classification), length-delimited by the underlying
+  ``multiprocessing.connection`` transport and framed here with a magic
+  + version prefix so a torn or foreign message fails loudly
+  (:func:`pack_frame` / :func:`unpack_frame`).  Arrays NEVER ride a
+  frame — a frame carries at most a :func:`slab reference <write_array>`.
+- **Payload slabs** — ``multiprocessing.shared_memory`` segments sized
+  to power-of-two classes that mirror the service's padding buckets
+  (every flush is padded to a bucket, so slab sizes are as finite as
+  the compiled program shapes).  Dispatch is one ``memcpy`` into a
+  slab; the receiving side attaches by name (cached — attach is a
+  syscall) and reads a NumPy view.  :class:`SlabPool` owns creation,
+  reuse, and unlink; :class:`SlabAttacher` is the read side.
+
+Reuse discipline: the protocol is strict request/response with ONE
+in-flight request per worker (the parent serializes on a per-worker
+lock), so a request slab may be reused as soon as the response frame
+arrives, and a response slab as soon as the next request is sent — no
+acknowledgement round-trip.  :meth:`SlabPool.acquire` refuses payloads
+past ``max_slab_bytes`` with :class:`PayloadTooLarge` (a typed refusal
+at dispatch beats an OOM in a worker that every tenant shares).
+
+This module is transport only — no JAX, no pipeline imports — so both
+the router and a freshly spawned worker can import it before paying
+the accelerator-runtime import.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: frame prefix: magic + protocol version.  A frame from a different
+#: keystone version (rolling restart skew) or a stray writer fails the
+#: unpack instead of silently misparsing.
+MAGIC = b"KSWP"
+VERSION = 1
+
+#: slab size classes are powers of two from this floor — small enough
+#: that a probe request wastes little, large enough that the common
+#: bucket sizes land in few classes
+MIN_SLAB_BYTES = 1 << 16  # 64 KiB
+
+#: refuse single payloads past this (acquire raises PayloadTooLarge)
+DEFAULT_MAX_SLAB_BYTES = 1 << 28  # 256 MiB
+
+
+class WireError(RuntimeError):
+    """A malformed control frame: wrong magic, wrong version, truncated
+    or non-JSON body.  Deliberately loud — a torn frame means the
+    control channel itself is unreliable and the worker must be
+    replaced, not retried."""
+
+
+class PayloadTooLarge(ValueError):
+    """The payload exceeds the slab cap.  A ``ValueError`` on purpose:
+    it is the REQUEST's fault (the 400 family at HTTP) and resubmitting
+    it unchanged will fail again — it must not charge replica breakers
+    or trip the supervisor."""
+
+
+def pack_frame(msg: dict) -> bytes:
+    """Serialize one control frame: ``MAGIC + version byte + JSON``.
+    Frames carry only JSON-native scalars/lists/dicts (slab references,
+    never arrays)."""
+    if not isinstance(msg, dict):
+        raise WireError(f"frame body must be a dict, got {type(msg).__name__}")
+    try:
+        body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    except (TypeError, ValueError) as e:
+        raise WireError(f"unserializable frame body: {e}") from e
+    return MAGIC + bytes([VERSION]) + body
+
+
+def unpack_frame(data: bytes) -> dict:
+    """Parse one control frame; raises :class:`WireError` on anything
+    that is not a well-formed frame of THIS protocol version."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise WireError(f"frame must be bytes, got {type(data).__name__}")
+    data = bytes(data)
+    if len(data) < len(MAGIC) + 1:
+        raise WireError(f"truncated frame ({len(data)} bytes)")
+    if data[: len(MAGIC)] != MAGIC:
+        raise WireError("bad frame magic (foreign or torn message)")
+    ver = data[len(MAGIC)]
+    if ver != VERSION:
+        raise WireError(f"frame version {ver} != {VERSION} (worker skew)")
+    try:
+        msg = json.loads(data[len(MAGIC) + 1 :].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"unparseable frame body: {e}") from e
+    if not isinstance(msg, dict):
+        raise WireError(f"frame body must be a dict, got {type(msg).__name__}")
+    return msg
+
+
+def slab_class(nbytes: int) -> int:
+    """The size class a payload of ``nbytes`` rides: the smallest power
+    of two >= max(nbytes, MIN_SLAB_BYTES) — mirroring the padding-bucket
+    discipline so slab shapes are as finite as program shapes."""
+    n = max(int(nbytes), MIN_SLAB_BYTES)
+    return 1 << (n - 1).bit_length()
+
+
+class Slab:
+    """One owned shared-memory segment (created by a :class:`SlabPool`;
+    the remote side attaches by :attr:`name`)."""
+
+    __slots__ = ("shm", "capacity")
+
+    def __init__(self, shm, capacity: int):
+        self.shm = shm
+        self.capacity = int(capacity)
+
+    @property
+    def name(self) -> str:
+        return self.shm.name
+
+    @property
+    def buf(self):
+        return self.shm.buf
+
+
+class SlabPool:
+    """Creator-side slab manager: acquire/release with reuse across
+    size classes (a released 1 MiB slab serves a later 256 KiB payload
+    — ``acquire`` hands out the smallest free slab that fits before
+    creating a new one).  Owned slabs are unlinked at :meth:`close`.
+    Thread-safe; creation is rare after warm-up (the working set is one
+    request slab + one response slab per worker)."""
+
+    def __init__(
+        self,
+        prefix: str = "ksw",
+        max_slab_bytes: int = DEFAULT_MAX_SLAB_BYTES,
+    ):
+        import re
+
+        # the prefix lands in the POSIX shm name (debuggability: ls
+        # /dev/shm attributes every segment to its pool/worker); keep
+        # it name-safe and short
+        self.prefix = re.sub(r"[^A-Za-z0-9_]", "_", str(prefix))[:48]
+        self.max_slab_bytes = int(max_slab_bytes)
+        self._lock = threading.Lock()
+        self._free: List[Slab] = []
+        self._all: List[Slab] = []
+        self._closed = False
+        self._seq = 0
+        self.created = 0
+        self.reused = 0
+
+    def acquire(self, nbytes: int) -> Slab:
+        nbytes = int(nbytes)
+        if nbytes > self.max_slab_bytes:
+            raise PayloadTooLarge(
+                f"payload of {nbytes} bytes exceeds the slab cap "
+                f"({self.max_slab_bytes}); refused at dispatch"
+            )
+        cls = slab_class(nbytes)
+        with self._lock:
+            if self._closed:
+                raise WireError("slab pool is closed")
+            fits = [s for s in self._free if s.capacity >= cls]
+            if fits:
+                slab = min(fits, key=lambda s: s.capacity)
+                self._free.remove(slab)
+                self.reused += 1
+                return slab
+        import os
+
+        from multiprocessing import shared_memory
+
+        shm = None
+        while shm is None:
+            with self._lock:
+                self._seq += 1
+                name = f"{self.prefix}_{os.getpid()}_{self._seq}"
+            try:
+                shm = shared_memory.SharedMemory(
+                    name=name, create=True, size=cls
+                )
+            except FileExistsError:
+                continue  # a stale segment from a crashed prior run
+        slab = Slab(shm, cls)
+        with self._lock:
+            self._all.append(slab)
+            self.created += 1
+        return slab
+
+    def release(self, slab: Slab) -> None:
+        with self._lock:
+            if self._closed:
+                self._destroy(slab)
+                return
+            if slab not in self._free:
+                self._free.append(slab)
+
+    @staticmethod
+    def _destroy(slab: Slab) -> None:
+        try:
+            slab.shm.close()
+            slab.shm.unlink()
+        except (FileNotFoundError, OSError):
+            pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "created": self.created,
+                "reused": self.reused,
+                "free": len(self._free),
+                "total": len(self._all),
+                "bytes": sum(s.capacity for s in self._all),
+            }
+
+    def close(self) -> None:
+        """Unlink every owned slab (idempotent).  The owner outlives
+        every reader by protocol (a worker's response slabs die with
+        the worker AFTER the parent read its last response)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            slabs, self._all, self._free = self._all, [], []
+        for s in slabs:
+            self._destroy(s)
+
+
+class SlabAttacher:
+    """Reader-side cache of attached segments (attach = a syscall +
+    mmap; the steady state re-reads the same one or two slab names
+    per worker)."""
+
+    def __init__(self):
+        self._attached: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _segment(self, name: str):
+        with self._lock:
+            seg = self._attached.get(name)
+            if seg is None:
+                from multiprocessing import shared_memory
+
+                seg = shared_memory.SharedMemory(name=name)
+                self._attached[name] = seg
+            return seg
+
+    def view(self, ref: dict) -> np.ndarray:
+        """A zero-copy NumPy view over the referenced payload.  The
+        view is valid only until the protocol allows the writer to
+        reuse the slab — copy before crossing that boundary."""
+        seg = self._segment(ref["slab"])
+        dtype = np.dtype(ref["dtype"])
+        shape = tuple(ref["shape"])
+        nbytes = int(ref["nbytes"])
+        if nbytes > seg.size:
+            raise WireError(
+                f"slab reference claims {nbytes} bytes but segment "
+                f"{ref['slab']!r} holds {seg.size}"
+            )
+        return np.ndarray(shape, dtype=dtype, buffer=seg.buf[:nbytes])
+
+    def read(self, ref: dict) -> np.ndarray:
+        """An owning copy of the referenced payload (safe past slab
+        reuse)."""
+        return np.array(self.view(ref))
+
+    def close(self) -> None:
+        with self._lock:
+            segs, self._attached = list(self._attached.values()), {}
+        for seg in segs:
+            try:
+                seg.close()
+            except OSError:
+                pass
+
+    def unlink_all(self) -> None:
+        """Reap segments whose OWNER died without unlinking (a
+        SIGKILLed worker's response slabs): close, unlink, and clear
+        the dead owner's resource-tracker registration.  A segment the
+        owner already unlinked is skipped silently."""
+        with self._lock:
+            segs, self._attached = list(self._attached.values()), {}
+        for seg in segs:
+            try:
+                seg.close()
+            except OSError:
+                pass
+            try:
+                seg.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+
+
+def write_array(pool: SlabPool, arr: np.ndarray) -> Tuple[Slab, dict]:
+    """Copy ``arr`` into a pool slab; returns ``(slab, reference)`` —
+    the reference is what rides the control frame.  Non-contiguous
+    inputs are made contiguous first (one copy either way)."""
+    arr = np.ascontiguousarray(arr)
+    slab = pool.acquire(arr.nbytes)
+    dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=slab.buf[: arr.nbytes])
+    np.copyto(dst, arr)
+    del dst  # release the exported buffer view before any slab close
+    ref = {
+        "slab": slab.name,
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.str,
+        "nbytes": int(arr.nbytes),
+    }
+    return slab, ref
+
+
+def send_frame(conn, msg: dict) -> None:
+    conn.send_bytes(pack_frame(msg))
+
+
+def recv_frame(conn, timeout: Optional[float] = None) -> dict:
+    """Receive one frame; ``timeout`` (seconds) raises ``TimeoutError``
+    instead of blocking forever — the ready-handshake path."""
+    if timeout is not None and not conn.poll(timeout):
+        raise TimeoutError(f"no frame within {timeout:.1f}s")
+    return unpack_frame(conn.recv_bytes())

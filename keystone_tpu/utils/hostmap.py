@@ -83,7 +83,8 @@ def _get_executor(workers: int):
     global _EXECUTOR, _EXECUTOR_WORKERS, _POOL_WARNED, _POOL_SIZE_NOTED
     with _EXECUTOR_LOCK:
         if _EXECUTOR is None:
-            import multiprocessing as mp
+            # explicit forkserver/spawn context below — never fork
+            import multiprocessing as mp  # lint: allow-proc-spawn
             from concurrent.futures import ProcessPoolExecutor
 
             methods = mp.get_all_start_methods()

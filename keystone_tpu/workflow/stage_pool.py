@@ -227,6 +227,7 @@ class SharedStagePool:
             if e is None:
                 self.misses += 1
                 metrics.inc("serve.pool_misses")
+                metrics.set_gauge("serve.pool_hit_rate", self._hit_rate())
                 return False, None
             self.hits += 1
             e.last_use = time.monotonic()
@@ -236,7 +237,20 @@ class SharedStagePool:
                 self._drop(key)
             metrics.inc("serve.pool_hits")
             metrics.set_gauge("serve.pool_bytes", float(self._bytes))
+            metrics.set_gauge("serve.pool_hit_rate", self._hit_rate())
             return True, value
+
+    def _hit_rate(self) -> float:
+        """Lifetime hit fraction (must hold the lock) — the
+        ``serve.pool_hit_rate`` gauge the autoscaler reads as a
+        capacity lever: a high rate means co-tenant flushes amortize
+        their shared prefix, so occupancy overstates marginal cost."""
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            return self._hit_rate()
 
     def put(self, key: PoolKey, value, nbytes: Optional[int] = None) -> bool:
         """Publish one computed stage result.  Returns False (and stores
@@ -299,6 +313,7 @@ class SharedStagePool:
                 "budget_bytes": self.budget_bytes,
                 "hits": self.hits,
                 "misses": self.misses,
+                "hit_rate": round(self._hit_rate(), 4),
                 "evictions": self.evictions,
                 "pinned_sigs": len(self._pinned),
                 "registered_sigs": len(self._sig_tenants),

@@ -1,0 +1,226 @@
+"""Autoscaler (serve/autoscale.py) unit coverage: the pure policy
+(thresholds, hysteresis, cooldowns, the pool-hit-rate capacity credit),
+and the controller loop against an injectable clock + signal source —
+no worker processes, no sleeps."""
+
+import pytest
+
+from keystone_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    Autoscaler,
+    Signals,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def sig(
+    workers=1,
+    queue_depth=0,
+    queue_bound=100,
+    occupancy=0.0,
+    burn_rate=None,
+    pool_hit_rate=None,
+):
+    return Signals(
+        workers=workers,
+        queue_depth=queue_depth,
+        queue_bound=queue_bound,
+        occupancy=occupancy,
+        burn_rate=burn_rate,
+        pool_hit_rate=pool_hit_rate,
+    )
+
+
+# ---------------------------------------------------------------- policy
+def test_policy_scales_up_on_queue_pressure():
+    p = AutoscalePolicy(min_workers=1, max_workers=4)
+    assert p.decide(sig(queue_depth=60), 0, 1e9, 1e9) == "up"
+
+
+def test_policy_scales_up_on_slo_burn():
+    p = AutoscalePolicy(min_workers=1, max_workers=4)
+    assert p.decide(sig(burn_rate=2.0), 0, 1e9, 1e9) == "up"
+
+
+def test_policy_scales_up_on_occupancy():
+    p = AutoscalePolicy(min_workers=1, max_workers=4)
+    assert p.decide(sig(occupancy=0.95), 0, 1e9, 1e9) == "up"
+
+
+def test_policy_respects_max_workers_and_up_cooldown():
+    p = AutoscalePolicy(min_workers=1, max_workers=2, up_cooldown_s=5.0)
+    pressed = sig(workers=2, queue_depth=90)
+    assert p.decide(pressed, 0, 1e9, 1e9) is None  # at the ceiling
+    fresh = sig(workers=1, queue_depth=90)
+    assert p.decide(fresh, 0, 1.0, 1e9) is None  # inside the cooldown
+    assert p.decide(fresh, 0, 6.0, 1e9) == "up"
+
+
+def test_pool_hit_rate_lifts_the_occupancy_bar():
+    """A hot shared pool means occupancy overstates marginal cost: the
+    same occupancy that scales an unshared fleet up does NOT scale a
+    fully-hitting one."""
+    p = AutoscalePolicy(
+        min_workers=1,
+        max_workers=4,
+        up_occupancy=0.85,
+        pool_occupancy_credit=0.10,
+    )
+    s = sig(occupancy=0.90)
+    assert p.decide(s, 0, 1e9, 1e9) == "up"
+    shared = sig(occupancy=0.90, pool_hit_rate=0.9)
+    assert p.decide(shared, 0, 1e9, 1e9) is None
+
+
+def test_policy_scales_down_only_after_hysteresis_and_cooldown():
+    p = AutoscalePolicy(
+        min_workers=1, max_workers=4, down_ticks=3, down_cooldown_s=10.0
+    )
+    idle = sig(workers=3, occupancy=0.05, burn_rate=0.0)
+    assert p.decide(idle, 0, 1e9, 1e9) is None  # not enough idle ticks
+    assert p.decide(idle, 2, 1e9, 5.0) is None  # inside the cooldown
+    assert p.decide(idle, 2, 1e9, 20.0) == "down"
+    floor = sig(workers=1, occupancy=0.05, burn_rate=0.0)
+    assert p.decide(floor, 10, 1e9, 1e9) is None  # never below the floor
+
+
+def test_window_retune_band():
+    p = AutoscalePolicy(
+        min_workers=1, max_workers=2, window_min=2, window_max=4
+    )
+    # maxed out + deep queue: deepen the window
+    hot = sig(workers=2, queue_depth=90)
+    assert p.window_for(hot, 2) == 3
+    assert p.window_for(hot, 4) is None  # at the band's top
+    # calm: tighten back
+    calm = sig(workers=2, occupancy=0.05)
+    assert p.window_for(calm, 4) == 3
+    assert p.window_for(calm, 2) is None  # at the band's floor
+
+
+# ------------------------------------------------------------ controller
+class FakeService:
+    """The minimal surface Autoscaler touches."""
+
+    name = "fake"
+    _closing = False
+    _obs_ctx = None
+    recorder = None
+
+    def __init__(self, workers=1, window=2):
+        self.workers = workers
+        self.scaled_to = []
+        self.windows = []
+        self._pool = self
+        self.queue_bound = 100
+        self.queue_depth = 0
+
+    # pool surface
+    @property
+    def size(self):
+        return self.workers
+
+    @property
+    def window(self):
+        return 2
+
+    # service surface
+    def scale_to(self, n):
+        self.scaled_to.append(n)
+        self.workers = n
+        return n
+
+    def set_dispatch_window(self, n):
+        self.windows.append(n)
+        return n
+
+    def occupancy(self):
+        return 0.0
+
+    def slo_burn_rate(self):
+        return None
+
+
+def make_scaler(svc, signals, **kw):
+    clock_box = [0.0]
+    scaler = Autoscaler(
+        svc,
+        interval_s=1.0,
+        clock=lambda: clock_box[0],
+        signal_source=signals,
+        **kw,
+    )
+    return scaler, clock_box
+
+
+def test_tick_scales_up_then_respects_cooldown():
+    svc = FakeService(workers=1)
+    state = {"s": sig(workers=1, queue_depth=80)}
+    scaler, clock = make_scaler(
+        svc, lambda: state["s"], min_workers=1, max_workers=3,
+        up_cooldown_s=5.0,
+    )
+    clock[0] = 100.0
+    assert scaler.tick() == "up"
+    assert svc.scaled_to == [2]
+    state["s"] = sig(workers=2, queue_depth=80)
+    clock[0] = 102.0  # inside the cooldown: no second spawn storm
+    assert scaler.tick() != "up"
+    clock[0] = 106.0
+    assert scaler.tick() == "up"
+    assert svc.scaled_to == [2, 3]
+
+
+def test_tick_scales_down_after_idle_run():
+    svc = FakeService(workers=2)
+    state = {"s": sig(workers=2, occupancy=0.01, burn_rate=0.0)}
+    scaler, clock = make_scaler(
+        svc, lambda: state["s"], min_workers=1, max_workers=3,
+        down_ticks=3, down_cooldown_s=0.0,
+    )
+    clock[0] = 100.0
+    results = [scaler.tick() for _ in range(3)]
+    assert results[-1] == "down"
+    assert svc.scaled_to == [1]
+
+
+def test_dry_run_records_but_does_not_touch_the_fleet():
+    svc = FakeService(workers=1)
+    scaler, clock = make_scaler(
+        svc,
+        lambda: sig(workers=1, queue_depth=80),
+        min_workers=1,
+        max_workers=3,
+        apply=False,
+    )
+    clock[0] = 50.0
+    assert scaler.tick() == "up"
+    assert svc.scaled_to == []  # advisor mode: decision only
+    assert scaler.status()["last_action"]["action"] == "up"
+
+
+def test_status_shape():
+    svc = FakeService()
+    scaler, clock = make_scaler(
+        svc, lambda: sig(), min_workers=1, max_workers=2
+    )
+    scaler.tick()
+    st = scaler.status()
+    for key in (
+        "min_workers",
+        "max_workers",
+        "ups",
+        "downs",
+        "window_retunes",
+        "last_signals",
+    ):
+        assert key in st
+    assert st["last_signals"]["workers"] == 1
+
+
+def test_bad_bounds_refused():
+    with pytest.raises(ValueError):
+        Autoscaler(FakeService(), min_workers=0)
+    with pytest.raises(ValueError):
+        Autoscaler(FakeService(), min_workers=3, max_workers=2)

@@ -308,3 +308,46 @@ def test_main_exit_codes(tmp_path, capsys):
     assert lint.main([str(dirty)]) == 1
     out = capsys.readouterr().out
     assert "fault-site" in out
+
+
+# ------------------------------------------------------------ proc-spawn
+def test_proc_spawn_rule_fires_on_import_and_fork(sites):
+    src = "import multiprocessing\n"
+    vs = _run(src, sites)
+    assert any(v.rule == "proc-spawn" for v in vs)
+    src = "from multiprocessing import shared_memory\n"
+    vs = _run(src, sites)
+    assert any(v.rule == "proc-spawn" for v in vs)
+    src = "import os\npid = os.fork()\n"
+    vs = _run(src, sites)
+    assert any(v.rule == "proc-spawn" for v in vs)
+
+
+def test_proc_spawn_rule_scoped_to_the_worker_fence(sites):
+    src = "import multiprocessing\n"
+    # the fenced worker modules may touch multiprocessing directly
+    vs = lint.lint_source(
+        "keystone_tpu/serve/procfleet.py", src, sites, {}, attr_vocab=None
+    )
+    assert not [v for v in vs if v.rule == "proc-spawn"]
+    # explicit override hook for tests
+    vs = lint.lint_source(
+        "elsewhere.py", src, sites, {}, attr_vocab=None, proc_fenced=False
+    )
+    assert not [v for v in vs if v.rule == "proc-spawn"]
+
+
+def test_proc_spawn_allow_comment_escapes(sites):
+    src = "import multiprocessing as mp  # lint: allow-proc-spawn\n"
+    vs = _run(src, sites)
+    assert not [v for v in vs if v.rule == "proc-spawn"]
+
+
+def test_proc_spawn_rule_catches_aliased_and_from_import_fork(sites):
+    for src in (
+        "import os as _os\npid = _os.fork()\n",
+        "from os import fork\npid = fork()\n",
+        "from os import forkpty\n",
+    ):
+        vs = _run(src, sites)
+        assert any(v.rule == "proc-spawn" for v in vs), src

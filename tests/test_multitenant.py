@@ -539,3 +539,88 @@ def test_tenant_breaker_refusal_counts_as_rejected():
         )
     finally:
         svc.close()
+
+
+# ------------------------------------------------------------- dedup
+def test_dedup_identical_inflight_payloads_computed_once():
+    from keystone_tpu.obs import metrics as _metrics
+
+    """With dedup=True, identical concurrent payloads for the SAME
+    tenant ride one computation: followers occupy no queue slot, count
+    as serve.dedup_hits, and resolve bit-identically."""
+    svc = _mk(
+        {"a": _tenant_pipeline(1)},
+        dedup=True,
+        max_wait_ms=25.0,  # hold the flush open so followers pile up
+    )
+    try:
+        x = np.random.default_rng(2).normal(size=(DIM,)).astype(np.float32)
+        h0 = _metrics.REGISTRY.counter_total("serve.dedup_hits")
+        futs = [svc.submit(x, tenant="a") for _ in range(6)]
+        outs = [np.asarray(f.result(30)) for f in futs]
+        for o in outs[1:]:
+            assert o.tobytes() == outs[0].tobytes()
+        hits = _metrics.REGISTRY.counter_total("serve.dedup_hits") - h0
+        assert hits >= 4, hits
+        # followers get an OWNING copy: mutating one response cannot
+        # corrupt a co-rider's
+        outs[1][:] = 0
+        assert outs[2].tobytes() == outs[0].tobytes()
+    finally:
+        svc.close()
+
+
+def test_dedup_never_crosses_tenants():
+    """The same payload for two tenants runs two different models —
+    dedup keys are (tenant, content), so results differ and no
+    cross-tenant hit is counted."""
+    svc = _mk(
+        {"a": _tenant_pipeline(1), "b": _tenant_pipeline(2)},
+        dedup=True,
+        max_wait_ms=25.0,
+    )
+    try:
+        x = np.random.default_rng(3).normal(size=(DIM,)).astype(np.float32)
+        fa = svc.submit(x, tenant="a")
+        fb = svc.submit(x, tenant="b")
+        ya, yb = np.asarray(fa.result(30)), np.asarray(fb.result(30))
+        assert ya.tobytes() != yb.tobytes()
+    finally:
+        svc.close()
+
+
+def test_dedup_off_by_default():
+    from keystone_tpu.obs import metrics as _metrics
+
+    svc = _mk({"a": _tenant_pipeline(1)}, max_wait_ms=10.0)
+    try:
+        x = np.random.default_rng(4).normal(size=(DIM,)).astype(np.float32)
+        h0 = _metrics.REGISTRY.counter_total("serve.dedup_hits")
+        futs = [svc.submit(x, tenant="a") for _ in range(4)]
+        outs = [np.asarray(f.result(30)) for f in futs]
+        for o in outs[1:]:
+            assert o.tobytes() == outs[0].tobytes()  # same math regardless
+        assert (
+            _metrics.REGISTRY.counter_total("serve.dedup_hits") - h0 == 0
+        )
+    finally:
+        svc.close()
+
+
+def test_dedup_map_drains_after_resolution():
+    """The in-flight map is bounded by construction: entries leave when
+    their leader resolves, so a long-running service cannot leak."""
+    import time
+
+    svc = _mk({"a": _tenant_pipeline(1)}, dedup=True)
+    try:
+        xs = np.random.default_rng(5).normal(size=(8, DIM)).astype(np.float32)
+        futs = [svc.submit(xs[i], tenant="a") for i in range(8)]
+        for f in futs:
+            f.result(30)
+        deadline = time.monotonic() + 5.0
+        while svc._dedup_inflight and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not svc._dedup_inflight
+    finally:
+        svc.close()

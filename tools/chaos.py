@@ -37,8 +37,10 @@ in the plan never injected (``not-exercised`` — a typo'd trigger or a
 workload that never reaches the site must not read as a green chaos
 run).
 
-**Soak mode** (``--soak SECONDS [--seed N] [--soak-replicas R]``)
-stands up a live replica fleet (supervisor + hedging on) and loops
+**Soak mode** (``--soak SECONDS [--seed N] [--soak-replicas R |
+--soak-workers W]``) stands up a live replica fleet (supervisor +
+hedging on; ``--soak-workers`` promotes it to a PROCESS fleet and adds
+seeded mid-wave worker SIGKILLs to the menu) and loops
 seeded randomized multi-site plans over the ``serve.*`` sites — worker
 crashes, flush failures, injected delays — submitting a request wave
 under each plan and requiring EVERY future to resolve (result or typed
@@ -315,6 +317,127 @@ def _tenants(tmp, restarts):
         svc.close()
 
 
+def _kill_live_worker(svc, pick) -> bool:
+    """SIGKILL one live worker process of a process-backed service —
+    THE seeded kill action, shared by the ``procfleet`` workload and
+    the soak loop (two drifting copies would silently test different
+    behavior).  ``pick``: seeded index chooser, ``callable(n) -> int``.
+    Returns whether a kill landed."""
+    import signal as _signal
+
+    pids = [
+        r.get("pid")
+        for r in svc.replica_statuses()
+        if r.get("worker_alive") and r.get("pid")
+    ]
+    if not pids:
+        return False
+    try:
+        os.kill(pids[int(pick(len(pids)))], _signal.SIGKILL)
+        return True
+    except OSError:
+        return False
+
+
+class _ChaosCheckFailed(RuntimeError):
+    """A workload's OWN acceptance check failed (non-finite result,
+    hung future, unhealthy exit wave) — distinct from RuntimeError-
+    typed terminal failures the serve layer legitimately answers
+    (FleetUnavailable, RemoteApplyError), which are acceptable
+    outcomes, not chaos failures."""
+
+
+def _procfleet(tmp, restarts):
+    """The process fleet under seeded kill/hang chaos: a workers=2
+    service takes waves of traffic while the workload SIGKILLs live
+    worker processes between (and during) waves and the active plan
+    batters the parent-side serve sites.  The contract being proven is
+    PR-15's promotion invariant: a worker process death loses NOTHING
+    — in-flight flushes requeue onto the supervisor's replacement,
+    every submitted future resolves (result or typed failure; a hung
+    future raises → chaos exit 1), and after the last kill a clean
+    wave serves 100% with bit-finite results."""
+    from concurrent.futures import TimeoutError as _FTimeout
+
+    import numpy as np
+
+    from tools.serve_bench import build_service
+
+    dim = 8
+    svc, item_shape = build_service(
+        dim=dim,
+        max_batch=8,
+        max_wait_ms=2.0,
+        queue_bound=256,
+        deadline_ms=None,
+        workers=2,
+        supervise_interval_s=0.1,
+        heartbeat_s=5.0,
+        restart_limit=10_000,
+    )
+    rng = np.random.default_rng(7 + int(restarts))
+    xs = rng.normal(size=(32,) + tuple(item_shape)).astype(np.float32)
+    hung = 0
+    try:
+        for wave in range(4):
+            futs = []
+            for i in range(xs.shape[0]):
+                try:
+                    futs.append(svc.submit(xs[i]))
+                except Exception:
+                    futs.append(None)  # typed admission refusal
+                if i == 10:
+                    # mid-wave: kill a seeded-random live worker
+                    _kill_live_worker(svc, lambda n: int(rng.integers(n)))
+            for f in futs:
+                if f is None:
+                    continue
+                try:
+                    y = np.asarray(f.result(timeout=30.0))
+                    if not np.all(np.isfinite(y)):
+                        raise _ChaosCheckFailed(
+                            "non-finite result after a kill"
+                        )
+                except _FTimeout:
+                    hung += 1
+                except _ChaosCheckFailed:
+                    raise
+                except Exception:
+                    pass  # typed failure (FleetUnavailable, remote
+                    # errors): an acceptable terminal
+        if hung:
+            raise _ChaosCheckFailed(
+                f"{hung} future(s) hung across worker SIGKILLs — "
+                "the process fleet lost admitted work"
+            )
+        # exit gate: with the kills over, a clean wave must serve 100%
+        deadline = time.monotonic() + 30.0
+        clean = 0
+        while clean < xs.shape[0] and time.monotonic() < deadline:
+            clean = 0
+            waiters = []
+            for i in range(xs.shape[0]):
+                try:
+                    waiters.append(svc.submit(xs[i]))
+                except Exception:
+                    pass
+            for f in waiters:
+                try:
+                    f.result(timeout=30.0)
+                    clean += 1
+                except Exception:
+                    pass
+            if clean < xs.shape[0]:
+                time.sleep(0.2)
+        if clean < xs.shape[0]:
+            raise _ChaosCheckFailed(
+                f"fleet unhealthy after kills: clean wave served "
+                f"{clean}/{xs.shape[0]}"
+            )
+    finally:
+        svc.close()
+
+
 WORKLOADS = {
     "bcd": _bcd,
     "ooc": _ooc,
@@ -323,6 +446,7 @@ WORKLOADS = {
     "stream": _stream,
     "serve_artifacts": _serve_artifacts,
     "tenants": _tenants,
+    "procfleet": _procfleet,
 }
 
 
@@ -365,6 +489,7 @@ def run_soak(
     replicas: int = 2,
     wave: int = 48,
     result_timeout: float = 30.0,
+    workers: int = 0,
 ) -> dict:
     """Loop seeded randomized multi-site fault plans against a LIVE
     serving fleet; every submitted future must resolve (a completed
@@ -383,19 +508,27 @@ def run_soak(
     from tools import serve_bench
 
     rng = _random.Random(seed)
+    fleet_kw = (
+        # process fleet soak (PR 15): worker PROCESSES behind the same
+        # router — the plan menu still fires at the parent-side sites,
+        # and the soak loop additionally SIGKILLs live workers
+        dict(workers=workers)
+        if workers
+        else dict(replicas=replicas)
+    )
     svc, item_shape = serve_bench.build_service(
         dim=8,
         max_batch=8,
         max_wait_ms=2.0,
         queue_bound=256,
         deadline_ms=None,
-        replicas=replicas,
         # soak services heal aggressively: short heartbeat, fast sweep,
         # a restart budget the whole soak cannot exhaust
         supervise_interval_s=0.1,
         heartbeat_s=5.0,
         restart_limit=10_000,
         hedge_ms=25.0,
+        **fleet_kw,
     )
     payload = np.random.default_rng(seed).normal(
         size=(wave,) + tuple(item_shape)
@@ -404,23 +537,39 @@ def run_soak(
         "seconds": seconds,
         "seed": seed,
         "replicas": replicas,
+        "workers": workers,
         "iterations": 0,
         "submitted": 0,
         "completed": 0,
         "failed_typed": 0,
         "rejected": 0,
         "hung": 0,
+        "process_kills": 0,
         "plans": [],
     }
+
+    def _maybe_kill_worker() -> None:
+        """Process-fleet soak action: SIGKILL a seeded-random live
+        worker child mid-wave (the failure mode threads can't even
+        have) — the supervisor must heal and no future may hang."""
+        if _kill_live_worker(svc, rng.randrange):
+            report["process_kills"] += 1
+
     try:
         end = time.monotonic() + float(seconds)
         while time.monotonic() < end:
             plan = _soak_plan(rng)
             report["iterations"] += 1
             report["plans"].append(plan)
+            # process fleets get killed roughly every other iteration
+            kill_at = (
+                rng.randrange(wave) if workers and rng.random() < 0.5 else None
+            )
             futs = []
             with faults.inject(plan):
                 for i in range(wave):
+                    if i == kill_at:
+                        _maybe_kill_worker()
                     try:
                         futs.append(svc.submit(payload[i]))
                     except Exception:
@@ -502,6 +651,15 @@ def main(argv=None) -> int:
         "XLA_FLAGS=--xla_force_host_platform_device_count=N on CPU)",
     )
     ap.add_argument(
+        "--soak-workers",
+        type=int,
+        default=0,
+        help="run the soak over a PROCESS fleet of this many worker "
+        "processes (0 = the threaded fleet): the soak loop then also "
+        "SIGKILLs live workers mid-wave — every future must still "
+        "resolve",
+    )
+    ap.add_argument(
         "--workload",
         default="bcd",
         help=f"one of {sorted(WORKLOADS)} or module.path:function",
@@ -555,7 +713,10 @@ def main(argv=None) -> int:
 
     if args.soak is not None:
         report = run_soak(
-            args.soak, seed=args.seed, replicas=args.soak_replicas
+            args.soak,
+            seed=args.seed,
+            replicas=args.soak_replicas,
+            workers=args.soak_workers,
         )
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1

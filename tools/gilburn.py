@@ -1,0 +1,55 @@
+"""The compute-bound (GIL-held) bench transformer, in its own module.
+
+``GilBurnFeature`` must be defined at module level so worker processes
+can unpickle it from a staged deploy payload — and importing it pulls
+``keystone_tpu.workflow.transformer`` (hence jax), so it lives HERE
+rather than in ``tools/serve_bench.py``: serve_bench keeps its
+zero-top-level-keystone-imports design (``--help`` stays instant, and
+legs configure JAX platforms before any backend initializes), while
+the procs A/B imports this module lazily when it actually builds the
+workload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.workflow.transformer import Transformer
+
+
+class GilBurnFeature(Transformer):
+    """Deterministic GIL-bound per-row featurizer: scales each row by
+    ``0.5 + crc_mix(row)/2`` where ``crc_mix`` is ``rounds`` chained
+    CRC32 passes over the row's bytes — pure interpreter-loop work, no
+    BLAS, no GIL release, like real tokenize/ngram featurization
+    stages.  Bit-deterministic (integer CRC math on exact bytes), so
+    thread and process fleets must produce identical output bytes.
+    Pickles cleanly (worker processes load it from the staged
+    payload)."""
+
+    #: pure-Python host compute: the stage-fusion rule must not inline
+    #: it into a jitted chain (its apply is untraceable by design)
+    fusable = False
+
+    def __init__(self, rounds: int = 300):
+        self.rounds = int(rounds)
+
+    def params(self):
+        return (self.rounds,)
+
+    def _burn(self, row_bytes: bytes) -> float:
+        import zlib
+
+        h = zlib.crc32(row_bytes)
+        for _ in range(self.rounds):
+            h = zlib.crc32(row_bytes, h)
+        return (h % 1000003) / 1000003.0
+
+    def apply_dataset(self, ds):
+        import jax.numpy as jnp
+
+        xs = np.asarray(ds.array)
+        out = np.empty_like(xs)
+        for i in range(xs.shape[0]):
+            out[i] = xs[i] * np.float32(0.5 + 0.5 * self._burn(xs[i].tobytes()))
+        return ds.with_array(jnp.asarray(out))

@@ -47,6 +47,14 @@ Rules:
   stalls the async dispatch pipeline the fit-path dataflow relies on
   (double-buffered staging + donated epoch carries).  A deliberate,
   obs-gated read takes a trailing ``# lint: allow-host-sync``;
+- ``proc-spawn``   — no direct ``multiprocessing`` import (or
+  ``os.fork``/``os.forkpty`` call) outside the serve worker modules
+  (``serve/wire.py``, ``serve/worker.py``, ``serve/procfleet.py``):
+  a forked JAX runtime inherits locked internals and deadlocks on
+  first dispatch, so process management is fenced into the modules
+  that enforce the ``spawn`` start method.  A deliberate, safe use
+  (an explicit spawn/forkserver context) takes a trailing
+  ``# lint: allow-proc-spawn``;
 - ``attr``         — literal keyword attribute keys at span/event emit
   sites (``ledger.span/event(...)``, flight-recorder
   ``rec.annotate/finish/batch/batch_update/ops(...)``) must be
@@ -134,6 +142,15 @@ SUPERVISED_PREFIXES = (
     "keystone_tpu/loaders/stream.py",
     "keystone_tpu/parallel/multihost.py",
     "keystone_tpu/serve/",
+)
+
+#: the only modules that may touch ``multiprocessing`` directly: the
+#: process-fleet worker modules, which enforce the spawn start method
+#: (forked JAX runtimes deadlock).  Everything else goes through them.
+PROC_SPAWN_ALLOWED = (
+    "keystone_tpu/serve/wire.py",
+    "keystone_tpu/serve/worker.py",
+    "keystone_tpu/serve/procfleet.py",
 )
 
 #: solver modules whose BCD sweep / epoch loops ride the async fit-path
@@ -239,6 +256,11 @@ def _is_supervised(rel_path: str) -> bool:
 def _is_solver_sweep(rel_path: str) -> bool:
     rel = rel_path.replace(os.sep, "/")
     return any(rel.startswith(p) for p in SOLVER_SYNC_PREFIXES)
+
+
+def _proc_spawn_allowed(rel_path: str) -> bool:
+    rel = rel_path.replace(os.sep, "/")
+    return any(rel == p for p in PROC_SPAWN_ALLOWED)
 
 
 # ------------------------------------------------------------ obs gating
@@ -372,13 +394,15 @@ def lint_source(
     supervised: Optional[bool] = None,
     solver_scoped: Optional[bool] = None,
     attr_vocab: Optional[frozenset] = None,
+    proc_fenced: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one file's source.  ``metric_kinds`` accumulates
     name → (kind, path, line) across files for the metric-kind rule.
-    ``supervised`` overrides the path-based wall-clock scoping, and
-    ``solver_scoped`` the host-sync scoping (tests).  ``attr_vocab``:
-    the registered span/event attribute vocabulary — None skips the
-    ``attr`` rule (``lint_paths`` loads it from obs/ledger.py)."""
+    ``supervised`` overrides the path-based wall-clock scoping,
+    ``solver_scoped`` the host-sync scoping, and ``proc_fenced`` the
+    proc-spawn scoping (tests).  ``attr_vocab``: the registered
+    span/event attribute vocabulary — None skips the ``attr`` rule
+    (``lint_paths`` loads it from obs/ledger.py)."""
     out: List[Violation] = []
     lines = source.splitlines()
     try:
@@ -389,6 +413,61 @@ def lint_source(
         supervised = _is_supervised(rel_path)
     if solver_scoped is None:
         solver_scoped = _is_solver_sweep(rel_path)
+    if proc_fenced is None:
+        proc_fenced = not _proc_spawn_allowed(rel_path)
+
+    # ---- proc-spawn: multiprocessing/os.fork outside the worker fence
+    if proc_fenced:
+        for node in ast.walk(tree):
+            bad_line = None
+            what = None
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "multiprocessing":
+                        bad_line, what = node.lineno, f"import {alias.name}"
+                        break
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").split(".")[0]
+                if mod == "multiprocessing":
+                    bad_line, what = node.lineno, f"from {node.module} import"
+                elif mod == "os":
+                    # `from os import fork` escapes the attribute check
+                    forked = [
+                        a.name
+                        for a in node.names
+                        if a.name in ("fork", "forkpty")
+                    ]
+                    if forked:
+                        bad_line = node.lineno
+                        what = f"from os import {', '.join(forked)}"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                # ANY <name>.fork()/<name>.forkpty() — aliased os
+                # modules (`import os as _os`) must not slip the fence
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("fork", "forkpty")
+                    and isinstance(f.value, ast.Name)
+                ):
+                    bad_line = node.lineno
+                    what = f"{f.value.id}.{f.attr}()"
+            if bad_line is not None and not _allowed(
+                lines, bad_line, "proc-spawn"
+            ):
+                out.append(
+                    Violation(
+                        rel_path,
+                        bad_line,
+                        "proc-spawn",
+                        f"{what} outside the serve worker fence "
+                        "(serve/wire.py, serve/worker.py, "
+                        "serve/procfleet.py) — forked JAX runtimes "
+                        "deadlock; route process use through the "
+                        "process fleet (or annotate "
+                        "'# lint: allow-proc-spawn' for an explicit "
+                        "spawn/forkserver context)",
+                    )
+                )
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):

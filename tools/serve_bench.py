@@ -874,6 +874,313 @@ def run_straggler_ab(
 
 
 # ----------------------------------------------------- AOT artifact A/Bs
+def build_gil_pipeline(
+    dim: int = 64,
+    classes: int = 16,
+    burn_rounds: int = 300,
+    seed: int = 0,
+):
+    """The COMPUTE-BOUND (not stall-emulated) workload for the
+    thread-vs-process A/B: a deterministic pure-Python featurizer
+    (iterated CRC mixing per row — interpreter-loop work that HOLDS the
+    GIL, like real tokenize/ngram featurization stages) feeding the
+    normalize→linear head.  On a multi-core host, N worker THREADS
+    serialize on the GIL through this stage while N worker PROCESSES
+    compute in parallel — which is exactly the claim
+    ``bench.py --leg-serve-procs`` measures.  Bit-deterministic: the
+    burn factor is integer CRC math on the row's exact bytes, so
+    thread and process fleets must produce identical output bytes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.models.linear import LinearMapper
+    from keystone_tpu.ops.stats import NormalizeRows
+    from keystone_tpu.workflow import Pipeline
+
+    from tools.gilburn import GilBurnFeature
+
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(dim, classes)).astype(np.float32))
+    return (
+        Pipeline.of(GilBurnFeature(rounds=burn_rounds))
+        | NormalizeRows()
+        | LinearMapper(w)
+    )
+
+
+def build_gil_service(
+    mode: str,
+    workers: int = 2,
+    dim: int = 64,
+    burn_rounds: int = 300,
+    max_batch: int = 16,
+    queue_bound: int = 512,
+    max_wait_ms: float = 2.0,
+    seed: int = 0,
+    **serve_kw,
+):
+    """A primed service over the GIL-bound pipeline: ``mode="thread"``
+    → ``replicas=workers`` worker threads (the PR-8 fleet),
+    ``mode="process"`` → ``workers=workers`` worker processes (PR-15).
+    Recorder off in both arms (identical per-request Python, pinned by
+    its own leg)."""
+    import numpy as np
+
+    from keystone_tpu.serve import serve
+
+    pipe = build_gil_pipeline(dim=dim, burn_rounds=burn_rounds, seed=seed)
+    fleet_kw = (
+        dict(workers=int(workers))
+        if mode == "process"
+        else dict(replicas=int(workers))
+    )
+    svc = serve(
+        pipe,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_bound=queue_bound,
+        deadline_ms=None,
+        example=np.zeros((dim,), np.float32),
+        name=f"procs_{mode}",
+        recorder=False,
+        **fleet_kw,
+        **serve_kw,
+    )
+    return svc, (int(dim),)
+
+
+def run_procs_ab(
+    qps: float = 2500.0,
+    duration: float = 2.5,
+    rounds: int = 3,
+    workers: int = 2,
+    dim: int = 64,
+    burn_rounds: int = 2000,
+    max_batch: int = 16,
+) -> dict:
+    """Thread-vs-process fleet A/B on the compute-bound workload:
+    IDENTICAL open-loop load against ``replicas=workers`` threads and
+    ``workers=workers`` processes, order-alternating rounds with a
+    discarded warmup (the run_overhead_pair discipline), plus a
+    bit-identity probe (one fixed batch serially through both fleets
+    must produce byte-identical predictions).
+
+    HONEST SCALING BOUND: processes can beat threads only where cores
+    exist — the report carries ``cores`` (the scheduler affinity mask)
+    and ``achievable_speedup = min(workers, cores)``.  On a >= 2-core
+    host the acceptance claim is speedup >= 1.8×; a 1-core host cannot
+    express the claim (both arms share one core) and the leg instead
+    requires the process fleet to be within 30% of the threaded one
+    (the wire protocol's overhead bound) while still pinning
+    bit-identity.  The PR-8 fleet leg's speedup was STALL-dominated by
+    construction (an injected 40 ms flush delay that releases the GIL)
+    — it measured router concurrency, not multi-core compute; THIS leg
+    is the compute-bound claim."""
+    import os as _os
+    import statistics
+
+    import numpy as np
+
+    cores = len(_os.sched_getaffinity(0))
+    services = {}
+    samples: dict = {"thread": [], "process": []}
+    try:
+        # build + probe INSIDE the try: a spawn failure or a hung probe
+        # must still close (and reap the worker processes of) whatever
+        # was already built
+        for mode in ("thread", "process"):
+            services[mode] = build_gil_service(
+                mode,
+                workers=workers,
+                dim=dim,
+                burn_rounds=burn_rounds,
+                max_batch=max_batch,
+                # offered load sits ABOVE capacity so achieved QPS
+                # measures capacity; a modest bound keeps the
+                # post-offer tail short
+                queue_bound=512,
+            )
+
+        # bit-identity probe on quiet services (serial submits)
+        rng = np.random.default_rng(11)
+        probe = rng.normal(size=(24, dim)).astype(np.float32)
+        digests = {}
+        for mode, (svc, _shape) in services.items():
+            outs = [
+                np.asarray(svc.submit(probe[i]).result(timeout=60.0))
+                for i in range(probe.shape[0])
+            ]
+            digests[mode] = _prediction_sha(np.stack(outs))
+        identical = digests["thread"] == digests["process"]
+
+        for rnd in range(max(2, int(rounds)) + 1):
+            order = (
+                ("thread", "process")
+                if rnd % 2 == 0
+                else ("process", "thread")
+            )
+            for mode in order:
+                svc, item_shape = services[mode]
+                rep = run_bench(
+                    svc,
+                    item_shape,
+                    qps=qps,
+                    duration=duration if rnd > 0 else 0.5,
+                    deadline_ms=None,
+                )
+                if rnd > 0:
+                    samples[mode].append(rep)
+    finally:
+        for svc, _ in services.values():
+            svc.close()
+
+    def med(mode: str, key: str):
+        vals = [r[key] for r in samples[mode] if r.get(key) is not None]
+        return round(float(statistics.median(vals)), 2) if vals else None
+
+    t_qps, p_qps = med("thread", "achieved_qps"), med("process", "achieved_qps")
+    speedup = round(p_qps / t_qps, 3) if t_qps and p_qps else None
+    achievable = min(int(workers), cores)
+    ok = bool(identical) and speedup is not None and (
+        speedup >= 1.8 if cores >= 2 else speedup >= 0.7
+    )
+    return {
+        "offered_qps": qps,
+        "duration_s": duration,
+        "rounds": len(samples["thread"]),
+        "workers": workers,
+        "cores": cores,
+        "burn_rounds": burn_rounds,
+        "thread_qps": t_qps,
+        "process_qps": p_qps,
+        "thread_p99_ms": med("thread", "p99_ms"),
+        "process_p99_ms": med("process", "p99_ms"),
+        "speedup": speedup,
+        "achievable_speedup": achievable,
+        "cores_limited": cores < int(workers),
+        "predictions_identical": bool(identical),
+        "prediction_sha": digests,
+        "ok": ok,
+        "note": (
+            "compute-bound (GIL-held featurizer) A/B: threads measure "
+            "the GIL, processes measure cores.  The PR-8 fleet leg's "
+            "~2.6x was stall-dominated by construction (injected "
+            "GIL-releasing flush delay) and was never a multi-core "
+            "hardware claim."
+        ),
+    }
+
+
+def run_autoscale_scenario(
+    qps: float = 2000.0,
+    duration: float = 4.0,
+    idle_timeout: float = 60.0,
+    max_workers: int = 3,
+    dim: int = 64,
+    burn_rounds: int = 2000,
+) -> dict:
+    """The autoscale acceptance scenario: a 1-worker process fleet
+    under sustained open-loop load must scale up (1 → N as queue/
+    occupancy pressure mounts), then — offered load gone — scale back
+    down to the floor, with EVERY submitted request resolving
+    successfully (zero dropped, zero hung: the queue bound is sized
+    above the offered burst so nothing is sheddable)."""
+    import time as _time
+
+    import numpy as np
+
+    from keystone_tpu.serve import serve
+
+    pipe = build_gil_pipeline(dim=dim, burn_rounds=burn_rounds)
+    svc = serve(
+        pipe,
+        max_batch=16,
+        max_wait_ms=2.0,
+        queue_bound=100_000,
+        deadline_ms=None,
+        example=np.zeros((dim,), np.float32),
+        name="procs_autoscale",
+        recorder=False,
+        workers=1,
+        autoscale=dict(
+            min_workers=1,
+            max_workers=int(max_workers),
+            interval_s=0.4,
+            up_queue_frac=0.002,  # queue_bound is huge; react to depth
+            up_cooldown_s=1.0,
+            down_ticks=4,
+            down_cooldown_s=3.0,
+            # scale-down keyed to an empty queue + calm burn: the 60 s
+            # occupancy window decays too slowly for a seconds-scale
+            # scenario to gate on it
+            down_occupancy=0.95,
+        ),
+    )
+    peak = 1
+    workers_track = []
+    futs = []
+    rng = np.random.default_rng(5)
+    payload = rng.normal(size=(64, dim)).astype(np.float32)
+    t0 = _time.monotonic()
+    try:
+        interval = 1.0 / qps
+        next_t = t0
+        i = 0
+        while _time.monotonic() - t0 < duration:
+            now = _time.monotonic()
+            if now < next_t:
+                _time.sleep(min(next_t - now, 0.002))
+                continue
+            futs.append(svc.submit(payload[i % payload.shape[0]]))
+            i += 1
+            next_t += interval
+            if i % 50 == 0:
+                n = svc.replicas
+                workers_track.append(n)
+                peak = max(peak, n)
+        # drain: every admitted request must complete
+        from concurrent.futures import TimeoutError as _FTimeout
+
+        completed = 0
+        errors = 0
+        hung = 0
+        for f in futs:
+            try:
+                f.result(timeout=180.0)
+                completed += 1
+            except _FTimeout:
+                hung += 1
+            except Exception:
+                errors += 1
+        peak = max(peak, svc.replicas)
+        # idle: the fleet must come back down to the floor
+        deadline = _time.monotonic() + idle_timeout
+        final = svc.replicas
+        while final > 1 and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+            final = svc.replicas
+        scaler = svc.autoscaler.status() if svc.autoscaler else {}
+    finally:
+        svc.close()
+    return {
+        "offered_qps": qps,
+        "duration_s": duration,
+        "submitted": len(futs),
+        "completed": completed,
+        "errors": errors,
+        "hung": hung,
+        "peak_workers": peak,
+        "final_workers": final,
+        "workers_track": workers_track[-20:],
+        "scaled_up": peak > 1,
+        "scaled_down": final == 1,
+        "autoscaler": scaler,
+        "ok": (
+            errors == 0 and hung == 0 and peak > 1 and final == 1
+        ),
+    }
+
+
 def publish_bench_registry(
     root: str,
     dim: int = 64,
@@ -1310,7 +1617,58 @@ def main(argv=None) -> int:
         default=2,
         help="samples per arm for --cold-start (order-alternated)",
     )
+    ap.add_argument(
+        "--workers",
+        type=int,
+        default=0,
+        help="PROCESS fleet: serve with N worker processes instead of "
+        "worker threads (0 = threaded).  With --procs-ab, the fleet "
+        "size of BOTH arms of the thread-vs-process A/B",
+    )
+    ap.add_argument(
+        "--procs-ab",
+        action="store_true",
+        help="run the thread-vs-process A/B on the compute-bound "
+        "(GIL-held featurizer) workload instead of the load generator: "
+        "achieved-QPS per arm, speedup vs the core-count-aware bound, "
+        "and a bit-identity pin",
+    )
+    ap.add_argument(
+        "--autoscale-scenario",
+        action="store_true",
+        help="run the autoscale acceptance scenario: a 1-worker "
+        "process fleet scales 1->N under open-loop load and back down "
+        "when idle, with zero dropped or hung requests",
+    )
+    ap.add_argument(
+        "--burn-rounds",
+        type=int,
+        default=2000,
+        help="CRC passes per row for the GIL-bound workload "
+        "(--procs-ab / --autoscale-scenario)",
+    )
     args = ap.parse_args(argv)
+
+    if args.procs_ab:
+        report = run_procs_ab(
+            qps=args.qps,
+            duration=args.duration,
+            rounds=args.ab_rounds,
+            workers=args.workers or 2,
+            dim=args.dim,
+            burn_rounds=args.burn_rounds,
+        )
+        print(json.dumps(report, indent=2))
+        return 0 if report.get("ok") else 1
+
+    if args.autoscale_scenario:
+        report = run_autoscale_scenario(
+            qps=args.qps,
+            duration=args.duration,
+            burn_rounds=args.burn_rounds,
+        )
+        print(json.dumps(report, indent=2))
+        return 0 if report.get("ok") else 1
 
     if args.cold_start:
         report = {
@@ -1338,6 +1696,11 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=2))
         return 0
 
+    fleet_kw = (
+        dict(workers=args.workers)
+        if args.workers
+        else dict(replicas=args.replicas)
+    )
     svc, item_shape = build_service(
         dim=args.dim,
         classes=args.classes,
@@ -1346,9 +1709,9 @@ def main(argv=None) -> int:
         queue_bound=args.queue_bound,
         deadline_ms=args.deadline_ms,
         model=args.model,
-        replicas=args.replicas,
         recorder=not args.no_recorder,
         hedge_ms=args.hedge_ms,
+        **fleet_kw,
     )
     swap_pipeline = None
     if args.swap_mid_run:
