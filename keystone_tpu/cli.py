@@ -2,6 +2,7 @@
 
     python -m keystone_tpu.cli <PipelineName> [pipeline flags...]
     python -m keystone_tpu.cli serve --model model.pkl [serve flags...]
+    python -m keystone_tpu.cli worker --connect HOST:PORT [worker flags...]
     python -m keystone_tpu.cli check <PipelineName> [check flags...]
     python -m keystone_tpu.cli check --model model.pkl [check flags...]
     python -m keystone_tpu.cli --list
@@ -100,6 +101,43 @@ def _serve_main(argv) -> int:
         "over a shared-memory wire, so a multi-core host's throughput "
         "is bounded by cores, not the GIL.  0 (default) = the threaded "
         "fleet.  Exclusive with --replicas > 1; single-tenant only.",
+    )
+    ap.add_argument(
+        "--hosts",
+        default=None,
+        metavar="HOST[:SLOTS],...",
+        help="CROSS-HOST fleet (serve/net.py; requires --workers >= 1): "
+        "a host map of boxes where workers may be spawned, e.g. "
+        "'local:2,gpu-a:4,gpu-b:4'.  'local' spawns on this machine; "
+        "remote hosts are reached over ssh and connect back to "
+        "--listen-host:--listen-port over TCP.  Each worker beats a "
+        "heartbeat lease; an expired lease is treated as death (the "
+        "flush re-serves on a survivor) and the worker self-fences so "
+        "a healed partition cannot double-serve.  Without --hosts, "
+        "--workers stays on the shared-memory transport.",
+    )
+    ap.add_argument(
+        "--lease-s",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="heartbeat lease length for the cross-host fleet (default "
+        "5.0): both sides beat every lease/4; this much silence fences "
+        "the worker / declares it dead at the router",
+    )
+    ap.add_argument(
+        "--listen-host",
+        default=None,
+        metavar="ADDR",
+        help="interface the cross-host fleet's registration listener "
+        "binds (default 127.0.0.1 — set 0.0.0.0 when workers connect "
+        "from other boxes)",
+    )
+    ap.add_argument(
+        "--listen-port",
+        type=int,
+        default=None,
+        help="registration listener port (default 0 = ephemeral)",
     )
     ap.add_argument(
         "--autoscale",
@@ -273,11 +311,26 @@ def _serve_main(argv) -> int:
     if args.workers and multi:
         ap.error("--workers is single-tenant only (the shared stage "
                  "pool needs in-process walks)")
+    if args.hosts and not args.workers:
+        ap.error("--hosts (cross-host fleet) requires --workers >= 1")
+    if args.hosts and multi:
+        ap.error("--hosts is single-tenant only")
     fleet_kw = (
         dict(workers=args.workers)
         if args.workers
         else dict(replicas=args.replicas)
     )
+    if args.hosts:
+        fleet_kw["hosts"] = args.hosts
+        net_opts = {}
+        if args.lease_s is not None:
+            net_opts["lease_s"] = args.lease_s
+        if args.listen_host is not None:
+            net_opts["listen_host"] = args.listen_host
+        if args.listen_port is not None:
+            net_opts["listen_port"] = args.listen_port
+        if net_opts:
+            fleet_kw["worker_opts"] = net_opts
     serve_kw = dict(
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
@@ -378,6 +431,59 @@ def _serve_main(argv) -> int:
         front.server.server_close()
         svc.close()
     return 0
+
+
+def _worker_main(argv) -> int:
+    """``worker`` subcommand: one remote replica of a cross-host
+    serving fleet (serve/net.py).  Connects back to a router started
+    with ``serve --hosts``, receives the deploy payload over the wire,
+    builds + primes the applier (the same cold-start ladder the
+    process fleet runs), and serves applies until the router says bye
+    — reconnecting with bounded backoff through partitions, and
+    self-fencing whenever its heartbeat lease lapses."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.cli worker",
+        description="run one remote serving worker: connect to a "
+        "router's registration listener, receive the model over TCP, "
+        "prime, and serve under a heartbeat lease",
+    )
+    ap.add_argument(
+        "--connect",
+        required=True,
+        metavar="HOST:PORT",
+        help="the router's registration listener (printed by serve "
+        "--hosts, or read service.listen_address)",
+    )
+    ap.add_argument(
+        "--name",
+        default=None,
+        help="worker label in router logs/metrics (default "
+        "<hostname>-<pid>)",
+    )
+    ap.add_argument(
+        "--connect-attempts",
+        type=int,
+        default=30,
+        help="bounded connect/reconnect retries (backoff+jitter) "
+        "before giving up on an unreachable router",
+    )
+    ap.add_argument(
+        "--backoff-seed",
+        type=int,
+        default=None,
+        help="seed the reconnect jitter (reproducible drills)",
+    )
+    args = ap.parse_args(argv)
+    from keystone_tpu.serve.net import run_worker
+
+    return run_worker(
+        args.connect,
+        name=args.name,
+        connect_attempts=args.connect_attempts,
+        backoff_seed=args.backoff_seed,
+    )
 
 
 def _export_main(argv) -> int:
@@ -620,6 +726,7 @@ def main(argv=None):
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
         print("usage: python -m keystone_tpu.cli <PipelineName> [flags]")
         print("       python -m keystone_tpu.cli serve --model model.pkl [flags]")
+        print("       python -m keystone_tpu.cli worker --connect HOST:PORT [flags]")
         print("       python -m keystone_tpu.cli export --model model.pkl --example-shape D0[,D1,...] [flags]")
         print("       python -m keystone_tpu.cli check <PipelineName>|--model model.pkl [flags]")
         print("pipelines:")
@@ -636,6 +743,12 @@ def main(argv=None):
 
         enable_compilation_cache()
         return _serve_main(rest)
+    if name == "worker":
+        _apply_platform_env()
+        from keystone_tpu.utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        return _worker_main(rest)
     if name == "export":
         _apply_platform_env()
         from keystone_tpu.utils.compile_cache import enable_compilation_cache

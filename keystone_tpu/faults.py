@@ -31,6 +31,11 @@ Named **sites** are threaded through the codebase::
                         in-hand flush is requeued for the supervisor's
                         restart), ``hang`` wedges it; this is how chaos
                         plans kill a live worker, not just one flush
+    serve.net.connect   remote worker dialing the router (serve/net.py)
+    serve.net.send      one outbound stream frame, either side; ctx
+                        ``link=NAME`` names the worker the frame is
+                        to/from, ``role=router|worker`` names the side
+    serve.net.recv      one inbound stream frame, either side (same ctx)
 
 A **plan** activates faults at sites, either via the ``inject`` context
 manager (tests) or the ``KEYSTONE_FAULTS`` environment variable — the
@@ -48,9 +53,18 @@ Plan grammar: ``site:token:token;site:token...`` where tokens are
   ``OSError`` so every transient-I/O retry path treats it as
   retryable), ``corrupt`` (flip bytes in the site's file), ``truncate``
   (halve the site's file), ``exit`` / ``exit=CODE`` (``os._exit`` — the
-  kill-worker action), and the **latency actions** ``delay=SECONDS``
+  kill-worker action), the **latency actions** ``delay=SECONDS``
   (stall the operation, then let it proceed) and ``hang`` (stall far
-  past any deadline — ``KEYSTONE_HANG_SECONDS``, default 3600 s);
+  past any deadline — ``KEYSTONE_HANG_SECONDS``, default 3600 s), and
+  the **wire action** ``drop`` (alias ``partition``) — valid only at
+  the ``serve.net.*`` sites, where the transport silently discards the
+  frame (the peer sees pure silence, exactly what a network partition
+  looks like).  ``drop`` never raises: :func:`fault_point` RETURNS the
+  advisory action string and the transport honors it, so a severed
+  link is detected by lease expiry, not by an exception the breaker
+  could classify.  At ``serve.net.*`` sites ``corrupt`` is likewise
+  advisory (there is no file): the sender flips bytes in the outbound
+  frame and the receiver's CRC check condemns the connection;
 - context matches: ``ctx.<key>=<value>`` restricts the spec to calls
   whose site context carries that value (string-compared), e.g.
   ``serve.replica:ctx.replica=0:delay=0.05`` stalls replica 0's
@@ -99,10 +113,19 @@ SITES = {
     "serve.swap",
     "serve.worker",
     "serve.artifact_load",
+    "serve.net.connect",
+    "serve.net.send",
+    "serve.net.recv",
     "kernel.sweep",
 }
 
-_ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang")
+_ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang", "drop")
+
+#: sites where file actions (corrupt) and the drop action are ADVISORY:
+#: fault_point returns the action name and the transport applies it to
+#: the in-flight frame (there is no file to damage and nothing local to
+#: raise — a partition is silence, not an exception)
+_WIRE_SITE_PREFIX = "serve.net."
 
 # file-damaging actions only make sense once the file is durably
 # published; failure actions fire while the operation is in flight.
@@ -263,6 +286,9 @@ def parse_plan(text: str) -> FaultPlan:
             key, _, val = tok.partition("=")
             if key in _ACTIONS and not val and key != "delay":
                 kwargs["action"] = key
+            elif key == "partition" and not val:
+                # chaos-drill vocabulary: a partition IS dropped frames
+                kwargs["action"] = "drop"
             elif key == "exit":
                 kwargs["action"] = "exit"
                 kwargs["exit_code"] = int(val)
@@ -296,6 +322,14 @@ def parse_plan(text: str) -> FaultPlan:
                 raise FaultPlanError(
                     f"bad fault token {tok!r} in clause {clause!r}"
                 )
+        if kwargs.get("action") == "drop" and not site.startswith(
+            _WIRE_SITE_PREFIX
+        ):
+            raise FaultPlanError(
+                f"drop/partition is a wire action; it is honored only "
+                f"at {_WIRE_SITE_PREFIX}* sites, not {site!r} (the site "
+                f"would silently ignore it)"
+            )
         specs.append(SiteSpec(site, **kwargs))
     return FaultPlan(specs, source=text)
 
@@ -384,14 +418,19 @@ def _truncate_file(path: str) -> None:
         f.truncate(size // 2)
 
 
-def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = None, **ctx) -> None:
+def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = None, **ctx) -> Optional[str]:
     """The injection hook threaded through the codebase.
 
     No active plan ⇒ a counter bump and an immediate return (the hot
     paths pay one dict lookup).  With a matching spec it raises
     :class:`FaultInjected`, damages the file at ``path``, or exits the
     process, per the spec's action.  File actions with no ``path`` fall
-    back to raising, so a plan never silently does nothing.
+    back to raising, so a plan never silently does nothing — EXCEPT at
+    the ``serve.net.*`` sites, where ``drop`` and ``corrupt`` are
+    advisory: the fired action name is RETURNED and the transport
+    applies it to the in-flight frame (discard it / flip its bytes).
+    Every other path returns ``None``; existing call sites ignore the
+    return value unchanged.
     """
     from keystone_tpu.obs import metrics
 
@@ -407,7 +446,8 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
     if env is not None:
         plans.append(env)
     if not plans:
-        return
+        return None
+    advisory: Optional[str] = None
     for plan in reversed(plans):  # innermost inject() wins
         for spec in plan.for_site(site):
             if not spec.matches(ctx):
@@ -430,6 +470,17 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
             )
             if spec.action == "exit":
                 os._exit(spec.exit_code)
+            if spec.action == "drop":
+                # a partition is silence: hand the verdict back to the
+                # transport (which skips the send / discards the recv)
+                # and keep scanning — a co-active raise still wins
+                advisory = "drop"
+                continue
+            if spec.action == "corrupt" and site.startswith(
+                _WIRE_SITE_PREFIX
+            ):
+                advisory = advisory or "corrupt"
+                continue
             if spec.action in ("delay", "hang"):
                 # latency, not failure: stall the operation in flight,
                 # then let it proceed.  The sleep is cancel-aware
@@ -451,3 +502,4 @@ def fault_point(site: str, path: Optional[str] = None, phase: Optional[str] = No
                 _truncate_file(path)
                 continue
             raise FaultInjected(site)
+    return advisory

@@ -28,6 +28,13 @@ from keystone_tpu.serve.procfleet import (  # noqa: F401
     WorkerHandle,
     WorkerSpawnError,
 )
+from keystone_tpu.serve.net import (  # noqa: F401
+    ConnectRetriesExhausted,
+    NetReplica,
+    NetWorkerHandle,
+    WorkerListener,
+    run_worker,
+)
 from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
 from keystone_tpu.serve.registry import (  # noqa: F401
     ModelRegistry,
@@ -52,10 +59,14 @@ from keystone_tpu.serve.tenants import (  # noqa: F401
 __all__ = [
     "AutoscalePolicy",
     "Autoscaler",
+    "ConnectRetriesExhausted",
     "FleetUnavailable",
     "HttpFrontend",
+    "NetReplica",
+    "NetWorkerHandle",
     "ProcessReplica",
     "RemoteApplier",
+    "WorkerListener",
     "Signals",
     "WorkerCrashed",
     "WorkerHandle",
@@ -74,6 +85,7 @@ __all__ = [
     "ServiceClosed",
     "UnknownTenant",
     "default_buckets",
+    "run_worker",
     "serve",
     "serve_http",
     "serve_multi",

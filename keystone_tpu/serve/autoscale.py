@@ -34,7 +34,10 @@ see is an outage generator.
 Cooldowns: ``up_cooldown_s`` after a scale-up (give the new worker a
 window to absorb load before judging again) and ``down_cooldown_s``
 after any action before a scale-down.  Scale-downs never go below
-``min_workers``; scale-ups never above ``max_workers``.
+``min_workers``; scale-ups never above ``max_workers`` — nor above the
+cross-host fleet's mapped slot capacity (``service.host_capacity``,
+net backend only): the controller will not ask for a worker no host
+has room to run.
 """
 
 from __future__ import annotations
@@ -269,6 +272,14 @@ class Autoscaler:
         )
         if action == "up":
             target = min(self.policy.max_workers, s.workers + 1)
+            # the cross-host fleet's host map bounds growth: a scale-up
+            # past the mapped slot capacity would spawn a worker no
+            # host has room to run (HostCapacityError mid-resize)
+            cap = getattr(svc, "host_capacity", None)
+            if cap is not None:
+                target = min(target, int(cap))
+            if target <= s.workers:
+                return None
             self._act("up", s, target)
             self._last_up = now
             self._last_any = now

@@ -455,19 +455,21 @@ class ReplicaPool:
             raise ValueError(
                 f"dispatch_window must be >= 1, got {dispatch_window}"
             )
-        if backend not in ("thread", "process"):
+        if backend not in ("thread", "process", "net"):
             raise ValueError(
-                f"backend must be 'thread' or 'process', got {backend!r}"
+                f"backend must be 'thread', 'process' or 'net', "
+                f"got {backend!r}"
             )
-        if backend == "process" and devices is not None:
+        if backend in ("process", "net") and devices is not None:
             raise ValueError(
-                "backend='process' owns device placement in the workers; "
-                "devices= applies to the thread backend only"
+                f"backend={backend!r} owns device placement in the "
+                f"workers; devices= applies to the thread backend only"
             )
         self.name = name
         #: replica backend: "thread" (the PR-8..14 in-process fleet,
-        #: byte-for-byte) or "process" (serve/procfleet.py — one worker
-        #: process per replica over the shared-memory wire protocol)
+        #: byte-for-byte), "process" (serve/procfleet.py — one worker
+        #: process per replica over the shared-memory wire protocol) or
+        #: "net" (serve/net.py — lease-fenced remote workers over TCP)
         self.backend = backend
         #: process-backend knobs (buckets/item_shape/dtype prime the
         #: worker at spawn; ready_timeout bounds spawn→ready)
@@ -525,11 +527,30 @@ class ReplicaPool:
         self._payload_seq = 0
         self._payload_path: Optional[str] = None
         self._staged_payload_path: Optional[str] = None
-        if backend == "process":
+        #: net backend: the router's accept side + the machine registry
+        #: scale-ups and heals spawn capacity from
+        self._listener = None
+        self._hostmap = None
+        if backend in ("process", "net"):
             import tempfile
 
             self._payload_dir = tempfile.mkdtemp(prefix=f"ksw-{name}-")
             self._payload_path = self._stage_payload(pipeline, artifacts)
+        if backend == "net":
+            from keystone_tpu.serve import net as netmod
+            from keystone_tpu.utils import hostmap as hostmap_mod
+
+            opts = self._worker_opts
+            self._listener = netmod.WorkerListener(
+                host=opts.get("listen_host", "127.0.0.1"),
+                port=int(opts.get("listen_port", 0)),
+            )
+            hosts = opts.get("hosts") or ["local"]
+            self._hostmap = (
+                hosts
+                if isinstance(hosts, hostmap_mod.HostMap)
+                else hostmap_mod.HostMap(hosts)
+            )
         try:
             self.replicas: List[Replica] = self._build(
                 pipeline, int(replicas), devices, version
@@ -543,6 +564,10 @@ class ReplicaPool:
 
                 shutil.rmtree(self._payload_dir, ignore_errors=True)
                 self._payload_dir = None
+            if self._listener is not None:
+                self._listener.close()
+            if self._hostmap is not None:
+                self._hostmap.close()
             raise
 
     # ------------------------------------------------------------ build
@@ -597,8 +622,76 @@ class ReplicaPool:
             heartbeat_timeout=self._heartbeat_s,
         )
 
+    def _build_net_one(
+        self,
+        index: int,
+        version: str,
+        payload_path: Optional[str] = None,
+        spawn_grace_s: Optional[float] = None,
+    ) -> Replica:
+        """Claim (or spawn) one REMOTE worker and deploy the staged
+        generation onto it.  A pending registration — a fenced worker
+        rejoining after a healed partition, or one an operator started
+        by hand — is adopted within ``spawn_grace_s`` before the host
+        map is asked for fresh capacity, so a heal prefers the worker
+        that already holds this generation's built applier."""
+        from keystone_tpu.serve import net as netmod
+        from keystone_tpu.serve import procfleet
+
+        opts = self._worker_opts
+        path = payload_path or self._payload_path
+        with open(path, "rb") as f:
+            payload_bytes = f.read()
+        grace = (
+            float(opts.get("spawn_grace_s", 2.0))
+            if spawn_grace_s is None
+            else float(spawn_grace_s)
+        )
+        ready_timeout = float(
+            opts.get("ready_timeout", procfleet.DEFAULT_READY_TIMEOUT_S)
+        )
+        t0 = time.monotonic()
+        pending = self._listener.next_pending(timeout=grace)
+        if pending is None:
+            self._hostmap.spawn(self._listener.address)
+            pending = self._listener.next_pending(timeout=ready_timeout)
+            if pending is None:
+                raise procfleet.WorkerSpawnError(
+                    f"{self.name}: spawned worker for slot {index} never "
+                    f"registered within {ready_timeout:.0f}s"
+                )
+        handle = netmod.deploy_worker(
+            self.name,
+            index,
+            pending,
+            payload_bytes,
+            buckets=opts.get("buckets"),
+            item_shape=opts.get("item_shape"),
+            dtype=opts.get("dtype"),
+            lease_s=float(opts.get("lease_s", netmod.DEFAULT_LEASE_S)),
+            ready_timeout=ready_timeout,
+            max_frame_bytes=int(
+                opts.get(
+                    "max_frame_bytes", procfleet.wire.DEFAULT_MAX_FRAME_BYTES
+                )
+            ),
+        )
+        metrics.observe("serve.worker_spawn_seconds", time.monotonic() - t0)
+        installed = int(handle.ready_info.get("artifact_buckets", 0))
+        if installed:
+            metrics.inc("serve.artifact_hits", installed)
+        elif self._artifacts or self._staged_artifacts:
+            metrics.inc("serve.artifact_fallbacks")
+        return netmod.NetReplica(
+            index,
+            handle,
+            version=version,
+            pool_name=self.name,
+            heartbeat_timeout=self._heartbeat_s,
+        )
+
     def _devices_for(self, n: int, devices) -> list:
-        if self.backend == "process":
+        if self.backend in ("process", "net"):
             # workers own their devices; the router holds no placement
             return [None] * n
         if devices is not None:
@@ -632,6 +725,10 @@ class ReplicaPool:
         artifact install all happen inside the worker."""
         if self.backend == "process":
             return self._build_process_one(
+                index, version, payload_path=payload_path
+            )
+        if self.backend == "net":
+            return self._build_net_one(
                 index, version, payload_path=payload_path
             )
         if device is None and n == 1 and not force_clone:
@@ -699,9 +796,21 @@ class ReplicaPool:
         fresh interpreter + runtime import + prime, and paying them
         serially would make construction and swap wall-clock ~n× one
         cold start.  On any spawn failure the already-ready workers are
-        reaped before the error propagates — no half-born generation."""
+        reaped before the error propagates — no half-born generation.
+        The net backend rides the same fan-out with a zero adopt grace:
+        an initial generation claims every already-registered volunteer
+        first, then spawns the shortfall from the host map."""
+        if self.backend == "net":
+            def build(i: int) -> Replica:
+                return self._build_net_one(
+                    i, version, payload_path, spawn_grace_s=0.0
+                )
+        else:
+            def build(i: int) -> Replica:
+                return self._build_process_one(i, version, payload_path)
+
         if n == 1:
-            return [self._build_process_one(0, version, payload_path)]
+            return [build(0)]
         from concurrent.futures import ThreadPoolExecutor
 
         results: List[Optional[Replica]] = [None] * n
@@ -709,9 +818,7 @@ class ReplicaPool:
 
         def one(i: int) -> None:
             try:
-                results[i] = self._build_process_one(
-                    i, version, payload_path
-                )
+                results[i] = build(i)
             except BaseException as e:
                 errors.append(e)
 
@@ -725,7 +832,7 @@ class ReplicaPool:
         return [r for r in results if r is not None]
 
     def _build(self, pipeline, n: int, devices, version) -> List[Replica]:
-        if self.backend == "process":
+        if self.backend in ("process", "net"):
             return self._build_process_many(n, version, self._payload_path)
         devs = self._devices_for(n, devices)
         return [
@@ -995,7 +1102,7 @@ class ReplicaPool:
         and :meth:`commit` makes it the pool's bundle for later heals."""
         devices = [r.device for r in self.replicas]
         n = len(devices)
-        if self.backend == "process":
+        if self.backend in ("process", "net"):
             # a fresh generation of workers off a fresh payload,
             # spawned concurrently: the old workers keep serving their
             # (already-loaded) payload throughout
@@ -1204,6 +1311,23 @@ class ReplicaPool:
     def window(self) -> int:
         return self._window
 
+    @property
+    def host_capacity(self) -> Optional[int]:
+        """The host map's total worker-slot budget (net backend), or
+        ``None`` when unbounded / not a net fleet — the autoscaler
+        clamps scale-up targets to this."""
+        if self._hostmap is None:
+            return None
+        return self._hostmap.capacity()
+
+    @property
+    def listen_address(self) -> Optional[str]:
+        """``host:port`` of the worker listener (net backend) — what an
+        operator points a hand-started ``keystone worker`` at."""
+        if self._listener is None:
+            return None
+        return self._listener.address
+
     def set_window(self, n: int) -> int:
         """Retune the dispatch window live (the autoscaler's second
         lever): raising it deepens per-replica queueing before the
@@ -1346,6 +1470,13 @@ class ReplicaPool:
 
             shutil.rmtree(self._payload_dir, ignore_errors=True)
             self._payload_dir = None
+        if self._listener is not None:
+            self._listener.close()
+        if self._hostmap is not None:
+            # spawned worker processes are reaped here; hand-started
+            # workers see the listener close and exit on their own
+            # when their reconnect budget runs dry
+            self._hostmap.close()
         return abandoned
 
     def statuses(self) -> List[dict]:

@@ -436,6 +436,7 @@ class PipelineService:
         workers: int = 0,
         worker_opts: Optional[dict] = None,
         autoscale: Optional[dict] = None,
+        hosts=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -453,6 +454,12 @@ class PipelineService:
             raise ValueError(
                 "workers= owns device placement in the worker processes; "
                 "devices= applies to the thread fleet only"
+            )
+        if hosts is not None and workers < 1:
+            raise ValueError(
+                "hosts= selects the cross-host TCP fleet and needs "
+                "workers>=1 to size it; local workers=N without hosts "
+                "stays on the shared-memory transport"
             )
         # the persistent-compile-cache tier of the prime fallback ladder
         # (artifact → cache → compile): auto-enabled for library callers
@@ -497,9 +504,15 @@ class PipelineService:
         #: the GIL.  workers == 0 is the PR-14 threaded path, untouched.
         self.workers = workers
         if workers > 0:
-            pool_backend = "process"
+            # hosts= promotes the fleet onto the TCP transport
+            # (serve/net.py): workers register over a socket and beat a
+            # heartbeat lease instead of sharing memory.  Without hosts
+            # the shared-memory process path is byte-for-byte untouched.
+            pool_backend = "net" if hosts is not None else "process"
             replicas = workers
             pool_worker_opts = dict(worker_opts or {})
+            if hosts is not None:
+                pool_worker_opts.setdefault("hosts", hosts)
             pool_worker_opts.setdefault("buckets", list(self.buckets))
             pool_worker_opts.setdefault("item_shape", self._item_shape)
             pool_worker_opts.setdefault(
@@ -1188,6 +1201,20 @@ class PipelineService:
         ) / n
         budget = 1.0 - self._slo_target
         return None if budget <= 0.0 else bad / budget
+
+    @property
+    def host_capacity(self) -> Optional[int]:
+        """Total worker slots across the cross-host fleet's host map
+        (None off the net backend, or when any host is unbounded) — the
+        autoscaler clamps its grow target here so a scale-up can never
+        ask for workers no host has room to run."""
+        return getattr(self._pool, "host_capacity", None)
+
+    @property
+    def listen_address(self) -> Optional[str]:
+        """``host:port`` remote workers connect to (net backend only) —
+        what ``keystone worker --connect`` takes on another box."""
+        return getattr(self._pool, "listen_address", None)
 
     def scale_to(self, n: int, timeout: float = 60.0) -> int:
         """Resize the fleet to ``n`` replicas (grow: spawn → prime →
@@ -2182,6 +2209,7 @@ def serve(
     workers: int = 0,
     worker_opts: Optional[dict] = None,
     autoscale: Optional[dict] = None,
+    hosts=None,
 ) -> PipelineService:
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
@@ -2248,6 +2276,18 @@ def serve(
       host's throughput is bounded by cores, not the GIL.  Exclusive
       with ``replicas``/``devices``.  ``worker_opts`` tunes spawn
       (``ready_timeout``, ``max_slab_bytes``).
+    - ``hosts`` — the CROSS-HOST fleet (needs ``workers>=1``): workers
+      connect over TCP (``serve/net.py``) instead of sharing memory.
+      A host map (``"hostA:4,hostB:4"``, or a list / ``HostMap``)
+      tells the router where ``keystone worker --connect`` processes
+      may be spawned; ``"local"`` spawns on this box.  Each remote
+      worker beats a heartbeat lease — an expired lease is treated as
+      death (flushes re-served on survivors), and the worker
+      self-fences when its OWN lease lapses so a healed partition
+      cannot double-serve.  ``worker_opts`` grows ``lease_s``,
+      ``listen_host``/``listen_port``, ``spawn_grace_s``,
+      ``max_frame_bytes``.  Without ``hosts``, ``workers=N`` stays on
+      the shared-memory transport, byte-for-byte.
     - ``autoscale`` — SLO-driven autoscaling (default OFF): a config
       dict for :class:`~keystone_tpu.serve.autoscale.Autoscaler`
       (``min_workers``/``max_workers``/``interval_s``/thresholds).  A
@@ -2292,4 +2332,5 @@ def serve(
         workers=workers,
         worker_opts=worker_opts,
         autoscale=autoscale,
+        hosts=hosts,
     )

@@ -240,12 +240,12 @@ class MultiTenantService(PipelineService):
         dedup: bool = False,
         **kw,
     ):
-        if kw.get("workers"):
+        if kw.get("workers") or kw.get("hosts") is not None:
             raise NotImplementedError(
                 "multi-tenant serving runs in-process (the shared stage "
                 "pool and per-tenant containment need the executor walk); "
-                "workers= (process fleet) applies to single-tenant "
-                "services"
+                "workers= (process fleet) and hosts= (cross-host fleet) "
+                "apply to single-tenant services"
             )
         applier = MultiTenantApplier(models, pool=pool, share=share)
         self.tenants = tuple(applier.appliers)

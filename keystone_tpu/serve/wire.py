@@ -27,6 +27,22 @@ acknowledgement round-trip.  :meth:`SlabPool.acquire` refuses payloads
 past ``max_slab_bytes`` with :class:`PayloadTooLarge` (a typed refusal
 at dispatch beats an OOM in a worker that every tenant shares).
 
+**Cross-host framing (wire v2).**  Off-box peers (``serve/net.py``)
+cannot share memory, so the same control-frame discipline is carried
+over a raw TCP socket with the payload bytes INLINE::
+
+    MAGIC(4) | version(1)=2 | body_len(4) | payload_len(4) | crc32(4)
+    | JSON body | payload bytes
+
+Lengths and the CRC ride big-endian; the CRC covers body+payload so a
+corrupted or torn stream frame fails loudly (:class:`WireError`) at
+the receiver instead of misparsing — the connection is condemned and
+the worker replaced, exactly the slab-path discipline.  TCP gives no
+message boundaries, so the length prefix is load-bearing here where
+``multiprocessing.connection`` provided it for free.  A stream frame
+carries at most one array payload (:func:`array_payload` /
+:func:`payload_array`); the strict one-in-flight rule is unchanged.
+
 This module is transport only — no JAX, no pipeline imports — so both
 the router and a freshly spawned worker can import it before paying
 the accelerator-runtime import.
@@ -35,7 +51,9 @@ the accelerator-runtime import.
 from __future__ import annotations
 
 import json
+import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -45,6 +63,26 @@ import numpy as np
 #: unpack instead of silently misparsing.
 MAGIC = b"KSWP"
 VERSION = 1
+
+#: the socket (cross-host) framing version.  Distinct from the slab
+#: protocol's VERSION: the two transports can rev independently, and a
+#: v1 slab frame accidentally written to a socket fails the version
+#: check instead of the length parse.
+SOCKET_VERSION = 2
+
+#: stream-frame fixed header past magic+version: body length, payload
+#: length, crc32(body + payload) — all big-endian u32
+_STREAM_HEADER = struct.Struct(">III")
+_STREAM_PREFIX_LEN = len(MAGIC) + 1 + _STREAM_HEADER.size
+
+#: refuse stream frames past this before allocating (a garbage length
+#: field must not turn into a multi-GiB recv buffer)
+DEFAULT_MAX_FRAME_BYTES = 1 << 28  # 256 MiB
+
+#: once the first byte of a frame arrives, the rest must follow within
+#: this window — a peer that stalls mid-frame holds the channel torn,
+#: and a torn channel means replace-the-worker, not wait-forever
+MID_FRAME_TIMEOUT_S = 30.0
 
 #: slab size classes are powers of two from this floor — small enough
 #: that a probe request wastes little, large enough that the common
@@ -330,3 +368,166 @@ def recv_frame(conn, timeout: Optional[float] = None) -> dict:
     if timeout is not None and not conn.poll(timeout):
         raise TimeoutError(f"no frame within {timeout:.1f}s")
     return unpack_frame(conn.recv_bytes())
+
+
+# ------------------------------------------------- cross-host framing (v2)
+
+
+def pack_stream_frame(msg: dict, payload: bytes = b"") -> bytes:
+    """Serialize one socket frame: prefix + JSON body + inline payload.
+    The body carries the control message (op, flush id, array meta);
+    ``payload`` is the raw array bytes for remote peers that cannot
+    attach a slab."""
+    if not isinstance(msg, dict):
+        raise WireError(f"frame body must be a dict, got {type(msg).__name__}")
+    try:
+        body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    except (TypeError, ValueError) as e:
+        raise WireError(f"unserializable frame body: {e}") from e
+    payload = bytes(payload)
+    crc = zlib.crc32(payload, zlib.crc32(body)) & 0xFFFFFFFF
+    return (
+        MAGIC
+        + bytes([SOCKET_VERSION])
+        + _STREAM_HEADER.pack(len(body), len(payload), crc)
+        + body
+        + payload
+    )
+
+
+def _recv_exact(sock, n: int, idle_timeout: Optional[float]) -> bytes:
+    """Read exactly ``n`` bytes from ``sock``.
+
+    ``idle_timeout`` bounds the wait for the FIRST byte only (an idle
+    channel raises ``TimeoutError`` — the caller's poll loop); once any
+    byte of a frame has arrived the rest must land within
+    :data:`MID_FRAME_TIMEOUT_S` or the frame is declared torn
+    (:class:`WireError`).  A peer that closes cleanly between frames
+    raises ``EOFError``; a close MID-read is a truncated frame and
+    raises :class:`WireError`.
+    """
+    chunks: List[bytes] = []
+    got = 0
+    sock.settimeout(idle_timeout)
+    while got < n:
+        try:
+            chunk = sock.recv(n - got)
+        except (TimeoutError, OSError) as e:
+            # socket.timeout is TimeoutError; anything else is a real
+            # transport failure and propagates as the OSError it is
+            if not isinstance(e, TimeoutError):
+                raise
+            if got == 0:
+                raise TimeoutError(
+                    f"no frame within {idle_timeout}s"
+                ) from None
+            raise WireError(
+                f"stream frame stalled mid-read ({got}/{n} bytes)"
+            ) from None
+        if not chunk:
+            if got == 0:
+                raise EOFError("peer closed the connection")
+            raise WireError(
+                f"truncated stream frame (peer closed at {got}/{n} bytes)"
+            )
+        if got == 0:
+            # first byte landed: the frame is in flight — switch from
+            # the caller's idle poll to the torn-frame bound
+            sock.settimeout(MID_FRAME_TIMEOUT_S)
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_stream_frame(sock, msg: dict, payload: bytes = b"") -> None:
+    """Write one socket frame (blocking ``sendall``)."""
+    sock.sendall(pack_stream_frame(msg, payload))
+
+
+def recv_stream_frame(
+    sock,
+    timeout: Optional[float] = None,
+    max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+) -> Tuple[dict, bytes]:
+    """Receive one socket frame; returns ``(msg, payload_bytes)``.
+
+    ``timeout`` is the IDLE timeout (seconds until the first byte);
+    raises ``TimeoutError`` when no frame starts in time, ``EOFError``
+    on a clean close between frames, and :class:`WireError` on
+    anything torn: truncation mid-frame, foreign magic, version skew,
+    oversized length fields, or a CRC mismatch.
+    """
+    prefix = _recv_exact(sock, _STREAM_PREFIX_LEN, timeout)
+    if prefix[: len(MAGIC)] != MAGIC:
+        raise WireError("bad stream-frame magic (foreign or torn stream)")
+    ver = prefix[len(MAGIC)]
+    if ver != SOCKET_VERSION:
+        raise WireError(
+            f"stream-frame version {ver} != {SOCKET_VERSION} (peer skew)"
+        )
+    body_len, payload_len, crc = _STREAM_HEADER.unpack(
+        prefix[len(MAGIC) + 1 :]
+    )
+    if body_len + payload_len > max_frame_bytes:
+        raise WireError(
+            f"stream frame claims {body_len + payload_len} bytes "
+            f"(cap {max_frame_bytes}); refusing before allocation"
+        )
+    try:
+        body = (
+            _recv_exact(sock, body_len, MID_FRAME_TIMEOUT_S)
+            if body_len
+            else b""
+        )
+        payload = (
+            _recv_exact(sock, payload_len, MID_FRAME_TIMEOUT_S)
+            if payload_len
+            else b""
+        )
+    except (TimeoutError, EOFError) as e:
+        # the header already landed: any stall or close past it is a
+        # torn frame, never an idle channel
+        raise WireError(f"truncated stream frame: {e}") from None
+    got_crc = zlib.crc32(payload, zlib.crc32(body)) & 0xFFFFFFFF
+    if got_crc != crc:
+        raise WireError(
+            f"stream-frame CRC mismatch (got {got_crc:#010x}, "
+            f"header {crc:#010x}) — bytes damaged in flight"
+        )
+    try:
+        parsed = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"unparseable stream-frame body: {e}") from e
+    if not isinstance(parsed, dict):
+        raise WireError(
+            f"stream-frame body must be a dict, got {type(parsed).__name__}"
+        )
+    return parsed, payload
+
+
+def array_payload(arr: np.ndarray) -> Tuple[dict, bytes]:
+    """``(meta, bytes)`` for shipping an array inline in a stream
+    frame — the cross-host analogue of :func:`write_array`'s slab
+    reference."""
+    arr = np.ascontiguousarray(arr)
+    meta = {
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.str,
+        "nbytes": int(arr.nbytes),
+    }
+    return meta, arr.tobytes()
+
+
+def payload_array(meta: dict, payload: bytes) -> np.ndarray:
+    """Rehydrate an inline array payload; raises :class:`WireError`
+    when the meta and the byte count disagree (a mismatch that survived
+    the CRC means the SENDER was confused — fail loudly)."""
+    dtype = np.dtype(meta["dtype"])
+    shape = tuple(int(d) for d in meta["shape"])
+    expect = int(meta["nbytes"])
+    if len(payload) != expect:
+        raise WireError(
+            f"array payload carries {len(payload)} bytes but meta "
+            f"claims {expect}"
+        )
+    return np.frombuffer(payload, dtype=dtype).reshape(shape).copy()

@@ -143,6 +143,27 @@ def _prime(applier, buckets, item_shape, dtype) -> int:
     return n
 
 
+def build_from_payload(payload: dict, spec: dict):
+    """The full cold-start ladder shared by BOTH worker transports (the
+    pipe-spawned process worker and the TCP worker of ``serve/net.py``):
+    freeze the pipeline, install AOT artifacts (degrading to the
+    compile ladder on a damaged bundle), and prime every padding
+    bucket.  Returns ``(applier, installed, primed)``."""
+    applier, installed = _build_applier(payload)
+    primed = _prime(
+        applier,
+        spec.get("buckets"),
+        spec.get("item_shape"),
+        spec.get("dtype") or "float32",
+    )
+    return applier, installed, primed
+
+
+#: public name for the cross-process error taxonomy (the TCP worker
+#: relays its apply failures through the same classifier)
+classify_error = _classify
+
+
 def _artifact_keys(applier) -> list:
     """The (shape, dtype) keys of installed AOT bucket programs — the
     ready frame ships them so the router's prime loop can label its
@@ -199,13 +220,7 @@ def worker_main(conn, spec: dict) -> None:
     t0 = time.monotonic()
     try:
         payload = _load_payload(spec["payload_path"])
-        applier, installed = _build_applier(payload)
-        primed = _prime(
-            applier,
-            spec.get("buckets"),
-            spec.get("item_shape"),
-            spec.get("dtype") or "float32",
-        )
+        applier, installed, primed = build_from_payload(payload, spec)
     except BaseException as e:
         try:
             wire.send_frame(

@@ -351,3 +351,43 @@ def test_proc_spawn_rule_catches_aliased_and_from_import_fork(sites):
     ):
         vs = _run(src, sites)
         assert any(v.rule == "proc-spawn" for v in vs), src
+
+
+# --------------------------------------------------------------- socket
+def test_socket_rule_fires_on_import_forms(sites):
+    for src in (
+        "import socket\n",
+        "import socket as sk\n",
+        "from socket import create_connection\n",
+    ):
+        vs = _run(src, sites)
+        assert any(v.rule == "socket" for v in vs), src
+
+
+def test_socket_rule_scoped_to_the_transport_fence(sites):
+    src = "import socket\n"
+    # the cross-host transport pair may import socket directly
+    for fenced in ("keystone_tpu/serve/net.py", "keystone_tpu/serve/wire.py"):
+        vs = lint.lint_source(fenced, src, sites, {}, attr_vocab=None)
+        assert not [v for v in vs if v.rule == "socket"], fenced
+    # explicit override hook for tests
+    vs = lint.lint_source(
+        "elsewhere.py", src, sites, {}, attr_vocab=None, socket_fenced=False
+    )
+    assert not [v for v in vs if v.rule == "socket"]
+
+
+def test_socket_allow_comment_escapes(sites):
+    src = "import socket  # lint: allow-socket\n"
+    vs = _run(src, sites)
+    assert not [v for v in vs if v.rule == "socket"]
+
+
+def test_socket_rule_ignores_lookalike_modules(sites):
+    # socketserver / websockets are not the raw-socket fence's concern
+    for src in (
+        "import socketserver\n",
+        "from websockets import connect\n",
+    ):
+        vs = _run(src, sites)
+        assert not [v for v in vs if v.rule == "socket"], src

@@ -21,7 +21,17 @@ FeatureBlockStore, exercising blockstore.*), ``lbfgs`` (chunk-
 checkpointed dense L-BFGS), ``stream`` (a resilient StreamDataset
 sweep), ``kernel`` (checkpointed out-of-core kernel BCD — spills a
 RowBlockStore and sweeps gram blocks, exercising blockstore.* +
-kernel.sweep + ckpt.*).
+kernel.sweep + ckpt.*), ``nethost`` (a live 2-worker CROSS-HOST TCP
+fleet — ``serve/net.py`` — severed by a seeded network partition
+mid-wave and required to heal with zero lost futures).
+
+Network plans: the ``serve.net.connect``/``serve.net.send``/
+``serve.net.recv`` sites take ``drop`` (the frame vanishes — silence,
+not an error; ``partition`` is a grammar alias for it, so
+``serve.net.send:ctx.link=NAME:partition`` reads as what it does),
+``delay``, ``hang``, and ``corrupt`` (a flipped byte the far side's
+CRC condemns).  Context-match on ``ctx.link=<worker>`` to sever one
+worker's link; both directions (send + recv) make a full partition.
 
 Latency plans (``delay=SECONDS`` / ``hang`` actions) are first-class:
 pair them with ``--stage-deadline`` / ``--stream-timeout`` (and
@@ -438,6 +448,152 @@ def _procfleet(tmp, restarts):
         svc.close()
 
 
+def _nethost(tmp, restarts):
+    """The cross-host TCP fleet under a seeded network partition: a
+    workers=2 ``hosts=`` service (serve/net.py — every replica is a
+    spawned ``keystone worker --connect`` process under a heartbeat
+    lease) takes waves of traffic while the workload severs one
+    worker's link mid-wave — a ``serve.net.send``/``serve.net.recv``
+    ``drop`` plan held for ~3 lease windows, the ``partition`` alias
+    of the plan grammar.  The contract being proven is the PR's
+    partition invariant: the router declares the silent worker dead at
+    lease expiry and re-serves its in-flight flush on the survivor
+    (zero lost futures — a hung future raises → chaos exit 1), the
+    fenced worker discards its stale result and rejoins with a fresh
+    lease once the partition heals, and after the heal a clean wave
+    serves 100% from a 2-live fleet."""
+    import threading as _threading
+    from concurrent.futures import TimeoutError as _FTimeout
+
+    import numpy as np
+
+    from keystone_tpu import faults as _faults
+    from tools.serve_bench import build_service
+
+    dim = 8
+    svc, item_shape = build_service(
+        dim=dim,
+        max_batch=8,
+        max_wait_ms=2.0,
+        queue_bound=256,
+        deadline_ms=None,
+        workers=2,
+        hosts=["local", "local"],
+        supervise_interval_s=0.1,
+        heartbeat_s=10.0,
+        restart_limit=10_000,
+        worker_opts={"lease_s": 1.0, "spawn_grace_s": 3.0},
+    )
+    rng = np.random.default_rng(11 + int(restarts))
+    xs = rng.normal(size=(32,) + tuple(item_shape)).astype(np.float32)
+    hung = 0
+    severs: list = []
+    try:
+        links = sorted(
+            r.get("link")
+            for r in svc.replica_statuses()
+            if r.get("link")
+        )
+        if len(links) < 2:
+            raise _ChaosCheckFailed(
+                f"net fleet came up with links {links!r}; expected 2"
+            )
+
+        def _sever(victim: str) -> None:
+            # both directions of the victim's link drop on the router
+            # side: its beats stop arriving (lease expiry → declared
+            # dead) AND the router's frames stop reaching it (the
+            # worker's own lease lapses → self-fence).  ~3 lease
+            # windows is long past expiry on both sides.
+            plan = (
+                f"serve.net.send:ctx.link={victim}:drop;"
+                f"serve.net.recv:ctx.link={victim}:drop"
+            )
+            with _faults.inject(plan):
+                time.sleep(3.0)
+
+        for wave in range(4):
+            futs = []
+            for i in range(xs.shape[0]):
+                try:
+                    futs.append(svc.submit(xs[i]))
+                except Exception:
+                    futs.append(None)  # typed admission refusal
+                if wave == 1 and i == 10:
+                    # mid-wave: sever a seeded-random worker's link
+                    victim = links[int(rng.integers(len(links)))]
+                    th = _threading.Thread(
+                        target=_sever, args=(victim,), daemon=True
+                    )
+                    th.start()
+                    severs.append(th)
+            for f in futs:
+                if f is None:
+                    continue
+                try:
+                    y = np.asarray(f.result(timeout=60.0))
+                    if not np.all(np.isfinite(y)):
+                        raise _ChaosCheckFailed(
+                            "non-finite result across a partition"
+                        )
+                except _FTimeout:
+                    hung += 1
+                except _ChaosCheckFailed:
+                    raise
+                except Exception:
+                    pass  # typed failure: an acceptable terminal
+        for th in severs:
+            th.join(timeout=30.0)
+        if hung:
+            raise _ChaosCheckFailed(
+                f"{hung} future(s) hung across the partition — "
+                "the cross-host fleet lost admitted work"
+            )
+        # heal gate: the fenced worker must rejoin (fresh lease) —
+        # 2 live workers before the clean wave is demanded
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            live = [
+                r
+                for r in svc.replica_statuses()
+                if r.get("worker_alive")
+            ]
+            if len(live) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise _ChaosCheckFailed(
+                "fleet never healed to 2 live workers after the "
+                "partition lifted"
+            )
+        # exit gate: with the partition healed, a clean wave serves 100%
+        deadline = time.monotonic() + 30.0
+        clean = 0
+        while clean < xs.shape[0] and time.monotonic() < deadline:
+            clean = 0
+            waiters = []
+            for i in range(xs.shape[0]):
+                try:
+                    waiters.append(svc.submit(xs[i]))
+                except Exception:
+                    pass
+            for f in waiters:
+                try:
+                    f.result(timeout=30.0)
+                    clean += 1
+                except Exception:
+                    pass
+            if clean < xs.shape[0]:
+                time.sleep(0.2)
+        if clean < xs.shape[0]:
+            raise _ChaosCheckFailed(
+                f"fleet unhealthy after the partition: clean wave "
+                f"served {clean}/{xs.shape[0]}"
+            )
+    finally:
+        svc.close()
+
+
 WORKLOADS = {
     "bcd": _bcd,
     "ooc": _ooc,
@@ -447,7 +603,12 @@ WORKLOADS = {
     "serve_artifacts": _serve_artifacts,
     "tenants": _tenants,
     "procfleet": _procfleet,
+    "nethost": _nethost,
 }
+
+#: workloads that activate their own fault plan mid-run (a seeded
+#: partition, a timed sever) — runnable with no --plan at all
+SELF_INJECTING = frozenset({"nethost"})
 
 
 # --------------------------------------------------------------- soak
@@ -720,8 +881,11 @@ def main(argv=None) -> int:
         )
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1
-    if args.plan is None:
-        ap.error("--plan is required (unless --soak)")
+    if args.plan is None and args.workload not in SELF_INJECTING:
+        ap.error(
+            "--plan is required (unless --soak, or a self-injecting "
+            f"workload: {sorted(SELF_INJECTING)})"
+        )
 
     if args.stage_deadline is not None:
         os.environ["KEYSTONE_STAGE_DEADLINE"] = str(args.stage_deadline)
@@ -736,7 +900,14 @@ def main(argv=None) -> int:
     from keystone_tpu.obs import ledger as obs_ledger
     from keystone_tpu.obs import metrics
 
-    plan = faults.parse_plan(args.plan)  # fail fast on grammar errors
+    # fail fast on grammar errors; a self-injecting workload (nethost
+    # activates its own seeded partition plan mid-wave) may run with
+    # no outer plan at all
+    plan = (
+        faults.parse_plan(args.plan)
+        if args.plan is not None
+        else faults.FaultPlan([], source="(workload self-injected)")
+    )
     tmp = args.tmp or tempfile.mkdtemp(prefix="kst_chaos_")
 
     if args.workload in WORKLOADS:

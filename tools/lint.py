@@ -55,6 +55,12 @@ Rules:
   that enforce the ``spawn`` start method.  A deliberate, safe use
   (an explicit spawn/forkserver context) takes a trailing
   ``# lint: allow-proc-spawn``;
+- ``socket``       — no direct ``socket`` import outside the
+  cross-host transport modules (``serve/net.py``, ``serve/wire.py``):
+  a raw socket anywhere else bypasses the heartbeat-lease/fencing
+  discipline and the ``serve.net.*`` fault sites that make network
+  failure injectable.  A deliberate use takes a trailing
+  ``# lint: allow-socket``;
 - ``attr``         — literal keyword attribute keys at span/event emit
   sites (``ledger.span/event(...)``, flight-recorder
   ``rec.annotate/finish/batch/batch_update/ops(...)``) must be
@@ -151,6 +157,17 @@ PROC_SPAWN_ALLOWED = (
     "keystone_tpu/serve/wire.py",
     "keystone_tpu/serve/worker.py",
     "keystone_tpu/serve/procfleet.py",
+)
+
+#: the only modules that may import ``socket`` directly: the cross-host
+#: transport pair — ``serve/net.py`` (lease-fenced connections, fault
+#: sites on every connect/send/recv) and ``serve/wire.py`` (CRC-checked
+#: stream framing).  A raw socket anywhere else bypasses the lease/
+#: fencing discipline and the ``serve.net.*`` chaos surface, so network
+#: use routes through them.
+SOCKET_ALLOWED = (
+    "keystone_tpu/serve/net.py",
+    "keystone_tpu/serve/wire.py",
 )
 
 #: solver modules whose BCD sweep / epoch loops ride the async fit-path
@@ -261,6 +278,11 @@ def _is_solver_sweep(rel_path: str) -> bool:
 def _proc_spawn_allowed(rel_path: str) -> bool:
     rel = rel_path.replace(os.sep, "/")
     return any(rel == p for p in PROC_SPAWN_ALLOWED)
+
+
+def _socket_allowed(rel_path: str) -> bool:
+    rel = rel_path.replace(os.sep, "/")
+    return any(rel == p for p in SOCKET_ALLOWED)
 
 
 # ------------------------------------------------------------ obs gating
@@ -395,14 +417,16 @@ def lint_source(
     solver_scoped: Optional[bool] = None,
     attr_vocab: Optional[frozenset] = None,
     proc_fenced: Optional[bool] = None,
+    socket_fenced: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one file's source.  ``metric_kinds`` accumulates
     name → (kind, path, line) across files for the metric-kind rule.
     ``supervised`` overrides the path-based wall-clock scoping,
-    ``solver_scoped`` the host-sync scoping, and ``proc_fenced`` the
-    proc-spawn scoping (tests).  ``attr_vocab``: the registered
-    span/event attribute vocabulary — None skips the ``attr`` rule
-    (``lint_paths`` loads it from obs/ledger.py)."""
+    ``solver_scoped`` the host-sync scoping, ``proc_fenced`` the
+    proc-spawn scoping, and ``socket_fenced`` the socket scoping
+    (tests).  ``attr_vocab``: the registered span/event attribute
+    vocabulary — None skips the ``attr`` rule (``lint_paths`` loads it
+    from obs/ledger.py)."""
     out: List[Violation] = []
     lines = source.splitlines()
     try:
@@ -415,6 +439,40 @@ def lint_source(
         solver_scoped = _is_solver_sweep(rel_path)
     if proc_fenced is None:
         proc_fenced = not _proc_spawn_allowed(rel_path)
+    if socket_fenced is None:
+        socket_fenced = not _socket_allowed(rel_path)
+
+    # ---- socket: a raw socket import outside the transport fence
+    if socket_fenced:
+        for node in ast.walk(tree):
+            bad_line = None
+            what = None
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "socket":
+                        bad_line, what = node.lineno, f"import {alias.name}"
+                        break
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "socket":
+                    bad_line = node.lineno
+                    what = f"from {node.module} import"
+            if bad_line is not None and not _allowed(
+                lines, bad_line, "socket"
+            ):
+                out.append(
+                    Violation(
+                        rel_path,
+                        bad_line,
+                        "socket",
+                        f"{what} outside the cross-host transport fence "
+                        "(serve/net.py, serve/wire.py) — a raw socket "
+                        "bypasses the lease/fencing discipline and the "
+                        "serve.net.* fault sites; route network use "
+                        "through the net fleet (or annotate "
+                        "'# lint: allow-socket' for a deliberate, "
+                        "fenced use)",
+                    )
+                )
 
     # ---- proc-spawn: multiprocessing/os.fork outside the worker fence
     if proc_fenced:
