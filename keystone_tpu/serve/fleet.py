@@ -628,13 +628,17 @@ class ReplicaPool:
         version: str,
         payload_path: Optional[str] = None,
         spawn_grace_s: Optional[float] = None,
+        allow_overflow: bool = False,
     ) -> Replica:
         """Claim (or spawn) one REMOTE worker and deploy the staged
         generation onto it.  A pending registration — a fenced worker
         rejoining after a healed partition, or one an operator started
         by hand — is adopted within ``spawn_grace_s`` before the host
         map is asked for fresh capacity, so a heal prefers the worker
-        that already holds this generation's built applier."""
+        that already holds this generation's built applier.
+        ``allow_overflow``: exempt a spawn from the host map's slot
+        budget — set on swap builds, whose workers coexist with the
+        old generation's only until commit."""
         from keystone_tpu.serve import net as netmod
         from keystone_tpu.serve import procfleet
 
@@ -653,7 +657,9 @@ class ReplicaPool:
         t0 = time.monotonic()
         pending = self._listener.next_pending(timeout=grace)
         if pending is None:
-            self._hostmap.spawn(self._listener.address)
+            self._hostmap.spawn(
+                self._listener.address, allow_overflow=allow_overflow
+            )
             pending = self._listener.next_pending(timeout=ready_timeout)
             if pending is None:
                 raise procfleet.WorkerSpawnError(
@@ -790,7 +796,8 @@ class ReplicaPool:
         return n
 
     def _build_process_many(
-        self, n: int, version: str, payload_path: Optional[str]
+        self, n: int, version: str, payload_path: Optional[str],
+        swap: bool = False,
     ) -> List[Replica]:
         """Spawn a whole generation's workers CONCURRENTLY: each pays a
         fresh interpreter + runtime import + prime, and paying them
@@ -799,11 +806,16 @@ class ReplicaPool:
         reaped before the error propagates — no half-born generation.
         The net backend rides the same fan-out with a zero adopt grace:
         an initial generation claims every already-registered volunteer
-        first, then spawns the shortfall from the host map."""
+        first, then spawns the shortfall from the host map.  ``swap``:
+        this generation REPLACES one that still occupies its host-map
+        slots until commit, so its spawns carry the map's transient
+        overflow allowance instead of failing on a budget sized to the
+        steady-state fleet."""
         if self.backend == "net":
             def build(i: int) -> Replica:
                 return self._build_net_one(
-                    i, version, payload_path, spawn_grace_s=0.0
+                    i, version, payload_path, spawn_grace_s=0.0,
+                    allow_overflow=swap,
                 )
         else:
             def build(i: int) -> Replica:
@@ -1107,7 +1119,7 @@ class ReplicaPool:
             # spawned concurrently: the old workers keep serving their
             # (already-loaded) payload throughout
             path = self._stage_payload(pipeline, artifacts)
-            staged = self._build_process_many(n, version, path)
+            staged = self._build_process_many(n, version, path, swap=True)
             self._staged_payload_path = path
         elif n == 1 and devices[0] is None:
             # staged single-replica generations still clone: the OLD

@@ -177,6 +177,9 @@ class WorkerListener:
     def _handshake(self, conn: socket.socket, addr) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # the socket timeout is the SEND budget only — reads wait
+            # via select and never touch it (wire.SEND_TIMEOUT_S)
+            conn.settimeout(wire.SEND_TIMEOUT_S)
             hello, _ = wire.recv_stream_frame(conn, timeout=self._hello_timeout)
             if hello.get("op") != "hello" or hello.get("protocol") != wire.SOCKET_VERSION:
                 raise wire.WireError(
@@ -319,6 +322,7 @@ class NetWorkerHandle:
         t0 = time.monotonic()
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(wire.SEND_TIMEOUT_S)
             self._raw_send({"op": "deploy", "spec": spec}, payload_bytes)
             ready, _ = wire.recv_stream_frame(
                 sock, timeout=ready_timeout, max_frame_bytes=self.max_frame_bytes
@@ -692,11 +696,21 @@ def _connect(
     for i in range(max(1, int(attempts))):
         sock = None
         try:
-            faults.fault_point(
+            act = faults.fault_point(
                 "serve.net.connect", role="worker", link=name, host=host
             )
+            if act is not None:
+                # a drop/partition verdict at the connect site IS a
+                # failed dial — silence, retried by the ladder like any
+                # refused connection (a plan never silently does nothing)
+                raise ConnectionRefusedError(
+                    f"fault plan injected {act!r} at serve.net.connect"
+                )
             sock = socket.create_connection((host, port), timeout=10.0)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # swap the dial timeout for the steady-state SEND budget;
+            # reads wait via select and never touch the socket timeout
+            sock.settimeout(wire.SEND_TIMEOUT_S)
             wire.send_stream_frame(
                 sock,
                 {
@@ -732,18 +746,22 @@ def _connect(
 
 def _drain_ready(
     sock, max_frame_bytes: int, wname: str
-) -> Tuple[List[dict], bool, bool]:
+) -> Tuple[List[Tuple[dict, bytes]], bool, bool]:
     """Drain frames already queued in the kernel buffer (beats that
-    landed during a long compute).  Returns ``(non-beat frames in
-    order, any frame arrived, channel dead)``.  This runs BEFORE the
+    landed during a long compute).  Returns ``((msg, payload) tuples in
+    order, any frame arrived, channel dead)``.  Payload bytes are kept
+    with their frame — a stashed apply is USUALLY a retransmit answered
+    from the last-reply cache, but nothing guarantees that, and
+    replaying it with an empty payload would turn a recomputable apply
+    into a confusing meta/byte-count error.  This runs BEFORE the
     self-fence check so a healthy worker whose compute outlasted one
     lease window is refreshed by the beats that were waiting for it —
     only true silence fences."""
-    stashed: List[dict] = []
+    stashed: List[Tuple[dict, bytes]] = []
     got_any = False
     while True:
         try:
-            msg, _ = wire.recv_stream_frame(
+            msg, payload = wire.recv_stream_frame(
                 sock, timeout=0.01, max_frame_bytes=max_frame_bytes
             )
         except TimeoutError:
@@ -757,7 +775,7 @@ def _drain_ready(
             continue  # never arrived; does not refresh the lease
         got_any = True
         if msg.get("op") != "beat":
-            stashed.append(msg)
+            stashed.append((msg, payload))
 
 
 def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
@@ -860,11 +878,11 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
 
     last_rx = time.monotonic()
     last_reply: Optional[Tuple[str, dict, bytes]] = None
-    stashed: Deque[dict] = deque()
+    stashed: Deque[Tuple[dict, bytes]] = deque()
     try:
         while True:
             if stashed:
-                msg, payload = stashed.popleft(), b""
+                msg, payload = stashed.popleft()
             else:
                 try:
                     msg, payload = wire.recv_stream_frame(

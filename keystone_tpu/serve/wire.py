@@ -51,6 +51,7 @@ the accelerator-runtime import.
 from __future__ import annotations
 
 import json
+import select
 import struct
 import threading
 import zlib
@@ -83,6 +84,15 @@ DEFAULT_MAX_FRAME_BYTES = 1 << 28  # 256 MiB
 #: this window — a peer that stalls mid-frame holds the channel torn,
 #: and a torn channel means replace-the-worker, not wait-forever
 MID_FRAME_TIMEOUT_S = 30.0
+
+#: the SEND direction's budget on a shared stream socket.  The socket
+#: object's timeout caps the TOTAL duration of ``sendall`` (Python
+#: 3.5+ semantics), so it must be generous enough for a full-size frame
+#: over a congested cross-host link — and it is set ONCE at connection
+#: setup, never by the read side: reader threads wait with ``select``
+#: (:func:`_wait_readable`) precisely so their short idle poll cannot
+#: shrink a concurrent ``sendall``'s budget out from under the sender.
+SEND_TIMEOUT_S = 60.0
 
 #: slab size classes are powers of two from this floor — small enough
 #: that a probe request wastes little, large enough that the common
@@ -395,6 +405,25 @@ def pack_stream_frame(msg: dict, payload: bytes = b"") -> bytes:
     )
 
 
+def _wait_readable(sock, timeout: Optional[float]) -> bool:
+    """``select``-based wait for readability; ``None`` blocks forever.
+
+    Readers MUST wait this way rather than via ``settimeout``: the
+    socket-object timeout is shared with the send direction (it caps the
+    total duration of ``sendall``), and reader threads share the socket
+    with sender threads — a reader that narrowed the timeout to its
+    0.25s idle poll would abort any concurrent ``sendall`` that cannot
+    flush within one poll interval, condemning a healthy channel the
+    moment a sizeable payload meets a full kernel send buffer.  A socket
+    closed out from under the wait surfaces as ``OSError``."""
+    try:
+        ready, _, _ = select.select([sock], [], [], timeout)
+    except ValueError:
+        # a concurrent close() already set fileno() to -1
+        raise OSError("socket closed while waiting for a frame") from None
+    return bool(ready)
+
+
 def _recv_exact(sock, n: int, idle_timeout: Optional[float]) -> bytes:
     """Read exactly ``n`` bytes from ``sock``.
 
@@ -404,36 +433,30 @@ def _recv_exact(sock, n: int, idle_timeout: Optional[float]) -> bytes:
     :data:`MID_FRAME_TIMEOUT_S` or the frame is declared torn
     (:class:`WireError`).  A peer that closes cleanly between frames
     raises ``EOFError``; a close MID-read is a truncated frame and
-    raises :class:`WireError`.
+    raises :class:`WireError`.  All waiting rides
+    :func:`_wait_readable`, so the socket's own timeout — the
+    concurrent-send budget — is never disturbed.
     """
     chunks: List[bytes] = []
     got = 0
-    sock.settimeout(idle_timeout)
     while got < n:
-        try:
-            chunk = sock.recv(n - got)
-        except (TimeoutError, OSError) as e:
-            # socket.timeout is TimeoutError; anything else is a real
-            # transport failure and propagates as the OSError it is
-            if not isinstance(e, TimeoutError):
-                raise
+        budget = idle_timeout if got == 0 else MID_FRAME_TIMEOUT_S
+        if not _wait_readable(sock, budget):
             if got == 0:
-                raise TimeoutError(
-                    f"no frame within {idle_timeout}s"
-                ) from None
+                raise TimeoutError(f"no frame within {idle_timeout}s")
             raise WireError(
                 f"stream frame stalled mid-read ({got}/{n} bytes)"
-            ) from None
+            )
+        try:
+            chunk = sock.recv(n - got)
+        except (BlockingIOError, InterruptedError):
+            continue  # spurious readability; re-arm the wait
         if not chunk:
             if got == 0:
                 raise EOFError("peer closed the connection")
             raise WireError(
                 f"truncated stream frame (peer closed at {got}/{n} bytes)"
             )
-        if got == 0:
-            # first byte landed: the frame is in flight — switch from
-            # the caller's idle poll to the torn-frame bound
-            sock.settimeout(MID_FRAME_TIMEOUT_S)
         chunks.append(chunk)
         got += len(chunk)
     return b"".join(chunks)

@@ -318,15 +318,31 @@ class HostMap:
         with self._lock:
             return sum(e.in_flight() for e in self.entries)
 
-    def _pick(self) -> HostEntry:
+    def _pick(self, allow_overflow: bool = False) -> HostEntry:
         """Least-loaded host with a free slot (ties break in map
-        order, so the first-listed host fills first at equal load)."""
+        order, so the first-listed host fills first at equal load).
+
+        ``allow_overflow``: when every budget is full, fall back to the
+        least-loaded host anyway.  This is the blue/green swap's
+        transient allowance — a staged generation COEXISTS with the old
+        one it replaces until commit, so a slot budget sized to the
+        steady-state fleet would otherwise fail every swap.  Steady
+        consumers (the autoscaler, heals) keep the hard budget."""
         best: Optional[HostEntry] = None
         for e in self.entries:
             if not e.has_room():
                 continue
             if best is None or e.in_flight() < best.in_flight():
                 best = e
+        if best is None and allow_overflow:
+            best = min(self.entries, key=lambda e: e.in_flight())
+            import logging
+
+            logging.getLogger(__name__).info(
+                "host slot budgets full; overflowing swap spawn onto %s "
+                "(transient: the replaced generation retires at commit)",
+                best.host,
+            )
         if best is None:
             raise HostCapacityError(
                 f"all {len(self.entries)} host(s) are at their slot "
@@ -345,15 +361,18 @@ class HostMap:
         connect_address: str,
         worker_name: Optional[str] = None,
         extra_args: Sequence[str] = (),
+        allow_overflow: bool = False,
     ):
         """Start one ``keystone worker`` pointed at the router's
         listener; returns the ``subprocess.Popen``.  The child inherits
         this environment (so ``KEYSTONE_FAULTS`` plans and platform
-        pins propagate exactly as they do to pipe-spawned workers)."""
+        pins propagate exactly as they do to pipe-spawned workers).
+        ``allow_overflow`` exempts this spawn from the slot budget —
+        the swap path's transient allowance (see :meth:`_pick`)."""
         import subprocess
 
         with self._lock:
-            entry = self._pick()
+            entry = self._pick(allow_overflow=allow_overflow)
             self._seq += 1
             name = worker_name or f"{entry.host}-w{self._seq}"
             args = ["--connect", str(connect_address), "--name", name]

@@ -186,6 +186,69 @@ def test_stream_frame_mid_frame_stall_is_torn(monkeypatch):
         _close_all(a, b)
 
 
+def test_reader_idle_poll_never_caps_concurrent_sendall():
+    """THE shared-socket timeout pin: a reader thread polling
+    ``recv_stream_frame(timeout=0.25)`` — exactly the router/worker
+    read loops — shares the socket with ``sendall`` callers, and the
+    socket-object timeout caps sendall's TOTAL duration.  A send too
+    large to flush before the peer starts reading must still complete:
+    the reader waits via select and never narrows the send budget."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        # tiny buffers: the frame cannot flush until the peer reads,
+        # so sendall provably outlives many reader poll intervals
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+        cli.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 16384)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli.connect(srv.getsockname())
+        peer, _ = srv.accept()
+    except OSError:
+        srv.close()
+        cli.close()
+        raise
+    srv.close()
+    cli.settimeout(wire.SEND_TIMEOUT_S)  # the net.py setup discipline
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            try:
+                wire.recv_stream_frame(cli, timeout=0.25)
+            except TimeoutError:
+                continue
+            except (EOFError, OSError, wire.WireError):
+                return
+
+    payload = b"x" * (4 << 20)
+    errs = []
+
+    def send():
+        try:
+            wire.send_stream_frame(cli, {"op": "apply", "fid": "big"}, payload)
+        except Exception as e:  # noqa: BLE001 — the pin IS "no exception"
+            errs.append(e)
+
+    reader = threading.Thread(target=poll, daemon=True)
+    sender = threading.Thread(target=send, daemon=True)
+    reader.start()
+    sender.start()
+    try:
+        # hold the peer silent across several poll intervals: the send
+        # is wedged on full buffers the whole time
+        time.sleep(0.8)
+        msg, got = wire.recv_stream_frame(peer, timeout=30.0)
+        sender.join(10.0)
+        assert not sender.is_alive()
+        assert errs == []
+        assert msg == {"op": "apply", "fid": "big"} and got == payload
+    finally:
+        stop.set()
+        _close_all(cli, peer)
+        reader.join(2.0)
+
+
 def test_payload_array_rejects_meta_length_mismatch():
     meta, payload = wire.array_payload(np.zeros(8, np.float32))
     with pytest.raises(wire.WireError):
@@ -244,6 +307,30 @@ def test_net_sites_registered():
     } <= faults.SITES
 
 
+def test_connect_drop_verdict_is_a_failed_dial():
+    """A drop/partition plan at ``serve.net.connect`` must not parse
+    and then silently do nothing: the verdict is a refused dial,
+    absorbed (and retried) by the backoff ladder like any dead router."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    try:
+        host, port = srv.getsockname()[:2]
+        with faults.inject("serve.net.connect:drop:times=1"):
+            sock = net._connect(
+                host, port, "dial-w", attempts=3, base_delay=0.01
+            )
+            sock.close()
+        # a persistent partition at the dial exhausts the ladder
+        with faults.inject("serve.net.connect:partition"):
+            with pytest.raises(net.ConnectRetriesExhausted):
+                net._connect(
+                    host, port, "dial-w", attempts=2, base_delay=0.01
+                )
+    finally:
+        srv.close()
+
+
 # ------------------------------------------------------------- host map
 def test_parse_hosts_grammar():
     entries = hostmap.parse_hosts("local:2, 10.0.0.5:4")
@@ -276,6 +363,23 @@ def test_hostmap_capacity_and_exhaustion():
         hm._pick()
     # any unbounded host makes total capacity unbounded
     assert hostmap.HostMap("local").capacity() is None
+
+
+def test_hostmap_swap_overflow_exempts_slot_budget():
+    """A staged swap generation coexists with the one it replaces
+    until commit, so with a budget sized to the steady-state fleet the
+    swap's spawns carry a transient overflow allowance — the hard
+    budget stays hard for everyone else (autoscaler, heals)."""
+    hm = hostmap.HostMap("local:1")
+
+    class _LiveProc:
+        def poll(self):
+            return None
+
+    hm.entries[0].spawned.append(_LiveProc())
+    with pytest.raises(hostmap.HostCapacityError):
+        hm._pick()
+    assert hm._pick(allow_overflow=True) is hm.entries[0]
 
 
 def test_hostmap_command_shapes():
@@ -716,6 +820,32 @@ def test_worker_session_self_fences_and_never_sends_the_result():
         assert "result" not in seen and "error" not in seen
     finally:
         _close_all(router, worker)
+
+
+def test_drain_ready_preserves_stashed_payload_bytes():
+    """Frames stashed by the mid-compute drain keep their payload:
+    replaying an apply with ``b""`` would turn a recomputable request
+    into a meta/byte-count ``WireError`` the moment the stashed fid
+    misses the last-reply cache."""
+    a, b = _spair()
+    try:
+        meta, p = wire.array_payload(_rows(2, seed=7))
+        wire.send_stream_frame(a, {"op": "beat"})
+        wire.send_stream_frame(
+            a, {"op": "apply", "fid": "fZ", "n": 2, "meta": meta}, p
+        )
+        time.sleep(0.1)  # let both frames land in b's kernel buffer
+        stashed, got_any, dead = net._drain_ready(
+            b, wire.DEFAULT_MAX_FRAME_BYTES, "drain-w"
+        )
+        assert got_any and not dead
+        assert len(stashed) == 1
+        msg, payload = stashed[0]
+        assert msg["fid"] == "fZ" and payload == p
+        arr = wire.payload_array(msg["meta"], payload)
+        assert arr.shape == (2, DIM)
+    finally:
+        _close_all(a, b)
 
 
 # --------------------------------------------------- live TCP fleet e2e
