@@ -254,6 +254,10 @@ PROC_DURATION_S = float(os.environ.get("BENCH_PROC_DURATION", "2.5"))
 PROC_BURN_ROUNDS = int(os.environ.get("BENCH_PROC_BURN", "2000"))
 AUTOSCALE_QPS = float(os.environ.get("BENCH_AUTOSCALE_QPS", "2000"))
 AUTOSCALE_DURATION_S = float(os.environ.get("BENCH_AUTOSCALE_DURATION", "4"))
+INGRESS_LEGS = int(os.environ.get("BENCH_INGRESS_LEGS", "1"))
+INGRESS_DURATION_S = float(os.environ.get("BENCH_INGRESS_DURATION", "1.5"))
+INGRESS_ROUNDS = int(os.environ.get("BENCH_INGRESS_ROUNDS", "2"))
+INGRESS_SHARDS = int(os.environ.get("BENCH_INGRESS_SHARDS", "2"))
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -963,6 +967,20 @@ def main():
         )
         return
 
+    if "--leg-serve-ingress" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                serve_bench.run_ingress_ab(
+                    duration=INGRESS_DURATION_S,
+                    rounds=INGRESS_ROUNDS,
+                    shards=INGRESS_SHARDS,
+                )
+            )
+        )
+        return
+
     if "--leg-serve-artifacts" in sys.argv:
         from tools import serve_bench
 
@@ -1206,6 +1224,17 @@ def main():
         else None
     )
 
+    # ingress leg (ISSUE 17): threaded HTTP/JSON vs binary-batch A/B on
+    # one service — the front-end ceiling, tracked per round
+    ingress_leg = (
+        subprocess_leg(
+            "--leg-serve-ingress",
+            required=("speedup", "predictions_identical"),
+        )
+        if INGRESS_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1385,6 +1414,11 @@ def main():
         # marks hosts that cannot express the claim), bit-identical
         # predictions, and a clean 1→N→1 autoscale scenario
         out["serve_procs"] = proc_leg
+    if ingress_leg:
+        # the ISSUE-17 acceptance: binary batch path >= 3x the threaded
+        # HTTP/JSON per-datum QPS ceiling, p99 for both arms,
+        # predictions bit-identical across JSON and binary
+        out["serve_ingress"] = ingress_leg
     if hedge_leg:
         # p99_ratio < 1 = hedging rescued the straggler's queue;
         # qps_cost <= 0.05 = the acceptance budget

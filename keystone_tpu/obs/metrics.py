@@ -78,6 +78,28 @@ LATENCY_MS_BUCKETS = (
     10.0,
 )
 
+#: sub-millisecond bounds for the ingress hot path: frame parse and
+#: batch admission each cost tens of microseconds when the zero-copy
+#: path holds, so even :data:`LATENCY_MS_BUCKETS` (floor 0.5 ms) would
+#: flatten every sample into its first bucket.  ``serve/ingress.py``
+#: registers these for ``ingress.parse_seconds`` /
+#: ``ingress.admit_seconds``.
+INGRESS_TIME_BUCKETS = (
+    0.00001,
+    0.000025,
+    0.00005,
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.05,
+    0.25,
+    1.0,
+)
+
 
 def enabled() -> bool:
     """Recording on?  ``KEYSTONE_METRICS=0`` disables every write path

@@ -36,6 +36,12 @@ from keystone_tpu.serve.net import (  # noqa: F401
     run_worker,
 )
 from keystone_tpu.serve.http import HttpFrontend, serve_http  # noqa: F401
+from keystone_tpu.serve.ingress import (  # noqa: F401
+    AsyncIngress,
+    BinaryClient,
+    IngressError,
+    serve_ingress,
+)
 from keystone_tpu.serve.registry import (  # noqa: F401
     ModelRegistry,
     RegistryError,
@@ -57,11 +63,14 @@ from keystone_tpu.serve.tenants import (  # noqa: F401
 )
 
 __all__ = [
+    "AsyncIngress",
     "AutoscalePolicy",
     "Autoscaler",
+    "BinaryClient",
     "ConnectRetriesExhausted",
     "FleetUnavailable",
     "HttpFrontend",
+    "IngressError",
     "NetReplica",
     "NetWorkerHandle",
     "ProcessReplica",
@@ -88,5 +97,6 @@ __all__ = [
     "run_worker",
     "serve",
     "serve_http",
+    "serve_ingress",
     "serve_multi",
 ]

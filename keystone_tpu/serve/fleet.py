@@ -1018,6 +1018,19 @@ class ReplicaPool:
             r.routable() and r.breaker.state() != guard.OPEN for r in replicas
         )
 
+    @property
+    def max_slab_bytes(self) -> int:
+        """The dispatch slab cap this fleet's workers were built with —
+        the ingress sizes ITS admission pool to the same bound so a
+        payload it accepts is never refused downstream.  Thread/device
+        fleets (no slab wire at all) report the wire default."""
+        cap = self._worker_opts.get("max_slab_bytes")
+        if cap is not None:
+            return int(cap)
+        from keystone_tpu.serve import wire
+
+        return int(wire.DEFAULT_MAX_SLAB_BYTES)
+
     def available(self) -> bool:
         """Can the fleet accept traffic right now?  One attribute read
         on the happy path (the per-submit admission check); the full

@@ -69,10 +69,16 @@ flush-completion estimate (``PipelineService.retry_after_hint``) —
 integer-ceiled for the header (delta-seconds), exact in the JSON body —
 instead of a hard-coded constant.
 
-``ThreadingHTTPServer`` (one thread per in-flight request) is the right
-shape here: handler threads block on their futures while the single
-batcher thread does the device work, which is exactly the micro-batching
-contract.  Bind ``port=0`` to get an ephemeral port (tests).
+``ThreadingHTTPServer`` (one thread per connection; HTTP/1.1
+keep-alive, so a client's request stream reuses its thread AND its TCP
+handshake) is the COMPATIBLE shape here: handler threads block on their
+futures while the single batcher thread does the device work, which is
+exactly the micro-batching contract.  It is also now the *slow path*:
+``serve/ingress.py`` runs a selector-driven front end that speaks a
+binary batch protocol and delegates sniffed HTTP connections to THIS
+handler (:func:`handle_http_connection`), so the JSON surface stays
+identical whichever front end accepted the socket.  Bind ``port=0`` to
+get an ephemeral port (tests).
 
 Usage::
 
@@ -117,6 +123,19 @@ _RESULT_TIMEOUT_S = 120.0
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 with Content-Length on every response => persistent
+    # connections by default.  Under HTTP/1.0 every request paid a TCP
+    # handshake (and slow-start) — at per-datum submit rates the
+    # handshakes, not the service, were the measured latency.  One
+    # handler THREAD now serves a whole connection's request stream,
+    # which is still the threaded slow path next to serve/ingress.py.
+    protocol_version = "HTTP/1.1"
+
+    #: idle keep-alive bound: a silent persistent connection releases
+    #: its thread after this (socketserver applies it via settimeout;
+    #: handle_one_request maps the timeout to close_connection)
+    timeout = 65.0
+
     # route access logs to logging (debug), not stderr
     def log_message(self, fmt, *args):
         logger.debug("http: " + fmt, *args)
@@ -131,13 +150,21 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(payload, bytes)
             else json.dumps(payload).encode("utf-8")
         )
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in headers:
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+        # a client that hung up mid-exchange (impatient curl, a load
+        # balancer health probe, a bencher's ^C) must not crash the
+        # handler thread with an uncaught BrokenPipeError — the
+        # response has no one to go to; drop it and close our side
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError, TimeoutError) as e:
+            self.close_connection = True
+            logger.debug("http: client disconnected mid-response: %s", e)
 
     def do_GET(self):
         parts = urlsplit(self.path)
@@ -288,6 +315,10 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 raise ValueError('body needs "instances" or "instance"')
             arr = np.asarray(instances, dtype=np.float32)
+            # the JSON slow path materializes every payload byte at
+            # least once (text → floats → array); the binary ingress
+            # charges zero here — the counter IS the zero-copy claim
+            metrics.inc("ingress.bytes_copied", int(arr.nbytes))
             deadline_ms = body.get("deadline_ms")
             deadline = None if deadline_ms is None else float(deadline_ms) / 1000.0
             # multi-tenant routing: the body names its tenant; a
@@ -525,6 +556,36 @@ class HttpFrontend:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class _DelegateServer:
+    """The duck-typed ``server`` object :class:`_Handler` needs when a
+    connection arrives from OUTSIDE a ``ThreadingHTTPServer`` — the
+    async ingress (``serve/ingress.py``) sniffs a non-binary client
+    and hands the accepted socket here, so every HTTP endpoint keeps
+    one implementation while the event loop keeps the fast path."""
+
+    def __init__(self, service: PipelineService, registry=None):
+        self.service = service
+        self.registry = registry
+
+
+def handle_http_connection(
+    sock, client_address, service: PipelineService, registry=None
+) -> None:
+    """Serve one already-accepted connection with the stdlib handler
+    (blocking; run it on its own thread).  The HTTP/1.1 keep-alive loop
+    inside ``BaseHTTPRequestHandler.handle`` serves the connection's
+    whole request stream; the socket is closed on return."""
+    try:
+        _Handler(sock, client_address, _DelegateServer(service, registry))
+    except (BrokenPipeError, ConnectionResetError, TimeoutError, OSError) as e:
+        logger.debug("http: delegated connection died: %s", e)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 def serve_http(

@@ -57,6 +57,7 @@ from typing import Optional
 
 import numpy as np
 
+from keystone_tpu.obs import metrics
 from keystone_tpu.serve import wire
 from keystone_tpu.serve.worker import worker_main
 
@@ -126,6 +127,12 @@ class WorkerHandle:
     """Owns one worker process: the control pipe, the request slab
     pool (parent-owned), the response-slab attacher, the shared
     heartbeat, and the strict one-in-flight request lock."""
+
+    #: same-host shared memory: a caller holding a payload that ALREADY
+    #: lives in a slab (serve/ingress.py admission blocks) may ship the
+    #: reference instead of the bytes — the worker attaches the segment
+    #: by name.  Cross-host handles (net.NetWorkerHandle) lack this.
+    accepts_slab_ref = True
 
     def __init__(
         self,
@@ -226,36 +233,50 @@ class WorkerHandle:
         arr: np.ndarray,
         n: int,
         deadline_s: Optional[float] = None,
+        slab_ref: Optional[dict] = None,
     ) -> np.ndarray:
         """One remote apply: copy into a slab, frame, wait, read the
         result slab.  Raises the relayed typed error, or
         :class:`WorkerCrashed` when the child died mid-request.
         (Prime/live distinction stays router-side: ``Replica.apply``
         consumes ``prime`` to skip the fault site; the worker's apply
-        is identical either way.)"""
-        reply, out = self._request(
-            {
-                "op": "apply",
-                "n": int(n),
-                "deadline_s": deadline_s,
-            },
-            arr=arr,
-        )
+        is identical either way.)
+
+        ``slab_ref``: the batch already lives in a shared-memory slab
+        the CALLER owns (an ingress admission block) — ship the
+        reference and skip the dispatch memcpy entirely.  The caller
+        must keep the slab alive until this returns (it does: the
+        request is strictly one-in-flight and blocks for the reply)."""
+        msg = {"op": "apply", "n": int(n), "deadline_s": deadline_s}
+        if slab_ref is not None:
+            reply, out = self._request(msg, ref=slab_ref)
+        else:
+            reply, out = self._request(msg, arr=arr)
         return out
 
     def ping(self) -> dict:
         reply, _ = self._request({"op": "ping"})
         return reply
 
-    def _request(self, msg: dict, arr: Optional[np.ndarray] = None):
+    def _request(
+        self,
+        msg: dict,
+        arr: Optional[np.ndarray] = None,
+        ref: Optional[dict] = None,
+    ):
         with self._lock:
             if self._closed:
                 raise WorkerCrashed(f"{self.name}: handle is closed")
             slab = None
             try:
-                if arr is not None:
-                    slab, ref = wire.write_array(self._pool, arr)
+                if ref is not None:
+                    # pre-slabbed payload: the reference rides the
+                    # control frame, zero dispatch bytes copied
                     msg = dict(msg, ref=ref)
+                elif arr is not None:
+                    slab, ref_ = wire.write_array(self._pool, arr)
+                    metrics.inc("dispatch.bytes_copied", int(arr.nbytes))
+                    msg = dict(msg, ref=ref_)
                 try:
                     wire.send_frame(self._conn, msg)
                     reply = wire.recv_frame(self._conn)
@@ -390,7 +411,14 @@ class RemoteApplier:
     def __init__(self, handle: WorkerHandle):
         self.handle = handle
 
-    def __call__(self, x, deadline=None, n=None, **kw):
+    @property
+    def accepts_slab_ref(self) -> bool:
+        """Capability marker the service's dispatch gate reads: True
+        exactly when the HANDLE can attach a caller-owned slab by name
+        (same-host process workers; cross-host net handles cannot)."""
+        return bool(getattr(self.handle, "accepts_slab_ref", False))
+
+    def __call__(self, x, deadline=None, n=None, slab_ref=None, **kw):
         if kw:
             # multi-tenant segment kwargs need in-process walks; the
             # service refuses workers>0 for multi-tenant deploys
@@ -408,7 +436,10 @@ class RemoteApplier:
         deadline_s = None
         if deadline is not None:
             deadline_s = max(0.0, deadline.remaining())
-        out = self.handle.apply(arr, int(n), deadline_s)
+        if slab_ref is not None and self.accepts_slab_ref:
+            out = self.handle.apply(arr, int(n), deadline_s, slab_ref=slab_ref)
+        else:
+            out = self.handle.apply(arr, int(n), deadline_s)
         return _HostOut(out)
 
     # ------------------------------------------------- status/prime hooks

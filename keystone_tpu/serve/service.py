@@ -256,7 +256,16 @@ def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "future", "t_submit", "request_id", "tenant")
+    __slots__ = (
+        "x",
+        "deadline",
+        "future",
+        "t_submit",
+        "request_id",
+        "tenant",
+        "block",
+        "row",
+    )
 
     def __init__(
         self,
@@ -275,6 +284,31 @@ class _Request:
         #: multi-tenant routing label (serve/tenants.py); None on the
         #: single-tenant service — every tenant hook is inert then
         self.tenant = tenant
+        #: slab-direct admission (serve/ingress.py): when set, ``x`` is
+        #: row ``row`` of admission block ``block`` (a zero-copy view of
+        #: a shared-memory slab).  A flush formed of exactly one block's
+        #: rows in order skips the stack+pad copies (_apply_reqs) and —
+        #: on a process fleet — ships the slab by REFERENCE to the
+        #: worker.  None on every other submit path.
+        self.block = None
+        self.row = 0
+
+
+def _block_of(reqs) -> Optional[object]:
+    """The admission block a flush is a complete in-order image of, or
+    None.  The preformed-flush fast path requires EXACTLY the block's
+    rows 0..count-1 in order: a shed/cancelled rider, a flush mixing two
+    submits, or a block spanning flushes all fall back to the stack+pad
+    copy path (which remains correct for views)."""
+    blk = getattr(reqs[0], "block", None)
+    if blk is None or not getattr(blk, "admission_block", False):
+        return None
+    if len(reqs) != blk.count:
+        return None
+    for i, r in enumerate(reqs):
+        if r.block is not blk or r.row != i:
+            return None
+    return blk
 
 
 class _Flush:
@@ -943,7 +977,49 @@ class PipelineService:
             return [new_request_id() for _ in range(n)]
         return [None] * n
 
-    def _submit_all(self, xs, deadline, request_ids=None, tenant=None) -> list:
+    def submit_batch(
+        self,
+        block,
+        deadline=None,
+        request_ids=None,
+        tenant: Optional[str] = None,
+    ) -> list:
+        """Admit a whole admission block (``serve/wire.py``
+        ``SlabBlock`` — or any duck-typed ``admission_block`` carrier
+        exposing ``count`` / ``rows()``) under ONE queue-lock round;
+        returns one Future per row, in order.  Each request's payload is
+        a zero-copy VIEW of the block, so when the block forms a flush
+        by itself the router skips the stack+pad copies and a process
+        worker attaches the same shared-memory slab by name.
+
+        The caller keeps ownership of the block's lifetime: hold it
+        (e.g. ``block.retain(n)`` + ``release_one`` done-callbacks)
+        until every returned future resolves — the router may read the
+        slab up to that point (hedges, crash requeues, bisection).
+        Raises exactly what :meth:`submit_many` raises; on ANY raise no
+        row was admitted (atomic, same as every submit path)."""
+        if not getattr(block, "admission_block", False):
+            raise TypeError(
+                f"submit_batch wants an admission block (wire.SlabBlock); "
+                f"got {type(block).__name__} — use submit_many for plain "
+                "sequences"
+            )
+        return self._submit_all(
+            list(block.rows()),
+            deadline,
+            request_ids,
+            tenant=tenant,
+            block=block,
+        )
+
+    def bucket_for(self, k: int) -> int:
+        """The padding bucket a ``k``-row flush pads to (public so the
+        ingress can pre-pad admission blocks to the exact flush shape)."""
+        return self._bucket_for(int(k))
+
+    def _submit_all(
+        self, xs, deadline, request_ids=None, tenant=None, block=None
+    ) -> list:
         if not xs:
             return []
         rids = self._resolve_request_ids(len(xs), request_ids)
@@ -1032,15 +1108,17 @@ class PipelineService:
                 # no queue slot, which is exactly the capacity win
                 self._check_bound_locked(len(arrs) - len(followers), tenant)
                 self._item_shape, self._dtype = item_shape, dtype
-                reqs = [
-                    _Request(
-                        a if a.dtype == dtype else a.astype(dtype),
-                        dl,
-                        rid,
-                        tenant=tenant,
-                    )
-                    for a, rid in zip(arrs, rids)
-                ]
+                reqs = []
+                for i, (a, rid) in enumerate(zip(arrs, rids)):
+                    xa = a if a.dtype == dtype else a.astype(dtype)
+                    r = _Request(xa, dl, rid, tenant=tenant)
+                    # slab-direct admission: tag the request with its
+                    # block row ONLY when no conversion copied the view
+                    # (a dtype-mismatched block silently rides the copy
+                    # path — correct, just not zero-copy)
+                    if block is not None and xa is a:
+                        r.block, r.row = block, i
+                    reqs.append(r)
                 if dd_keys is not None:
                     self._dedup_register(tenant, dd_keys, reqs, followers)
                 # push, then annotate — both UNDER the queue lock: the
@@ -2094,10 +2172,27 @@ class PipelineService:
         rows here).  The multi-tenant service overrides this with the
         segment-aware shared-pool apply — both the flush happy path and
         bisection's re-runs route through it, so poison isolation works
-        identically per tenant."""
-        return self._apply_rows(
-            np.stack([req.x for req in reqs]), deadline=deadline, replica=replica
-        )
+        identically per tenant.
+
+        Preformed-flush fast path: when the flush is a complete
+        in-order image of ONE admission block (slab-direct ingress),
+        the block's slab IS the padded batch — already bucket-shaped,
+        pad rows zeroed at allocation — so the ``np.stack`` copy and
+        the ``iter_row_chunks`` re-pad are both skipped, and a process
+        worker can attach the slab by reference."""
+        blk = _block_of(reqs)
+        if blk is not None and blk.padded_rows == self._bucket_for(len(reqs)):
+            metrics.inc("serve.preformed_flushes")
+            return self._apply_rows(
+                blk.array,
+                deadline=deadline,
+                replica=replica,
+                pre_padded_n=len(reqs),
+                slab_ref=blk.ref,
+            )
+        stacked = np.stack([req.x for req in reqs])
+        metrics.inc("serve.bytes_copied", stacked.nbytes)
+        return self._apply_rows(stacked, deadline=deadline, replica=replica)
 
     def _bucket_for(self, k: int) -> int:
         for b in self.buckets:
@@ -2112,6 +2207,8 @@ class PipelineService:
         replica=None,
         prime: bool = False,
         source_box: Optional[list] = None,
+        pre_padded_n: Optional[int] = None,
+        slab_ref: Optional[dict] = None,
         **apply_kw,
     ) -> np.ndarray:
         """Pad ``(k, ...)`` rows up to the smallest bucket >= k (the
@@ -2131,9 +2228,19 @@ class PipelineService:
         from keystone_tpu.workflow.dataset import Dataset
         from keystone_tpu.workflow.transformer import iter_row_chunks
 
-        k = stacked.shape[0]
-        bucket = self._bucket_for(k)
-        padded, _mask, _start = next(iter(iter_row_chunks(stacked, None, bucket)))
+        if pre_padded_n is not None:
+            # slab-direct flush (serve/ingress.py): ``stacked`` is the
+            # admission block's array, ALREADY padded to the bucket with
+            # zeroed pad rows — re-padding would be the exact copy the
+            # zero-copy path exists to skip
+            k = int(pre_padded_n)
+            padded = stacked
+        else:
+            k = stacked.shape[0]
+            bucket = self._bucket_for(k)
+            padded, _mask, _start = next(
+                iter(iter_row_chunks(stacked, None, bucket))
+            )
         rep = replica if replica is not None else self._pool.replicas[0]
         if getattr(rep.applier, "remote_worker", False):
             # process fleet: the padded HOST batch goes straight to the
@@ -2143,6 +2250,14 @@ class PipelineService:
             # applier; prime is consumed BY Replica.apply (it skips the
             # serve.replica fault site for warm-ups — the worker's
             # apply is identical either way).
+            if slab_ref is not None and getattr(
+                rep.applier, "accepts_slab_ref", False
+            ):
+                # the ingress already landed the batch in a shared-
+                # memory slab: ship the REFERENCE, the worker attaches
+                # the same segment by name — the dispatch memcpy is
+                # skipped too
+                apply_kw = dict(apply_kw, slab_ref=slab_ref)
             out = rep.apply(
                 padded, deadline=deadline, prime=prime, n=k, **apply_kw
             )

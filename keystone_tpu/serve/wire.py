@@ -260,6 +260,15 @@ class SlabPool:
             slab.shm.unlink()
         except (FileNotFoundError, OSError):
             pass
+        except BufferError:
+            # a NumPy view over the segment is still alive (a late
+            # rider reference, a recorder-held row): unlink the NAME so
+            # the segment dies with the last mapping instead of leaking
+            # past process exit, and leave the mapping to the GC
+            try:
+                slab.shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
 
     def stats(self) -> dict:
         with self._lock:
@@ -306,17 +315,22 @@ class SlabAttacher:
     def view(self, ref: dict) -> np.ndarray:
         """A zero-copy NumPy view over the referenced payload.  The
         view is valid only until the protocol allows the writer to
-        reuse the slab — copy before crossing that boundary."""
+        reuse the slab — copy before crossing that boundary.  An
+        optional ``offset`` field (bytes, default 0) lets one slab
+        carry a payload past its head — the admission-block hand-off."""
         seg = self._segment(ref["slab"])
         dtype = np.dtype(ref["dtype"])
         shape = tuple(ref["shape"])
         nbytes = int(ref["nbytes"])
-        if nbytes > seg.size:
+        offset = int(ref.get("offset", 0))
+        if offset < 0 or offset + nbytes > seg.size:
             raise WireError(
-                f"slab reference claims {nbytes} bytes but segment "
-                f"{ref['slab']!r} holds {seg.size}"
+                f"slab reference claims bytes [{offset}, {offset + nbytes}) "
+                f"but segment {ref['slab']!r} holds {seg.size}"
             )
-        return np.ndarray(shape, dtype=dtype, buffer=seg.buf[:nbytes])
+        return np.ndarray(
+            shape, dtype=dtype, buffer=seg.buf[offset : offset + nbytes]
+        )
 
     def read(self, ref: dict) -> np.ndarray:
         """An owning copy of the referenced payload (safe past slab
@@ -366,6 +380,107 @@ def write_array(pool: SlabPool, arr: np.ndarray) -> Tuple[Slab, dict]:
         "nbytes": int(arr.nbytes),
     }
     return slab, ref
+
+
+class SlabBlock:
+    """An admission-owned padded batch living in ONE pool slab — the
+    zero-copy hand-off between the ingress front end and the dispatch
+    path.
+
+    Rows ``[0, count)`` are request rows (the ingress reads client
+    payload bytes straight off the socket into them); rows
+    ``[count, padded_rows)`` are zero pad, pre-sized to the service's
+    padding bucket so a flush of the whole block needs NO re-pad copy.
+    :attr:`ref` is the slab reference a process worker can attach by
+    name — the router ships it on the control frame instead of
+    memcpy'ing the batch into a dispatch slab.
+
+    Lifetime is refcounted in request rows: the admitting caller
+    :meth:`retain`\\ s once per submitted future and each future's done
+    callback :meth:`release_one`\\ s; the slab rejoins its pool only
+    after the LAST future resolves, which by the strict
+    request/response dispatch protocol is after any worker has read
+    the payload (and after bisection's re-runs, which slice the same
+    rows).  ``admission_block`` is the duck-typed marker
+    ``PipelineService.submit_batch`` keys on — no wire import needed
+    at the admission layer."""
+
+    admission_block = True
+
+    __slots__ = ("pool", "slab", "array", "count", "_refs", "_lock")
+
+    def __init__(self, pool: SlabPool, slab: Slab, array: np.ndarray, count: int):
+        self.pool = pool
+        self.slab = slab
+        self.array = array
+        self.count = int(count)
+        self._refs = 0
+        self._lock = threading.Lock()
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.array.shape[0])
+
+    @property
+    def ref(self) -> dict:
+        """The dispatch slab reference for the WHOLE padded block."""
+        return {
+            "slab": self.slab.name,
+            "shape": list(self.array.shape),
+            "dtype": self.array.dtype.str,
+            "nbytes": int(self.array.nbytes),
+            "offset": 0,
+        }
+
+    def rows(self) -> list:
+        """Per-request row views (zero-copy slices of the block)."""
+        return [self.array[i] for i in range(self.count)]
+
+    def retain(self, n: int = 1) -> None:
+        with self._lock:
+            self._refs += int(n)
+
+    def release_one(self, _fut=None) -> None:
+        """Drop one reference (signature-compatible with
+        ``Future.add_done_callback``); the last one frees the slab."""
+        with self._lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+        self.close()
+
+    def close(self) -> None:
+        """Return the slab to the pool (idempotent).  The ndarray view
+        is dropped first so a later ``pool.close()`` can unmap the
+        segment."""
+        with self._lock:
+            slab, self.slab, self.array = self.slab, None, None
+        if slab is not None:
+            self.pool.release(slab)
+
+
+def alloc_block(
+    pool: SlabPool,
+    count: int,
+    item_shape: Tuple[int, ...],
+    dtype,
+    padded_rows: Optional[int] = None,
+) -> SlabBlock:
+    """Acquire a slab sized for ``padded_rows`` (default ``count``)
+    items of ``item_shape``/``dtype`` and return the
+    :class:`SlabBlock` over it, pad rows zeroed.  The caller fills
+    rows ``[0, count)`` — typically by ``recv_into`` straight off a
+    socket.  Raises :class:`PayloadTooLarge` past the pool cap."""
+    count = int(count)
+    padded = count if padded_rows is None else max(int(padded_rows), count)
+    dtype = np.dtype(dtype)
+    shape = (padded,) + tuple(int(d) for d in item_shape)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    slab = pool.acquire(nbytes)
+    arr = np.ndarray(shape, dtype=dtype, buffer=slab.buf[:nbytes])
+    if padded > count:
+        arr[count:] = 0
+    return SlabBlock(pool, slab, arr, count)
 
 
 def send_frame(conn, msg: dict) -> None:

@@ -366,8 +366,12 @@ def test_socket_rule_fires_on_import_forms(sites):
 
 def test_socket_rule_scoped_to_the_transport_fence(sites):
     src = "import socket\n"
-    # the cross-host transport pair may import socket directly
-    for fenced in ("keystone_tpu/serve/net.py", "keystone_tpu/serve/wire.py"):
+    # the transport trio may import socket directly
+    for fenced in (
+        "keystone_tpu/serve/net.py",
+        "keystone_tpu/serve/wire.py",
+        "keystone_tpu/serve/ingress.py",
+    ):
         vs = lint.lint_source(fenced, src, sites, {}, attr_vocab=None)
         assert not [v for v in vs if v.rule == "socket"], fenced
     # explicit override hook for tests

@@ -56,7 +56,8 @@ Rules:
   (an explicit spawn/forkserver context) takes a trailing
   ``# lint: allow-proc-spawn``;
 - ``socket``       — no direct ``socket`` import outside the
-  cross-host transport modules (``serve/net.py``, ``serve/wire.py``):
+  cross-host transport modules (``serve/net.py``, ``serve/wire.py``,
+  ``serve/ingress.py``):
   a raw socket anywhere else bypasses the heartbeat-lease/fencing
   discipline and the ``serve.net.*`` fault sites that make network
   failure injectable.  A deliberate use takes a trailing
@@ -159,15 +160,18 @@ PROC_SPAWN_ALLOWED = (
     "keystone_tpu/serve/procfleet.py",
 )
 
-#: the only modules that may import ``socket`` directly: the cross-host
-#: transport pair — ``serve/net.py`` (lease-fenced connections, fault
-#: sites on every connect/send/recv) and ``serve/wire.py`` (CRC-checked
-#: stream framing).  A raw socket anywhere else bypasses the lease/
-#: fencing discipline and the ``serve.net.*`` chaos surface, so network
-#: use routes through them.
+#: the only modules that may import ``socket`` directly: the transport
+#: trio — ``serve/net.py`` (lease-fenced cross-host connections, fault
+#: sites on every connect/send/recv), ``serve/wire.py`` (CRC-checked
+#: stream framing), and ``serve/ingress.py`` (the selector-driven front
+#: end: non-blocking accept/sniff/recv_into is the whole point of the
+#: module, and its frames ride the wire-v2 CRC discipline).  A raw
+#: socket anywhere else bypasses the lease/fencing discipline and the
+#: ``serve.net.*`` chaos surface, so network use routes through them.
 SOCKET_ALLOWED = (
     "keystone_tpu/serve/net.py",
     "keystone_tpu/serve/wire.py",
+    "keystone_tpu/serve/ingress.py",
 )
 
 #: solver modules whose BCD sweep / epoch loops ride the async fit-path
@@ -465,7 +469,8 @@ def lint_source(
                         bad_line,
                         "socket",
                         f"{what} outside the cross-host transport fence "
-                        "(serve/net.py, serve/wire.py) — a raw socket "
+                        "(serve/net.py, serve/wire.py, serve/ingress.py) "
+                        "— a raw socket "
                         "bypasses the lease/fencing discipline and the "
                         "serve.net.* fault sites; route network use "
                         "through the net fleet (or annotate "
