@@ -426,6 +426,20 @@ class AsyncIngress:
                         if conn is not None:
                             logger.debug("ingress: conn died: %s", e)
                             self._drop(sel, conn, conns)
+                    except Exception:
+                        # one bad connection must never take the shard
+                        # loop (and with it the listener plus every
+                        # other conn) down: drop the offender, count
+                        # it, keep serving
+                        metrics.inc(
+                            "ingress.frame_errors", kind="internal"
+                        )
+                        logger.exception(
+                            "ingress: internal error on conn %s",
+                            getattr(conn, "addr", None),
+                        )
+                        if conn is not None:
+                            self._drop(sel, conn, conns)
                 # response frames assembled by future callbacks
                 self._flush_done(sel, shard, conns)
                 # condemn mid-frame stalls: a peer that started a frame
@@ -531,7 +545,18 @@ class AsyncIngress:
 
     def _sniff(self, sel, conn: _Conn, conns) -> None:
         """Peek the first bytes without consuming: binary magic stays
-        on the loop, anything else becomes a delegated HTTP thread."""
+        on the loop, anything else becomes a delegated HTTP thread.
+
+        A strict PREFIX of the magic is consumed into the frame buffer
+        and the conn committed to the binary parser right away: peeked-
+        but-unread bytes would make the level-triggered selector report
+        the socket readable every iteration (a peer sending ``b"KS"``
+        and stalling would spin this loop at full CPU), and no HTTP
+        method shares a first byte with the magic, so committing early
+        loses nothing — a stream that diverges after the prefix fails
+        the magic check with a typed error, and a staller is now
+        mid-frame (``PREFIX`` with buffered bytes) so the stall sweep
+        condemns it."""
         try:
             peek = conn.sock.recv(len(BATCH_MAGIC), socket.MSG_PEEK)
         except (BlockingIOError, InterruptedError):
@@ -542,10 +567,21 @@ class AsyncIngress:
         if not peek:
             self._drop(sel, conn, conns)
             return
-        if BATCH_MAGIC.startswith(peek) and len(peek) < len(BATCH_MAGIC):
-            return  # a prefix of the magic: wait for more bytes
-        if peek == BATCH_MAGIC:
+        if BATCH_MAGIC.startswith(peek):
             metrics.inc("ingress.bin_conns")
+            try:
+                got = conn.sock.recv(len(peek))
+            except (BlockingIOError, InterruptedError):
+                return
+            except (ConnectionResetError, OSError):
+                self._drop(sel, conn, conns)
+                return
+            if not got:
+                self._drop(sel, conn, conns)
+                return
+            conn.buf.extend(got)
+            conn.t_progress = time.monotonic()
+            conn.t_frame_start = conn.t_progress
             conn.state = _Conn.PREFIX
             conn.want = _PREFIX_LEN
             self._readable(sel, conn, conns)
@@ -652,13 +688,35 @@ class AsyncIngress:
             item_shape = tuple(int(d) for d in msg.get("shape") or ())
             if count < 1:
                 raise ValueError(f"count must be >= 1, got {count}")
+            # wire dtypes are numeric scalars only: an object dtype
+            # over the slab would turn raw socket bytes into PyObject
+            # pointers the moment anything dereferences the array
+            if dtype.hasobject or dtype.kind not in "biufc":
+                raise ValueError(
+                    f"dtype {dtype.str!r} not admissible on the wire "
+                    "(numeric kinds biufc only)"
+                )
+            # overflow-safe Python-int math: a crafted dim must fail
+            # typed here, not wrap through a fixed-width product into
+            # passing the payload-length consistency check below
+            row_elems = 1
+            for d in item_shape:
+                if d < 1:
+                    raise ValueError(
+                        f"item shape {item_shape} has a dim < 1"
+                    )
+                row_elems *= d
+                if row_elems * dtype.itemsize > self.max_frame_bytes:
+                    raise ValueError(
+                        f"item shape {item_shape} exceeds the "
+                        f"{self.max_frame_bytes}-byte frame cap"
+                    )
         except (KeyError, TypeError, ValueError) as e:
             self._frame_error(
                 sel, conn, conns, "bad_body", f"bad predict header: {e}"
             )
             return False
-        row_bytes = int(np.prod(item_shape, dtype=np.int64)) * dtype.itemsize
-        expect = count * row_bytes
+        expect = count * row_elems * dtype.itemsize
         if expect != conn.payload_len:
             self._frame_error(
                 sel,

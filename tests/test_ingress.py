@@ -284,6 +284,123 @@ def test_server_rejects_header_payload_length_mismatch(served):
         s.close()
 
 
+def test_server_refuses_non_numeric_dtypes_with_typed_error(served):
+    """The wire dtype is attacker-controlled and must be allowlisted:
+    dtype "O" over the shared-memory slab would reinterpret raw socket
+    bytes as PyObject pointers (a remote segfault on first deref);
+    strings/datetimes/void are refused with the same typed verdict."""
+    _, srv = served
+    for spec in ["O", "U4", "M8[ns]", "S8", "V16"]:
+        itemsize = np.dtype(spec).itemsize
+        s = _dial(srv)
+        try:
+            msg = {"op": "predict", "count": 1, "dtype": spec, "shape": [2]}
+            s.sendall(ing.pack_batch_frame(msg, b"\x00" * (2 * itemsize)))
+            reply, _ = _recv(s)
+            assert reply["kind"] == "bad_body", spec
+            assert "not admissible" in reply["error"], spec
+        finally:
+            s.close()
+
+
+def test_server_refuses_overflow_and_nonpositive_dims_typed(served):
+    """Header dims are validated with overflow-safe Python-int math: a
+    product that wraps a fixed-width accumulator into matching
+    payload_len, negative dims that cancel, and zero dims must all get
+    a typed bad_body refusal — never an untyped alloc failure."""
+    _, srv = served
+    cases = [
+        ([1 << 31, 1 << 33], b""),  # int64 product wraps to exactly 0
+        ([-1, -1], b"\x00" * 4),  # negatives cancel to a +1 product
+        ([0], b""),  # zero-size rows
+    ]
+    for shape, payload in cases:
+        s = _dial(srv)
+        try:
+            msg = {
+                "op": "predict",
+                "count": 1,
+                "dtype": "<f4",
+                "shape": shape,
+            }
+            s.sendall(ing.pack_batch_frame(msg, payload))
+            reply, _ = _recv(s)
+            assert reply["kind"] == "bad_body", shape
+        finally:
+            s.close()
+
+
+def test_partial_magic_stall_is_condemned_and_does_not_spin(served):
+    """A peer sending a strict prefix of the magic then stalling used
+    to sit unconsumed under MSG_PEEK — invisible to the stall sweep,
+    and spinning the level-triggered selector at full CPU.  The bytes
+    are now consumed into the frame buffer, so the conn is mid-frame:
+    the sweep condemns it bounded, and the drained socket stops waking
+    the selector (the wait must cost ~no process CPU)."""
+    _, srv = served
+    before = _counter("ingress.frame_errors", kind="mid_frame_stall")
+    s = _dial(srv)
+    try:
+        s.sendall(ing.BATCH_MAGIC[:2])
+        t0, c0 = time.monotonic(), time.process_time()
+        assert s.recv(1, socket.MSG_WAITALL) == b""  # server hangs up
+        wall, cpu = time.monotonic() - t0, time.process_time() - c0
+        assert wall < 10.0  # bounded, never a hang
+        assert cpu < 0.4  # a spinning shard loop would burn ~wall CPU
+        assert (
+            _counter("ingress.frame_errors", kind="mid_frame_stall")
+            == before + 1
+        )
+    finally:
+        s.close()
+
+
+def test_magic_split_across_sniff_still_parses(served):
+    """Bytes consumed during the sniff must flow into the prefix
+    parser: a client trickling the magic a byte at a time still gets
+    its frame served."""
+    _, srv = served
+    s = _dial(srv)
+    try:
+        frame = ing.pack_batch_frame({"op": "ping"})
+        for i in range(len(ing.BATCH_MAGIC)):
+            s.sendall(frame[i : i + 1])
+            time.sleep(0.02)
+        s.sendall(frame[len(ing.BATCH_MAGIC) :])
+        reply, _ = _recv(s)
+        assert reply["op"] == "pong"
+    finally:
+        s.close()
+
+
+def test_shard_loop_survives_internal_handler_error(served, monkeypatch):
+    """An unanticipated exception escaping the per-connection path
+    drops that conn (counted as kind=internal) but must never kill the
+    shard loop — the listener keeps serving everyone else."""
+    _, srv = served
+    before = _counter("ingress.frame_errors", kind="internal")
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic handler bug")
+
+    monkeypatch.setattr(srv, "_parse_prefix", boom)
+    s = _dial(srv)
+    try:
+        s.sendall(ing.pack_batch_frame({"op": "ping"}))
+        _assert_hangup(s)
+    finally:
+        s.close()
+    monkeypatch.undo()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if _counter("ingress.frame_errors", kind="internal") == before + 1:
+            break
+        time.sleep(0.01)
+    assert _counter("ingress.frame_errors", kind="internal") == before + 1
+    with ing.BinaryClient("127.0.0.1", srv.port) as cli:
+        assert cli.ping()["op"] == "pong"  # the shard loop is alive
+
+
 def test_server_mid_frame_stall_is_condemned_never_a_hang(served):
     """A peer that starts a frame and goes silent holds a TORN channel:
     the stall sweep (stall_timeout_s=0.5 here) condemns it bounded."""
