@@ -203,6 +203,16 @@ def _serve_main(argv) -> int:
         "nothing records or resolves it server-side",
     )
     ap.add_argument(
+        "--trace-dump",
+        default=None,
+        metavar="DIR",
+        help="durable flight-recorder snapshots: POST /tracez/dump "
+        "writes the recorder state into DIR (atomic publish), and a "
+        "final snapshot is written at shutdown — the artifact "
+        "tools/trace_report.py reads offline for post-incident "
+        "analysis.  Needs the recorder (conflicts with --no-recorder).",
+    )
+    ap.add_argument(
         "--no-supervise",
         action="store_true",
         help="disable the replica supervisor (self-healing: dead/wedged "
@@ -315,6 +325,9 @@ def _serve_main(argv) -> int:
         ap.error("--hosts (cross-host fleet) requires --workers >= 1")
     if args.hosts and multi:
         ap.error("--hosts is single-tenant only")
+    if args.trace_dump and args.no_recorder:
+        ap.error("--trace-dump needs the flight recorder; drop "
+                 "--no-recorder")
     fleet_kw = (
         dict(workers=args.workers)
         if args.workers
@@ -410,7 +423,13 @@ def _serve_main(argv) -> int:
         watcher = RegistryWatcher(
             svc, registry, poll_seconds=args.watch
         ).start()
-    front = HttpFrontend(svc, host=args.host, port=args.port, registry=registry)
+    front = HttpFrontend(
+        svc,
+        host=args.host,
+        port=args.port,
+        registry=registry,
+        trace_dump_dir=args.trace_dump,
+    )
     print(
         f"serving {source} on http://{args.host}:{front.port} "
         f"(replicas={svc.replicas}, max_batch={args.max_batch}, "
@@ -429,6 +448,15 @@ def _serve_main(argv) -> int:
         if watcher is not None:
             watcher.stop()
         front.server.server_close()
+        if args.trace_dump:
+            # the shutdown snapshot: whatever the recorder holds when
+            # the process exits survives for the post-incident read
+            try:
+                path = svc.dump_trace(args.trace_dump)
+                if path:
+                    print(f"trace dump written to {path}", flush=True)
+            except OSError as e:
+                print(f"trace dump failed: {e}", flush=True)
         svc.close()
     return 0
 

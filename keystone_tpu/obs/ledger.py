@@ -91,6 +91,7 @@ ATTR_VOCABULARY = {
     "from_state",
     "from_replica",
     "grad_norm",
+    "host",
     "instances",
     "it",
     "key",
@@ -139,6 +140,9 @@ ATTR_VOCABULARY = {
     "to_replica",
     "version",
     "waited_seconds",
+    "wire",
+    "worker",
+    "worker_spans",
     "workers",
 }
 

@@ -351,12 +351,161 @@ class MetricsRegistry:
             h = self._hists.get(_key(name, labels))
             return None if h is None else h.as_dict()
 
+    def histogram_summary(
+        self, name: str, quantiles=(0.5, 0.95, 0.99), **labels
+    ) -> Optional[dict]:
+        """One histogram series as ``as_dict()`` plus interpolated
+        quantiles (``p50``/``p95``/... keys), or None.  The read behind
+        ``/statusz`` blocks that need percentiles of a cumulative
+        series (fleet apply/wire, ingress parse/admit) without a
+        windowed wrapper per label combination."""
+        with self._lock:
+            h = self._hists.get(_key(name, labels))
+            if h is None:
+                return None
+            out = h.as_dict()
+            for q in quantiles:
+                out[f"p{int(round(float(q) * 100))}"] = h.quantile(float(q))
+            return out
+
+    def counter_series(self, name: str) -> List[Tuple[dict, float]]:
+        """Every label combination of one counter, as
+        ``(labels_dict, value)`` pairs — the per-kind / per-worker
+        breakdown read (``ingress.frame_errors{kind=}``,
+        ``serve.net.retransmits{worker=}``)."""
+        with self._lock:
+            return [
+                (dict(labels), v)
+                for (n, labels), v in sorted(self._counters.items())
+                if n == name
+            ]
+
+    def histogram_series(
+        self, name: str, quantiles=(0.5, 0.95, 0.99)
+    ) -> List[Tuple[dict, dict]]:
+        """Every label combination of one histogram, as
+        ``(labels_dict, summary)`` pairs (summary per
+        :meth:`histogram_summary`)."""
+        with self._lock:
+            out = []
+            for (n, labels), h in sorted(self._hists.items()):
+                if n != name:
+                    continue
+                d = h.as_dict()
+                for q in quantiles:
+                    d[f"p{int(round(float(q) * 100))}"] = h.quantile(float(q))
+                out.append((dict(labels), d))
+            return out
+
     def remove_gauge(self, name: str, **labels) -> None:
         """Drop one gauge series (registry owners evicting dead keys —
         e.g. guard's breaker registry — keep export cardinality bounded
         by removing the series along with the owner's entry)."""
         with self._lock:
             self._gauges.pop(_key(name, labels), None)
+
+    # --------------------------------------------- cross-process shipping
+    def export_raw(self):
+        """Raw copies of every series, keyed by ``(name, labels)``:
+        ``(counters, gauges, hists)`` where a histogram entry is
+        ``(bounds, buckets, count, sum, min, max)``.  The worker-side
+        delta exporter (``serve/telemetry.py``) diffs two of these;
+        unlike :meth:`snapshot` nothing is string-formatted, so the
+        shipped keys round-trip exactly."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {
+                k: (
+                    h.bounds,
+                    list(h.buckets),
+                    h.count,
+                    h.sum,
+                    (h.min if h.count else None),
+                    (h.max if h.count else None),
+                )
+                for k, h in self._hists.items()
+            }
+        return counters, gauges, hists
+
+    def merge_histogram(
+        self,
+        name: str,
+        labels: Dict[str, object],
+        bounds,
+        buckets,
+        count,
+        total,
+        mn=None,
+        mx=None,
+    ) -> None:
+        """Fold a shipped histogram delta into one series.  The series
+        is created with the SHIPPED bounds (a worker's registration,
+        not this registry's) so bucket counts merge exactly; a
+        bounds/shape mismatch against an existing series drops the
+        shipment rather than corrupting the buckets."""
+        if not enabled():
+            return
+        bounds = tuple(float(b) for b in bounds)
+        buckets = [int(b) for b in buckets]
+        if len(buckets) != len(bounds) + 1:
+            return
+        k = _key(name, labels)
+        with self._lock:
+            self._check_kind(name, "histogram")
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Histogram(bounds)
+            if h.bounds != bounds:
+                return
+            h.count += int(count)
+            h.sum += float(total)
+            for i, n in enumerate(buckets):
+                h.buckets[i] += n
+            if mn is not None:
+                h.min = min(h.min, float(mn))
+            if mx is not None:
+                h.max = max(h.max, float(mx))
+
+    def merge_entries(self, entries, **extra_labels) -> int:
+        """Fold worker-shipped delta entries (the wire format
+        ``serve/telemetry.py`` emits: ``["c"|"g"|"h", name, labels,
+        data]``) into this registry, with ``extra_labels`` (the
+        ``worker=``/``host=`` fan-out) appended to every series.
+        Tolerant by contract — a malformed or kind-conflicting entry is
+        skipped, never raised (an old/new peer mix must degrade to
+        missing telemetry, not a dead fleet).  Returns entries merged."""
+        merged = 0
+        if not entries:
+            return merged
+        for entry in entries:
+            try:
+                kind, name, labels, data = entry
+                name = str(name)
+                lbl = {str(k): str(v) for k, v in labels}
+                for k, v in extra_labels.items():
+                    lbl[str(k)] = str(v)
+                if kind == "c":
+                    self.inc(name, float(data), **lbl)
+                elif kind == "g":
+                    self.set_gauge(name, float(data), **lbl)
+                elif kind == "h":
+                    self.merge_histogram(
+                        name,
+                        lbl,
+                        data["bounds"],
+                        data["buckets"],
+                        data["count"],
+                        data["sum"],
+                        mn=data.get("min"),
+                        mx=data.get("max"),
+                    )
+                else:
+                    continue
+                merged += 1
+            except (MetricKindError, TypeError, ValueError, KeyError):
+                continue
+        return merged
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)

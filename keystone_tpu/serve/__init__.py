@@ -55,6 +55,12 @@ from keystone_tpu.serve.service import (  # noqa: F401
     default_buckets,
     serve,
 )
+from keystone_tpu.serve.telemetry import (  # noqa: F401
+    ClockSync,
+    FleetTelemetry,
+    WorkerTelemetry,
+    clamp_span,
+)
 from keystone_tpu.serve.tenants import (  # noqa: F401
     MultiTenantApplier,
     MultiTenantService,
@@ -67,7 +73,9 @@ __all__ = [
     "AutoscalePolicy",
     "Autoscaler",
     "BinaryClient",
+    "ClockSync",
     "ConnectRetriesExhausted",
+    "FleetTelemetry",
     "FleetUnavailable",
     "HttpFrontend",
     "IngressError",
@@ -93,6 +101,8 @@ __all__ = [
     "RegistryWatcher",
     "ServiceClosed",
     "UnknownTenant",
+    "WorkerTelemetry",
+    "clamp_span",
     "default_buckets",
     "run_worker",
     "serve",

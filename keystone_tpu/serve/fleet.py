@@ -448,6 +448,7 @@ class ReplicaPool:
         artifacts: Optional[dict] = None,
         backend: str = "thread",
         worker_opts: Optional[dict] = None,
+        telemetry=None,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -471,6 +472,12 @@ class ReplicaPool:
         #: process per replica over the shared-memory wire protocol) or
         #: "net" (serve/net.py — lease-fenced remote workers over TCP)
         self.backend = backend
+        #: fleet-telemetry sink (serve/telemetry.FleetTelemetry, or
+        #: None): every worker handle this pool ever constructs —
+        #: initial build, staged generation, supervisor heal, scale-up
+        #: — is attached to it, so shipped spans/metrics survive any
+        #: replica churn.  Set BEFORE _build runs.
+        self.telemetry = telemetry
         #: process-backend knobs (buckets/item_shape/dtype prime the
         #: worker at spawn; ready_timeout bounds spawn→ready)
         self._worker_opts = dict(worker_opts or {})
@@ -609,6 +616,7 @@ class ReplicaPool:
         metrics.observe(
             "serve.worker_spawn_seconds", time.monotonic() - t0
         )
+        handle.attach_telemetry(self.telemetry)
         installed = int(handle.ready_info.get("artifact_buckets", 0))
         if installed:
             metrics.inc("serve.artifact_hits", installed)
@@ -683,6 +691,7 @@ class ReplicaPool:
             ),
         )
         metrics.observe("serve.worker_spawn_seconds", time.monotonic() - t0)
+        handle.attach_telemetry(self.telemetry)
         installed = int(handle.ready_info.get("artifact_buckets", 0))
         if installed:
             metrics.inc("serve.artifact_hits", installed)

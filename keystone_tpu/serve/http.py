@@ -296,6 +296,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/swap":
             self._do_swap()
             return
+        if self.path == "/tracez/dump":
+            self._do_trace_dump()
+            return
         if self.path != "/predict":
             self._send(404, {"error": f"no such path {self.path!r}"})
             return
@@ -438,6 +441,44 @@ class _Handler(BaseHTTPRequestHandler):
             ),
         )
 
+    def _do_trace_dump(self):
+        """Write the flight recorder's state durably to disk (the
+        incident-time snapshot ``tools/trace_report.py`` reads offline).
+        Directory: the request body's ``dir`` key, else the configured
+        ``--trace-dump`` directory.  Codes: 200 with the written path,
+        409 when tracing is off or no directory is known, 400 bad body,
+        500 the write itself failed."""
+        rec = self._recorder_or_409()
+        if rec is None:
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}") or {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+            return
+        dir_path = body.get("dir") or getattr(
+            self.server, "trace_dump_dir", None
+        )
+        if not dir_path:
+            self._send(
+                409,
+                {
+                    "error": "no trace-dump directory configured; start "
+                    'with `keystone serve --trace-dump DIR` or POST '
+                    '{"dir": "..."}'
+                },
+            )
+            return
+        try:
+            path = self.service.dump_trace(str(dir_path))
+        except OSError as e:
+            self._send(500, {"error": f"trace dump failed: {e}"})
+            return
+        self._send(200, {"path": path, "stats": rec.stats()})
+
     def _do_swap(self):
         """Admin blue/green swap from the attached registry.  Codes:
         200 swapped, 409 no registry configured, 404 unknown version,
@@ -513,11 +554,15 @@ class HttpFrontend:
         host: str = "127.0.0.1",
         port: int = 8000,
         registry=None,
+        trace_dump_dir: Optional[str] = None,
     ):
         self.server = ThreadingHTTPServer((host, port), _Handler)
         self.server.service = service  # type: ignore[attr-defined]
         #: ModelRegistry backing POST /swap (None: endpoint answers 409)
         self.server.registry = registry  # type: ignore[attr-defined]
+        #: default directory for POST /tracez/dump (None: the endpoint
+        #: needs an explicit "dir" in its body)
+        self.server.trace_dump_dir = trace_dump_dir  # type: ignore[attr-defined]
         self.server.daemon_threads = True
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -565,20 +610,28 @@ class _DelegateServer:
     and hands the accepted socket here, so every HTTP endpoint keeps
     one implementation while the event loop keeps the fast path."""
 
-    def __init__(self, service: PipelineService, registry=None):
+    def __init__(
+        self, service: PipelineService, registry=None, trace_dump_dir=None
+    ):
         self.service = service
         self.registry = registry
+        self.trace_dump_dir = trace_dump_dir
 
 
 def handle_http_connection(
-    sock, client_address, service: PipelineService, registry=None
+    sock, client_address, service: PipelineService, registry=None,
+    trace_dump_dir=None,
 ) -> None:
     """Serve one already-accepted connection with the stdlib handler
     (blocking; run it on its own thread).  The HTTP/1.1 keep-alive loop
     inside ``BaseHTTPRequestHandler.handle`` serves the connection's
     whole request stream; the socket is closed on return."""
     try:
-        _Handler(sock, client_address, _DelegateServer(service, registry))
+        _Handler(
+            sock,
+            client_address,
+            _DelegateServer(service, registry, trace_dump_dir),
+        )
     except (BrokenPipeError, ConnectionResetError, TimeoutError, OSError) as e:
         logger.debug("http: delegated connection died: %s", e)
     finally:
@@ -593,10 +646,18 @@ def serve_http(
     host: str = "127.0.0.1",
     port: int = 8000,
     registry=None,
+    trace_dump_dir: Optional[str] = None,
 ) -> HttpFrontend:
     """Stand up (and start) the HTTP front end for ``service`` on a
     background thread; returns the :class:`HttpFrontend` (``.port`` for
     ephemeral binds, ``.stop()`` to shut down).  ``registry``: a
     :class:`~keystone_tpu.serve.registry.ModelRegistry` enabling the
-    ``POST /swap`` admin endpoint."""
-    return HttpFrontend(service, host=host, port=port, registry=registry).start()
+    ``POST /swap`` admin endpoint.  ``trace_dump_dir``: default
+    directory for ``POST /tracez/dump`` snapshots."""
+    return HttpFrontend(
+        service,
+        host=host,
+        port=port,
+        registry=registry,
+        trace_dump_dir=trace_dump_dir,
+    ).start()

@@ -87,6 +87,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from keystone_tpu.obs import metrics
+from keystone_tpu.obs.recorder import new_request_id
 from keystone_tpu.serve import wire
 from keystone_tpu.serve.fleet import FleetUnavailable
 from keystone_tpu.serve.http import handle_http_connection
@@ -203,10 +204,20 @@ class IngressError(RuntimeError):
     ``poison`` / ``unavailable`` / ``closed`` / ``bad_request`` /
     ``error``) so a client can map it without string-matching."""
 
-    def __init__(self, message: str, kind: str = "error", retry_after=None):
+    def __init__(
+        self,
+        message: str,
+        kind: str = "error",
+        retry_after=None,
+        request_ids=None,
+    ):
         super().__init__(message)
         self.kind = kind
         self.retry_after = retry_after
+        #: the per-row trace ids the refused frame would have served
+        #: under (echoed by the server on every typed refusal) — quote
+        #: one at ``GET /requestz/<id>`` to see how far it got
+        self.request_ids = list(request_ids or [])
 
 
 # ---------------------------------------------------------------- server
@@ -286,9 +297,13 @@ class AsyncIngress:
         registry=None,
         stall_timeout_s: float = wire.MID_FRAME_TIMEOUT_S,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        trace_dump_dir: Optional[str] = None,
     ):
         self.service = service
         self.registry = registry
+        #: default directory for POST /tracez/dump on the sniffed HTTP
+        #: path (None: the endpoint needs an explicit "dir" in its body)
+        self.trace_dump_dir = trace_dump_dir
         self.host = host
         self.stall_timeout_s = float(stall_timeout_s)
         self.max_frame_bytes = int(max_frame_bytes)
@@ -598,7 +613,13 @@ class AsyncIngress:
         sock_.setblocking(True)
         threading.Thread(
             target=handle_http_connection,
-            args=(sock_, addr, self.service, self.registry),
+            args=(
+                sock_,
+                addr,
+                self.service,
+                self.registry,
+                self.trace_dump_dir,
+            ),
             daemon=True,
             name="ingress-http",
         ).start()
@@ -821,6 +842,18 @@ class AsyncIngress:
         conn.closing = True  # close once the error frame drains
 
     # -------------------------------------------------------- dispatching
+    @staticmethod
+    def _request_ids_for(msg: dict, count: int) -> List[str]:
+        """Request-id parity with the HTTP front end: honor the
+        client's ``request_id`` body key, else mint one; a multi-row
+        frame fans out ``<rid>/<i>`` sub-ids so each row's causal chain
+        resolves individually at ``/requestz/<id>``."""
+        rid = msg.get("request_id")
+        rid = (str(rid).strip() if rid is not None else "") or new_request_id()
+        if count == 1:
+            return [rid]
+        return [f"{rid}/{i}" for i in range(count)]
+
     def _dispatch(self, conn: _Conn) -> None:
         """Admit one complete predict frame: the whole block under one
         service lock round; futures resolve on service threads and the
@@ -834,12 +867,19 @@ class AsyncIngress:
         tenant = msg.get("tenant")
         tenant = None if tenant is None else str(tenant)
         svc = self.service
+        rids = self._request_ids_for(msg, block.count)
+        rec = svc.recorder
+        if rec is not None:
+            for r in rids:
+                rec.annotate(r, "bin.ingress", rows=block.count)
         t0 = time.monotonic()
         try:
-            futs = svc.submit_batch(block, deadline=deadline, tenant=tenant)
+            futs = svc.submit_batch(
+                block, deadline=deadline, request_ids=rids, tenant=tenant
+            )
         except BaseException as e:
             block.close()
-            self._enqueue_response(conn, self._error_frame(seq, e))
+            self._enqueue_response(conn, self._error_frame(seq, e, rids))
             return
         metrics.observe("ingress.admit_seconds", time.monotonic() - t0)
         metrics.inc("ingress.batch_rows", len(futs))
@@ -855,18 +895,18 @@ class AsyncIngress:
                 state["left"] -= 1
                 if state["left"]:
                     return
-            self._finish_batch(conn, seq, futs)
+            self._finish_batch(conn, seq, futs, rids)
 
         for f in futs:
             f.add_done_callback(on_done)
 
-    def _finish_batch(self, conn: _Conn, seq, futs) -> None:
+    def _finish_batch(self, conn: _Conn, seq, futs, rids=None) -> None:
         """All futures of one batch resolved (runs on a service
         thread): assemble the response frame, enqueue, wake the loop."""
         try:
             rows = [f.result(timeout=0) for f in futs]
         except BaseException as e:
-            self._enqueue_response(conn, self._error_frame(seq, e))
+            self._enqueue_response(conn, self._error_frame(seq, e, rids))
             return
         try:
             out = np.ascontiguousarray(np.stack(rows))
@@ -878,16 +918,17 @@ class AsyncIngress:
                     "count": int(out.shape[0]),
                     "dtype": out.dtype.str,
                     "shape": list(out.shape[1:]),
+                    "request_ids": list(rids or []),
                 },
                 out.tobytes(),
             )
         except BaseException as e:  # heterogeneous rows, pack failure
-            self._enqueue_response(conn, self._error_frame(seq, e))
+            self._enqueue_response(conn, self._error_frame(seq, e, rids))
             return
         self._enqueue_response(conn, frame)
 
     @staticmethod
-    def _error_frame(seq, e: BaseException) -> bytes:
+    def _error_frame(seq, e: BaseException, rids=None) -> bytes:
         if isinstance(e, Overloaded):
             kind = "overloaded"
         elif isinstance(e, guard.DeadlineExceeded):
@@ -911,6 +952,11 @@ class AsyncIngress:
             "kind": kind,
             "error": f"{type(e).__name__}: {e}",
         }
+        if rids:
+            # every typed refusal echoes the ids the frame would have
+            # served under — the id a client quotes at /requestz/<id>
+            # must exist whether the request succeeded or was refused
+            body["request_ids"] = list(rids)
         retry = getattr(e, "retry_after_seconds", None)
         if retry is not None:
             body["retry_after_seconds"] = float(retry)
@@ -995,6 +1041,8 @@ class BinaryClient:
         self.timeout = float(timeout)
         self._lock = threading.Lock()
         self._seq = 0
+        #: per-row trace ids of the most recent successful predict
+        self.last_request_ids: List[str] = []
         self.sock = socket.create_connection(
             (host, port), timeout=connect_timeout
         )
@@ -1014,6 +1062,7 @@ class BinaryClient:
                 str(reply.get("error") or "server error"),
                 kind=str(reply.get("kind") or "error"),
                 retry_after=reply.get("retry_after_seconds"),
+                request_ids=reply.get("request_ids"),
             )
         return reply, rpayload
 
@@ -1026,7 +1075,12 @@ class BinaryClient:
         batch: np.ndarray,
         tenant: Optional[str] = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> np.ndarray:
+        """``request_id``: the trace identity for this frame (else the
+        server mints one) — per-row ids come back on the reply and are
+        kept on :attr:`last_request_ids`; a refusal carries them on
+        ``IngressError.request_ids``."""
         batch = np.ascontiguousarray(batch)
         if batch.ndim < 1:
             raise ValueError("batch must be (n, ...) — at least 1-D")
@@ -1036,11 +1090,14 @@ class BinaryClient:
             "dtype": batch.dtype.str,
             "shape": list(batch.shape[1:]),
         }
+        if request_id is not None:
+            msg["request_id"] = str(request_id)
         if tenant is not None:
             msg["tenant"] = str(tenant)
         if deadline_ms is not None:
             msg["deadline_ms"] = float(deadline_ms)
         reply, payload = self._roundtrip(msg, batch.tobytes())
+        self.last_request_ids = list(reply.get("request_ids") or [])
         dtype = np.dtype(reply["dtype"])
         shape = (int(reply["count"]),) + tuple(
             int(d) for d in reply.get("shape") or ()

@@ -294,6 +294,9 @@ class NetWorkerHandle:
         self.lease_s = float(lease_s)
         self.max_frame_bytes = int(max_frame_bytes)
         self.hello = dict(hello)
+        #: fleet-telemetry sink (``serve/telemetry.py``), attached by
+        #: the pool via :meth:`attach_telemetry`; None = telemetry off
+        self.telemetry = None
         self._sock = sock
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()  # strict one-in-flight
@@ -346,6 +349,10 @@ class NetWorkerHandle:
             )
         self.ready_info = ready
         self.spawn_seconds = time.monotonic() - t0
+        #: the deploy→ready exchange's telemetry (remote build/prime
+        #: spans + first clock sample), flushed when the pool attaches
+        #: its sink
+        self._pending_ready = (t0, time.monotonic(), ready.get("telemetry"))
         self.artifact_keys = {
             (tuple(shape), str(dt))
             for shape, dt in ready.get("artifact_keys", ())
@@ -359,6 +366,30 @@ class NetWorkerHandle:
             target=self._beat_loop, daemon=True, name=f"{self.name}-beat"
         )
         self._beater.start()
+
+    # --------------------------------------------------------- telemetry
+    def attach_telemetry(self, sink) -> None:
+        """Wire this handle to the pool's fleet-telemetry sink and
+        flush the deploy→ready exchange's shipment.  Safe with
+        ``sink=None``."""
+        self.telemetry = sink
+        pending, self._pending_ready = getattr(
+            self, "_pending_ready", None
+        ), None
+        if sink is None or pending is None:
+            return
+        t_send, t_recv, shipped = pending
+        sink.on_exchange(self.name, self.peer_host, t_send, t_recv, shipped)
+
+    def _ship_reply_telemetry(self, reply, t_send, t_recv, trace) -> None:
+        sink = self.telemetry
+        if sink is None or not isinstance(reply, dict):
+            return
+        shipped = reply.get("telemetry")
+        if shipped is not None:
+            sink.on_exchange(
+                self.name, self.peer_host, t_send, t_recv, shipped, trace=trace
+            )
 
     # ---------------------------------------------------------- liveness
     @property
@@ -449,6 +480,13 @@ class NetWorkerHandle:
             self._last_rx = time.monotonic()
             op = msg.get("op")
             if op == "beat":
+                shipped = msg.get("telemetry")
+                sink = self.telemetry
+                if shipped is not None and sink is not None:
+                    # worker metrics deltas piggyback on the beats the
+                    # worker already sends — no extra frames, and an
+                    # old worker (no telemetry key) is simply silent
+                    sink.on_beat(self.name, self.peer_host, shipped)
                 continue
             if op == "bye_ack":
                 self._bye_ack.set()
@@ -493,11 +531,15 @@ class NetWorkerHandle:
         arr: np.ndarray,
         n: int,
         deadline_s: Optional[float] = None,
+        trace: Optional[dict] = None,
     ) -> np.ndarray:
         """One remote apply: frame the padded batch inline, wait for the
         matching flush id.  Raises the relayed typed error, or
         :class:`WorkerCrashed` when the channel died or the lease
-        expired mid-request — the un-claim/front-requeue/heal path."""
+        expired mid-request — the un-claim/front-requeue/heal path.
+
+        ``trace``: optional trace context carried as a frame body key —
+        absent when the recorder is off, ignored by an old worker."""
         meta, payload = wire.array_payload(arr)
         if len(payload) > self.max_frame_bytes:
             raise wire.PayloadTooLarge(
@@ -522,6 +564,9 @@ class NetWorkerHandle:
                     "deadline_s": deadline_s,
                     "meta": meta,
                 }
+                if trace is not None:
+                    frame["trace"] = trace
+                t_send = time.monotonic()
                 try:
                     self._send(frame, payload)
                 except OSError as e:
@@ -530,6 +575,13 @@ class NetWorkerHandle:
                         f"{self.name}: apply send failed ({e})"
                     ) from e
                 reply, rpayload = self._wait_reply(fid, frame, payload)
+                # the clock-sync sample pairs this side's FIRST send
+                # with the reply arrival; a reply to a retransmit only
+                # inflates the measured delay, and an inflated sample
+                # loses the min-delay race instead of skewing the offset
+                self._ship_reply_telemetry(
+                    reply, t_send, time.monotonic(), trace
+                )
             finally:
                 with self._resp_cond:
                     self._pending_fid = None
@@ -783,6 +835,7 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
     the applier, answer applies until ``bye`` / EOF / self-fence.
     Returns the exit reason; anything but ``"bye"`` means the caller
     should dial back for a fresh lease."""
+    from keystone_tpu.serve.telemetry import WorkerTelemetry
     from keystone_tpu.serve.worker import build_from_payload, classify_error
     from keystone_tpu.utils import durable, guard
     from keystone_tpu.workflow.dataset import Dataset
@@ -825,15 +878,20 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
     # exact generation this process already built and primed)
     digest = spec.get("digest")
     t0 = time.monotonic()
+    #: span capture + metrics-delta shipping, piggybacked on the frames
+    #: this session already answers (ready, beat, result, error); an
+    #: old router ignores the optional ``telemetry`` body key
+    tel = WorkerTelemetry()
     cached = cache.get(digest) if digest else None
     try:
         if cached is not None:
             applier, installed, primed = cached[0], cached[1], 0
             logger.info("%s: reusing built applier for %s", name, digest)
         else:
-            deploy_payload = pickle.loads(payload)
+            with tel.span("worker.load"):
+                deploy_payload = pickle.loads(payload)
             applier, installed, primed = durable.with_retries(
-                lambda: build_from_payload(deploy_payload, spec),
+                lambda: build_from_payload(deploy_payload, spec, tel=tel),
                 description=f"{wname} build",
             )
             if digest:
@@ -861,6 +919,7 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
                 "artifact_buckets": installed,
                 "artifact_keys": _ready_artifact_keys(applier),
                 "startup_seconds": round(time.monotonic() - t0, 3),
+                "telemetry": tel.ship(t_rx=t0),
             }
         )
     except OSError:
@@ -869,8 +928,15 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
     def beat_loop() -> None:
         interval = _beat_interval(lease_s)
         while not stop.wait(interval):
+            beat: dict = {"op": "beat"}
+            # metrics deltas ride the beats the lease already requires
+            # — no extra frames, bounded entries, and a quiet registry
+            # ships nothing at all
+            entries = tel.metrics_entries(min_interval_s=1.0)
+            if entries:
+                beat["telemetry"] = {"metrics": entries}
             try:
-                wsend({"op": "beat"})
+                wsend(beat)
             except OSError:
                 return
 
@@ -938,7 +1004,8 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
                 continue
             t_apply = time.monotonic()
             try:
-                arr = wire.payload_array(msg["meta"], payload)
+                with tel.span("worker.attach"):
+                    arr = wire.payload_array(msg["meta"], payload)
                 n = int(msg.get("n", arr.shape[0]))
                 deadline_s = msg.get("deadline_s")
                 deadline = (
@@ -946,7 +1013,8 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
                     if deadline_s is None
                     else guard.Deadline.after(float(deadline_s))
                 )
-                out = applier(Dataset(arr, n=n), deadline=deadline)
+                with tel.span("worker.apply", n=n):
+                    out = applier(Dataset(arr, n=n), deadline=deadline)
                 result = np.asarray(out.array)
                 rmeta, rpayload = wire.array_payload(result)
                 reply = {
@@ -954,6 +1022,7 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
                     "fid": fid,
                     "meta": rmeta,
                     "seconds": round(time.monotonic() - t_apply, 6),
+                    "telemetry": tel.ship(t_rx=t_apply),
                 }
             except BaseException as e:
                 reply, rpayload = {
@@ -963,6 +1032,7 @@ def _worker_session(sock: socket.socket, name: str, cache: dict) -> str:
                     "etype": type(e).__name__,
                     "emsg": str(e)[:800],
                     "seconds": round(time.monotonic() - t_apply, 6),
+                    "telemetry": tel.ship(t_rx=t_apply),
                 }, b""
             # beats queued behind a long compute refresh the lease
             # BEFORE the fence verdict — only true silence fences

@@ -51,6 +51,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import socket  # lint: allow-socket (gethostname only; no network use)
 import threading
 import time
 from typing import Optional
@@ -150,6 +151,13 @@ class WorkerHandle:
         ctx = mp.get_context("spawn")
         self.name = f"{name}-worker{index}"
         self.index = int(index)
+        #: fleet-telemetry sink (``serve/telemetry.py``), attached by
+        #: the pool via :meth:`attach_telemetry`; None = telemetry off
+        #: (shipped blobs are simply dropped — old-router behavior)
+        self.telemetry = None
+        #: host label for fleet metrics — the process fleet is same-box
+        #: by construction
+        self.peer_host = socket.gethostname()
         self._hb = ctx.Value("d", 0.0)
         parent_conn, child_conn = ctx.Pipe(duplex=True)
         self._conn = parent_conn
@@ -204,11 +212,41 @@ class WorkerHandle:
             )
         self.ready_info = ready
         self.spawn_seconds = time.monotonic() - t0
+        #: the ready exchange's telemetry (load/build/prime spans), held
+        #: until a sink is attached — the pool attaches one right after
+        #: construction, so cold-start spans are not lost to ordering
+        self._pending_ready = (t0, time.monotonic(), ready.get("telemetry"))
         #: installed AOT program keys, for honest prime-source labels
         self.artifact_keys = {
             (tuple(shape), str(dt))
             for shape, dt in ready.get("artifact_keys", ())
         }
+
+    # --------------------------------------------------------- telemetry
+    def attach_telemetry(self, sink) -> None:
+        """Wire this handle to the pool's fleet-telemetry sink and
+        flush the ready exchange's shipment (spawn-time spans).  Safe
+        with ``sink=None`` (telemetry stays off)."""
+        self.telemetry = sink
+        pending, self._pending_ready = getattr(
+            self, "_pending_ready", None
+        ), None
+        if sink is None or pending is None:
+            return
+        t_send, t_recv, shipped = pending
+        sink.on_exchange(self.name, self.peer_host, t_send, t_recv, shipped)
+
+    def _ship_reply_telemetry(self, reply, t_send, t_recv, trace) -> None:
+        """Hand one reply's shipped telemetry to the sink (never raises
+        into the request path — the sink swallows malformed blobs)."""
+        sink = self.telemetry
+        if sink is None or not isinstance(reply, dict):
+            return
+        shipped = reply.get("telemetry")
+        if shipped is not None:
+            sink.on_exchange(
+                self.name, self.peer_host, t_send, t_recv, shipped, trace=trace
+            )
 
     # ---------------------------------------------------------- liveness
     @property
@@ -234,6 +272,7 @@ class WorkerHandle:
         n: int,
         deadline_s: Optional[float] = None,
         slab_ref: Optional[dict] = None,
+        trace: Optional[dict] = None,
     ) -> np.ndarray:
         """One remote apply: copy into a slab, frame, wait, read the
         result slab.  Raises the relayed typed error, or
@@ -246,12 +285,19 @@ class WorkerHandle:
         the CALLER owns (an ingress admission block) — ship the
         reference and skip the dispatch memcpy entirely.  The caller
         must keep the slab alive until this returns (it does: the
-        request is strictly one-in-flight and blocks for the reply)."""
+        request is strictly one-in-flight and blocks for the reply).
+
+        ``trace``: optional trace context (``{"batch": ..,
+        "request_ids": [..]}``) carried as a frame body key — absent
+        when the recorder is off (the frame is byte-identical to the
+        pre-trace wire), ignored by an old worker when present."""
         msg = {"op": "apply", "n": int(n), "deadline_s": deadline_s}
+        if trace is not None:
+            msg["trace"] = trace
         if slab_ref is not None:
-            reply, out = self._request(msg, ref=slab_ref)
+            reply, out = self._request(msg, ref=slab_ref, trace=trace)
         else:
-            reply, out = self._request(msg, arr=arr)
+            reply, out = self._request(msg, arr=arr, trace=trace)
         return out
 
     def ping(self) -> dict:
@@ -263,6 +309,7 @@ class WorkerHandle:
         msg: dict,
         arr: Optional[np.ndarray] = None,
         ref: Optional[dict] = None,
+        trace: Optional[dict] = None,
     ):
         with self._lock:
             if self._closed:
@@ -277,6 +324,7 @@ class WorkerHandle:
                     slab, ref_ = wire.write_array(self._pool, arr)
                     metrics.inc("dispatch.bytes_copied", int(arr.nbytes))
                     msg = dict(msg, ref=ref_)
+                t_send = time.monotonic()
                 try:
                     wire.send_frame(self._conn, msg)
                     reply = wire.recv_frame(self._conn)
@@ -285,6 +333,11 @@ class WorkerHandle:
                         f"{self.name} (pid {self.pid}) died mid-request "
                         f"({type(e).__name__}: {e})"
                     ) from e
+                # error replies ship telemetry too: a failing apply is
+                # exactly the span an operator wants on /requestz
+                self._ship_reply_telemetry(
+                    reply, t_send, time.monotonic(), trace
+                )
             finally:
                 if slab is not None:
                     # the child copies at use and has answered: the
@@ -418,7 +471,7 @@ class RemoteApplier:
         (same-host process workers; cross-host net handles cannot)."""
         return bool(getattr(self.handle, "accepts_slab_ref", False))
 
-    def __call__(self, x, deadline=None, n=None, slab_ref=None, **kw):
+    def __call__(self, x, deadline=None, n=None, slab_ref=None, trace=None, **kw):
         if kw:
             # multi-tenant segment kwargs need in-process walks; the
             # service refuses workers>0 for multi-tenant deploys
@@ -437,9 +490,11 @@ class RemoteApplier:
         if deadline is not None:
             deadline_s = max(0.0, deadline.remaining())
         if slab_ref is not None and self.accepts_slab_ref:
-            out = self.handle.apply(arr, int(n), deadline_s, slab_ref=slab_ref)
+            out = self.handle.apply(
+                arr, int(n), deadline_s, slab_ref=slab_ref, trace=trace
+            )
         else:
-            out = self.handle.apply(arr, int(n), deadline_s)
+            out = self.handle.apply(arr, int(n), deadline_s, trace=trace)
         return _HostOut(out)
 
     # ------------------------------------------------- status/prime hooks

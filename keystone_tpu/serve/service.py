@@ -556,6 +556,21 @@ class PipelineService:
         else:
             pool_backend = "thread"
             pool_worker_opts = None
+        #: fleet telemetry (workers > 0): the one sink every worker
+        #: handle ships spans/metric-deltas into.  Built BEFORE the pool
+        #: (handles attach at construction); its recorder reference is
+        #: wired after the recorder itself exists below.  Thread fleets
+        #: have no wire to account for — no sink.
+        self._telemetry = None
+        self._trace_ctx_cap = 0
+        if workers > 0:
+            from keystone_tpu.serve.telemetry import (
+                MAX_TRACE_REQUEST_IDS,
+                FleetTelemetry,
+            )
+
+            self._telemetry = FleetTelemetry()
+            self._trace_ctx_cap = MAX_TRACE_REQUEST_IDS
         self._pool = ReplicaPool(
             pipeline,
             replicas=replicas,
@@ -566,6 +581,7 @@ class PipelineService:
             artifacts=artifacts,
             backend=pool_backend,
             worker_opts=pool_worker_opts,
+            telemetry=self._telemetry,
         )
         #: the flight recorder: True (default) = a fresh bounded
         #: recorder, False/None = tracing fully off (request ids stay
@@ -577,6 +593,16 @@ class PipelineService:
             self.recorder = recorder
         else:
             self.recorder = None
+        if self._telemetry is not None:
+            # shipped worker spans stitch into /requestz via the
+            # recorder; with the recorder off the sink still aggregates
+            # fleet METRICS (trace contexts are never sent at all)
+            self._telemetry.recorder = self.recorder
+        #: thread-local trace context: set by _run_batch around a
+        #: dispatch (recorder on + remote fleet only), read by
+        #: _apply_rows' remote branch — threaded out-of-band because
+        #: _apply_reqs is an override point (serve/tenants.py)
+        self._trace_tls = threading.local()
         #: rolling-window latency/batch instruments backing /statusz
         #: percentiles; every observe also feeds the cumulative
         #: registry series of the same name (/metrics)
@@ -596,6 +622,7 @@ class PipelineService:
         )
         self._slo_target = min(1.0, max(0.0, float(slo_target)))
         self._batch_seq = itertools.count(1)
+        self._trace_dump_seq = itertools.count(1)
         #: span-parenting context captured where the service was built:
         #: restored in the batcher and every replica worker, so ledger
         #: spans emitted there nest under the constructor's open span
@@ -1370,6 +1397,14 @@ class PipelineService:
         return self._pool.set_window(n)
 
     # ------------------------------------------------------------- statusz
+    @classmethod
+    def _ingress_ms(cls, reg, name: str) -> Optional[dict]:
+        """One cumulative ingress histogram as a ms summary, or None
+        when the front end never observed it (HTTP-only traffic has no
+        binary parse samples)."""
+        summary = reg.histogram_summary(name)
+        return None if summary is None else cls._ms(summary)
+
     @staticmethod
     def _ms(window_summary: dict) -> dict:
         """A windowed summary in milliseconds (rounded for the wire)."""
@@ -1458,6 +1493,35 @@ class PipelineService:
             ),
             "recorder": None if rec is None else rec.stats(),
         }
+        # front-end ingress health (present once any front end has
+        # served a connection — pure registry reads, so a library-only
+        # service with no listener shows an all-zero block harmlessly
+        # only if something registered the histograms; gate on traffic)
+        ingress_conns = reg.counter_total(
+            "ingress.bin_conns"
+        ) + reg.counter_total("ingress.http_conns")
+        if ingress_conns or reg.counter_total("ingress.accepts"):
+            out["ingress"] = {
+                "accepts": reg.counter_total("ingress.accepts"),
+                "bin_conns": reg.counter_total("ingress.bin_conns"),
+                "http_conns": reg.counter_total("ingress.http_conns"),
+                "frames": reg.counter_total("ingress.frames"),
+                "batch_rows": reg.counter_total("ingress.batch_rows"),
+                "bytes_copied": reg.counter_total("ingress.bytes_copied"),
+                "frame_errors": {
+                    labels.get("kind", "?"): value
+                    for labels, value in reg.counter_series(
+                        "ingress.frame_errors"
+                    )
+                },
+                "parse_ms": self._ingress_ms(reg, "ingress.parse_seconds"),
+                "admit_ms": self._ingress_ms(reg, "ingress.admit_seconds"),
+            }
+        if self._telemetry is not None:
+            # the fleet block: per-worker apply/wire percentiles and
+            # clock-sync health, built from the spans/metric deltas
+            # workers shipped over their existing reply/beat frames
+            out["fleet"] = self._telemetry.fleet_status()
         if self._slo_s is not None:
             # bad = completed-but-over-objective PLUS every failed
             # terminal (shed/rejected/error) in the window: a shed
@@ -1486,6 +1550,37 @@ class PipelineService:
                 ),
             }
         return out
+
+    def dump_trace(self, dir_path: str) -> Optional[str]:
+        """Write the flight recorder's full state (the ``/tracez?full=1``
+        payload) durably into ``dir_path`` and return the file path —
+        the artifact ``tools/trace_report.py`` reads offline (its
+        recorder-dump mode; the ``.json`` suffix is load()'s mode
+        switch).  Returns None when tracing is off.  Published via
+        ``utils.durable.atomic_write`` so a crash mid-dump never leaves
+        a truncated file for the post-incident read."""
+        import json
+        import os
+
+        rec = self.recorder
+        if rec is None:
+            return None
+        os.makedirs(dir_path, exist_ok=True)
+        seq = next(self._trace_dump_seq)
+        path = os.path.join(
+            dir_path,
+            f"trace-{self.name}-{int(time.time())}-{seq}.json",  # lint: allow-wall-clock
+        )
+        payload = rec.dump()
+
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+
+        from keystone_tpu.utils import durable
+
+        durable.atomic_write(path, _write)
+        return path
 
     # --------------------------------------------------------------- swap
     def swap(
@@ -1943,7 +2038,21 @@ class PipelineService:
                     dls = [r.deadline for r in live if r.deadline is not None]
                     if dls and len(dls) == len(live):
                         batch_deadline = max(dls, key=lambda d: d.at)
-                out = self._apply_reqs(live, replica, batch_deadline)
+                # trace context for the wire: set ONLY when the recorder
+                # is on AND the fleet is remote — recorder-off keeps
+                # every apply frame byte-identical (pinned), and the
+                # thread fleet has no wire to annotate.  Thread-local
+                # because _apply_reqs is an override point
+                # (serve/tenants.py) whose signature must not grow.
+                if rec is not None and self._telemetry is not None:
+                    self._trace_tls.ctx = {
+                        "batch": bid,
+                        "request_ids": trace_ids[: self._trace_ctx_cap],
+                    }
+                try:
+                    out = self._apply_reqs(live, replica, batch_deadline)
+                finally:
+                    self._trace_tls.ctx = None
         except WorkerCrashed:
             # process death is NOT a batch error: the flush will be
             # re-run whole on the slot's replacement (see _run_flush)
@@ -2258,6 +2367,13 @@ class PipelineService:
                 # the same segment by name — the dispatch memcpy is
                 # skipped too
                 apply_kw = dict(apply_kw, slab_ref=slab_ref)
+            trace_ctx = getattr(self._trace_tls, "ctx", None)
+            if trace_ctx is not None:
+                # recorder-on dispatch: the batch id + rider ids ride
+                # the apply frame so the worker's shipped spans stitch
+                # back to this flush's record.  None (recorder off,
+                # prime calls) adds no key — the frame is byte-identical
+                apply_kw = dict(apply_kw, trace=trace_ctx)
             out = rep.apply(
                 padded, deadline=deadline, prime=prime, n=k, **apply_kw
             )

@@ -45,9 +45,12 @@ import pickle
 import threading
 import time
 
+import contextlib
+
 import numpy as np
 
 from keystone_tpu.serve import wire
+from keystone_tpu.serve.telemetry import WorkerTelemetry
 
 logger = logging.getLogger(__name__)
 
@@ -143,19 +146,28 @@ def _prime(applier, buckets, item_shape, dtype) -> int:
     return n
 
 
-def build_from_payload(payload: dict, spec: dict):
+def build_from_payload(payload: dict, spec: dict, tel=None):
     """The full cold-start ladder shared by BOTH worker transports (the
     pipe-spawned process worker and the TCP worker of ``serve/net.py``):
     freeze the pipeline, install AOT artifacts (degrading to the
     compile ladder on a damaged bundle), and prime every padding
-    bucket.  Returns ``(applier, installed, primed)``."""
-    applier, installed = _build_applier(payload)
-    primed = _prime(
-        applier,
-        spec.get("buckets"),
-        spec.get("item_shape"),
-        spec.get("dtype") or "float32",
+    bucket.  Returns ``(applier, installed, primed)``.  ``tel``: a
+    :class:`~keystone_tpu.serve.telemetry.WorkerTelemetry` that records
+    ``worker.build`` / ``worker.prime`` spans for shipping on the ready
+    frame — cold-start time becomes visible from the router's ops
+    surface, not just worker logs."""
+    span = tel.span if tel is not None else (
+        lambda _name, **_a: contextlib.nullcontext()
     )
+    with span("worker.build"):
+        applier, installed = _build_applier(payload)
+    with span("worker.prime"):
+        primed = _prime(
+            applier,
+            spec.get("buckets"),
+            spec.get("item_shape"),
+            spec.get("dtype") or "float32",
+        )
     return applier, installed, primed
 
 
@@ -217,10 +229,17 @@ def worker_main(conn, spec: dict) -> None:
         ),
     )
     attacher = wire.SlabAttacher()
+    #: worker-side telemetry: load/prime/attach/apply spans plus
+    #: metrics-registry deltas, shipped by piggybacking on the frames
+    #: this loop already answers (ready, result, error) — bounded,
+    #: dropped-not-queued, and invisible to an old router (optional
+    #: body key)
+    tel = WorkerTelemetry()
     t0 = time.monotonic()
     try:
-        payload = _load_payload(spec["payload_path"])
-        applier, installed, primed = build_from_payload(payload, spec)
+        with tel.span("worker.load"):
+            payload = _load_payload(spec["payload_path"])
+        applier, installed, primed = build_from_payload(payload, spec, tel=tel)
     except BaseException as e:
         try:
             wire.send_frame(
@@ -244,6 +263,7 @@ def worker_main(conn, spec: dict) -> None:
             "artifact_buckets": installed,
             "artifact_keys": _artifact_keys(applier),
             "startup_seconds": round(time.monotonic() - t0, 3),
+            "telemetry": tel.ship(t_rx=t0),
         },
     )
 
@@ -282,7 +302,8 @@ def worker_main(conn, spec: dict) -> None:
                 continue
             t_apply = time.monotonic()
             try:
-                arr = attacher.read(msg["ref"])
+                with tel.span("worker.attach"):
+                    arr = attacher.read(msg["ref"])
                 n = int(msg.get("n", arr.shape[0]))
                 deadline_s = msg.get("deadline_s")
                 deadline = (
@@ -290,7 +311,8 @@ def worker_main(conn, spec: dict) -> None:
                     if deadline_s is None
                     else guard.Deadline.after(float(deadline_s))
                 )
-                out = applier(Dataset(arr, n=n), deadline=deadline)
+                with tel.span("worker.apply", n=n):
+                    out = applier(Dataset(arr, n=n), deadline=deadline)
                 result = np.asarray(out.array)
                 slab, ref = wire.write_array(pool, result)
             except BaseException as e:
@@ -302,6 +324,7 @@ def worker_main(conn, spec: dict) -> None:
                         "etype": type(e).__name__,
                         "emsg": str(e)[:800],
                         "seconds": round(time.monotonic() - t_apply, 6),
+                        "telemetry": tel.ship(t_rx=t_apply),
                     },
                 )
                 continue
@@ -312,6 +335,7 @@ def worker_main(conn, spec: dict) -> None:
                     "op": "result",
                     "ref": ref,
                     "seconds": round(time.monotonic() - t_apply, 6),
+                    "telemetry": tel.ship(t_rx=t_apply),
                 },
             )
     finally:

@@ -1,0 +1,319 @@
+"""Fleet-wide distributed tracing & metrics (ISSUE 18) — unit tier.
+
+What this file pins:
+
+- :class:`ClockSync`: NTP four-timestamp math (offset recovered under
+  a known skew), minimum-delay sample selection with decay, rejection
+  of negative-delay samples (a retransmit answered by an earlier
+  send's reply);
+- :func:`clamp_span`: skew tolerance — however wrong the offset
+  estimate, aligned spans stay inside the router's observed
+  ``[t_send, t_recv]`` window, stay ordered, never have negative
+  duration;
+- :class:`WorkerTelemetry`: bounded span ring (dropped-not-queued),
+  ``ship()`` body shape, metric-delta export with throttle and
+  ship-only-what-fits baseline advance;
+- the delta wire format roundtrip: worker ``metrics_entries()`` →
+  router ``merge_entries(..., worker=, host=)`` reproduces counters /
+  gauges / histograms under fleet labels, and a second delta merges
+  only the change;
+- :class:`FleetTelemetry`: stitches worker spans + wire accounting
+  into the FlightRecorder batch record that ``/requestz`` joins;
+  old-peer shipments (absent/garbage ``telemetry``) are no-ops, never
+  errors; ``fleet_status()`` has the ``/statusz`` block shape.
+
+Cross-process e2e lives with each transport's suite
+(tests/test_procfleet.py, tests/test_netfleet.py); the wire-level
+frame pins (no ``trace`` key recorder-off) live in
+tests/test_netfleet.py next to the _FakeWorker scripting.
+"""
+
+import pytest
+
+from keystone_tpu.obs import metrics
+from keystone_tpu.obs.recorder import FlightRecorder
+from keystone_tpu.serve.telemetry import (
+    ClockSync,
+    FleetTelemetry,
+    WorkerTelemetry,
+    clamp_span,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.obs]
+
+
+# --------------------------------------------------------------- ClockSync
+
+
+SKEW = 5.0  # worker_clock - router_clock in every synthetic exchange
+
+
+def _exchange(sync, t_send, wire_s, compute_s, skew=SKEW):
+    """One synthetic four-timestamp exchange with symmetric wire time."""
+    t_rx = t_send + wire_s / 2.0 + skew
+    t_tx = t_rx + compute_s
+    t_recv = t_send + wire_s + compute_s
+    return sync.observe(t_send, t_recv, t_rx, t_tx)
+
+
+def test_clock_sync_recovers_known_skew():
+    sync = ClockSync()
+    delay = _exchange(sync, 10.0, wire_s=0.004, compute_s=0.002)
+    assert delay == pytest.approx(0.004)
+    assert sync.offset == pytest.approx(SKEW)
+    assert sync.to_router(100.0 + SKEW) == pytest.approx(100.0)
+
+
+def test_clock_sync_min_delay_sample_wins():
+    """A slower exchange carries a worse offset bound — it must not
+    displace the best sample even when its (asymmetric) offset
+    estimate differs."""
+    sync = ClockSync()
+    _exchange(sync, 10.0, wire_s=0.002, compute_s=0.001)
+    best = sync.offset
+    # asymmetric slow sample: all the wire time on the send leg, so
+    # its naive offset estimate is off by ~wire/2
+    sync.observe(20.0, 20.102, 20.1 + SKEW, 20.101 + SKEW)
+    assert sync.offset == best  # kept the tight sample
+    assert sync.samples == 2
+
+
+def test_clock_sync_rejects_negative_delay():
+    """The reply to an EARLIER retransmitted send can pair with a later
+    t_send, making measured delay negative — unusable, rejected."""
+    sync = ClockSync()
+    assert sync.observe(10.0, 10.001, 15.0, 15.005) is None
+    assert sync.offset is None and sync.samples == 0
+
+
+def test_clock_sync_decay_readmits_samples_after_drift():
+    """The kept delay bound grows per rejected sample, so a drifted
+    clock re-syncs instead of trusting one ancient lucky sample."""
+    sync = ClockSync()
+    _exchange(sync, 0.0, wire_s=0.001, compute_s=0.0)
+    first_best = sync.best_delay
+    for i in range(200):
+        _exchange(sync, float(i + 1), wire_s=0.0015, compute_s=0.0)
+    assert sync.best_delay > first_best  # decayed upward...
+    # ...far enough that a typical sample finally won and refreshed
+    # the offset (the 0.0015 samples carry the same SKEW, so the
+    # offset stays correct either way)
+    assert sync.offset == pytest.approx(SKEW, abs=1e-3)
+
+
+# --------------------------------------------------------------- clamp_span
+
+
+def test_clamp_span_bounds_order_and_duration():
+    sync = ClockSync()
+    _exchange(sync, 10.0, wire_s=0.004, compute_s=0.010)
+    t_send, t_recv = 10.0, 10.014
+    # a worker span genuinely inside the window aligns inside it
+    r0, r1 = clamp_span(sync, 10.003 + SKEW, 10.011 + SKEW, t_send, t_recv)
+    assert t_send <= r0 <= r1 <= t_recv
+    assert (r1 - r0) == pytest.approx(0.008, abs=1e-6)
+
+
+def test_clamp_span_tolerates_wildly_wrong_offset():
+    """Force a badly wrong offset: the aligned span must still land
+    inside [t_send, t_recv], ordered, with non-negative duration."""
+    sync = ClockSync()
+    sync.observe(0.0, 0.001, 1000.0, 1000.001)  # offset ~ +1000, "valid"
+    t_send, t_recv = 50.0, 50.01
+    r0, r1 = clamp_span(sync, 50.001, 50.009, t_send, t_recv)  # true skew 0
+    assert t_send <= r0 <= r1 <= t_recv
+
+
+def test_clamp_span_without_sync_preserves_duration():
+    sync = ClockSync()
+    t_send, t_recv = 5.0, 5.5
+    r0, r1 = clamp_span(sync, 99.0, 99.2, t_send, t_recv)
+    assert r0 == t_send and (r1 - r0) == pytest.approx(0.2)
+    # duration longer than the window clamps to the window
+    r0, r1 = clamp_span(sync, 99.0, 100.0, t_send, t_recv)
+    assert (r0, r1) == (t_send, t_recv)
+
+
+# --------------------------------------------------------- WorkerTelemetry
+
+
+def test_worker_spans_drop_oldest_never_queue():
+    tel = WorkerTelemetry(registry=metrics.MetricsRegistry(), max_spans=4)
+    for i in range(10):
+        tel.add_span(f"s{i}", float(i), float(i) + 0.5)
+    blob = tel.ship(t_rx=1.0)
+    assert [s["name"] for s in blob["spans"]] == ["s6", "s7", "s8", "s9"]
+    assert blob["t_rx"] == 1.0 and "t_tx" in blob
+    # drained: the next ship carries no spans key at all
+    assert "spans" not in tel.ship()
+
+
+def test_worker_span_recorded_even_when_block_raises():
+    tel = WorkerTelemetry(registry=metrics.MetricsRegistry())
+    with pytest.raises(RuntimeError):
+        with tel.span("worker.apply", n=3):
+            raise RuntimeError("boom")
+    (sp,) = tel.ship()["spans"]
+    assert sp["name"] == "worker.apply" and sp["attrs"] == {"n": 3}
+    assert sp["t1"] >= sp["t0"]
+
+
+def test_metrics_delta_roundtrip_under_fleet_labels():
+    wreg = metrics.MetricsRegistry()
+    rreg = metrics.MetricsRegistry()
+    tel = WorkerTelemetry(registry=wreg)
+    wreg.inc("serve.applies", 3.0)
+    wreg.set_gauge("serve.occupancy", 0.5, replica=0)
+    wreg.observe("serve.apply_seconds", 0.004)
+    entries = tel.metrics_entries(min_interval_s=0.0)
+    assert entries
+    merged = rreg.merge_entries(entries, worker="w0", host="hA")
+    assert merged == len(entries)
+    assert rreg.counter_value("serve.applies", worker="w0", host="hA") == 3.0
+    assert (
+        rreg.gauge_value("serve.occupancy", replica=0, worker="w0", host="hA")
+        == 0.5
+    )
+    h = rreg.histogram_summary("serve.apply_seconds", worker="w0", host="hA")
+    assert h is not None and h["count"] == 1
+    # second delta ships only the change
+    wreg.inc("serve.applies", 2.0)
+    wreg.observe("serve.apply_seconds", 0.006)
+    entries2 = tel.metrics_entries(min_interval_s=0.0)
+    rreg.merge_entries(entries2, worker="w0", host="hA")
+    assert rreg.counter_value("serve.applies", worker="w0", host="hA") == 5.0
+    h2 = rreg.histogram_summary("serve.apply_seconds", worker="w0", host="hA")
+    assert h2["count"] == 2
+
+
+def test_metrics_delta_throttle_window():
+    wreg = metrics.MetricsRegistry()
+    tel = WorkerTelemetry(registry=wreg, min_metrics_interval_s=3600.0)
+    wreg.inc("serve.applies")
+    assert tel.metrics_entries() is not None  # first ship goes out
+    wreg.inc("serve.applies")
+    assert tel.metrics_entries() is None  # inside the window: held
+    assert tel.metrics_entries(min_interval_s=0.0) is not None  # override
+
+
+def test_capped_delta_export_ships_remainder_next_round():
+    """Baselines advance only for entries that made the cut — a capped
+    export loses nothing, it just ships the rest next time."""
+    wreg = metrics.MetricsRegistry()
+    tel = WorkerTelemetry(registry=wreg, max_entries=1)
+    wreg.inc("serve.a", 1.0)
+    wreg.inc("serve.b", 2.0)
+    first = tel.metrics_entries(min_interval_s=0.0)
+    second = tel.metrics_entries(min_interval_s=0.0)
+    assert len(first) == 1 and len(second) == 1
+    names = {e[1] for e in first} | {e[1] for e in second}
+    assert names == {"serve.a", "serve.b"}
+
+
+def test_merge_entries_skips_malformed_and_kind_conflicts():
+    rreg = metrics.MetricsRegistry()
+    rreg.inc("serve.x")  # counter; a gauge shipment for it must not raise
+    merged = rreg.merge_entries(
+        [
+            "not-a-list",
+            ["c", "serve.ok", [], 2.0],
+            ["g", "serve.x", [], 1.0],  # kind conflict: dropped
+            ["h", "serve.bad", [], {"bounds": "garbage"}],
+            ["?", "serve.unknown", [], 1.0],
+        ],
+        worker="w0",
+    )
+    assert merged == 1
+    assert rreg.counter_value("serve.ok", worker="w0") == 2.0
+
+
+# ---------------------------------------------------------- FleetTelemetry
+
+
+def _shipped(spans=None, t_rx=10.0 + SKEW + 0.001, t_tx=10.0 + SKEW + 0.003):
+    blob = {"t_rx": t_rx, "t_tx": t_tx}
+    if spans is not None:
+        blob["spans"] = spans
+    return blob
+
+
+def test_fleet_telemetry_stitches_batch_record_for_requestz():
+    rec = FlightRecorder()
+    reg = metrics.MetricsRegistry()
+    fleet = FleetTelemetry(registry=reg, recorder=rec)
+    rec.annotate("r1", "serve.replica", batch="b1", replica=0)
+    rec.batch("b1", ["r1"], replica=0, rows=1)
+    spans = [
+        {"name": "worker.attach", "t0": 10.0 + SKEW + 0.0012, "t1": 10.0 + SKEW + 0.0015},
+        {"name": "worker.apply", "t0": 10.0 + SKEW + 0.0015, "t1": 10.0 + SKEW + 0.0028, "attrs": {"n": 1}},
+    ]
+    fleet.on_exchange(
+        "net0", "hostA", 10.0, 10.004, _shipped(spans), trace={"batch": "b1"}
+    )
+    rec.finish("r1", "completed", batch="b1")
+    (b,) = rec.request("r1")["batch_records"]
+    assert b["worker"] == "net0" and b["host"] == "hostA"
+    assert b["wire"]["rtt_s"] == pytest.approx(0.002, abs=1e-6)
+    names = [s["name"] for s in b["worker_spans"]]
+    assert names == ["worker.attach", "worker.apply"]
+    for s in b["worker_spans"]:
+        assert s["seconds"] >= 0.0
+        assert 0.0 <= s["t_off"] <= 0.004
+    # the apply span also fed the labeled fleet series
+    h = reg.histogram_summary(
+        "serve.fleet.apply_seconds", worker="net0", host="hostA"
+    )
+    assert h is not None and h["count"] == 1
+    rtt = reg.histogram_summary(
+        "serve.fleet.wire_rtt_seconds", worker="net0", host="hostA"
+    )
+    assert rtt is not None and rtt["count"] == 1
+
+
+def test_fleet_telemetry_old_peer_is_a_silent_noop():
+    rec = FlightRecorder()
+    reg = metrics.MetricsRegistry()
+    fleet = FleetTelemetry(registry=reg, recorder=rec)
+    fleet.on_exchange("w0", None, 1.0, 2.0, None)  # old worker: no body
+    fleet.on_exchange("w0", None, 1.0, 2.0, "garbage")
+    fleet.on_exchange("w0", None, 1.0, 2.0, {"spans": "garbage", "t_rx": "x"})
+    fleet.on_beat("w0", None, None)
+    fleet.on_beat("w0", None, {"metrics": "garbage"})
+    assert fleet.known_workers() in ([], ["w0"])  # never raised
+    assert rec.stats()["live"] == 0
+
+
+def test_fleet_telemetry_without_recorder_still_aggregates():
+    reg = metrics.MetricsRegistry()
+    fleet = FleetTelemetry(registry=reg, recorder=None)
+    fleet.on_exchange(
+        "p0",
+        None,
+        10.0,
+        10.004,
+        {
+            **_shipped([{"name": "worker.apply", "t0": 10.0 + SKEW + 0.0015, "t1": 10.0 + SKEW + 0.0028}]),
+            "metrics": [["c", "serve.applies", [], 4.0]],
+        },
+        trace={"batch": "b9"},  # recorder off: stitching skipped, no error
+    )
+    assert reg.counter_value("serve.applies", worker="p0", host="local") == 4.0
+    h = reg.histogram_summary(
+        "serve.fleet.apply_seconds", worker="p0", host="local"
+    )
+    assert h is not None and h["count"] == 1
+
+
+def test_fleet_status_block_shape():
+    reg = metrics.MetricsRegistry()
+    fleet = FleetTelemetry(registry=reg, recorder=None)
+    fleet.on_exchange("net0", "hostA", 10.0, 10.004, _shipped(
+        [{"name": "worker.apply", "t0": 10.0 + SKEW + 0.001, "t1": 10.0 + SKEW + 0.003}]
+    ))
+    st = fleet.fleet_status()
+    entry = st["workers"]["net0"]
+    assert entry["host"] == "hostA"
+    assert entry["clock_samples"] == 1
+    assert entry["clock_offset_s"] == pytest.approx(SKEW, abs=1e-3)
+    assert entry["apply_ms"]["count"] == 1 and entry["apply_ms"]["p50"] is not None
+    assert entry["wire_rtt_ms"]["count"] == 1

@@ -576,3 +576,146 @@ def test_trace_report_from_ledger_with_span_links(tmp_path):
     seg = run_path + ".000001"
     os.rename(run_path, seg)
     assert trace_report.load(seg)["source"] == "ledger"
+
+
+# ------------------------------------------- durable trace dump (ISSUE 18)
+
+
+def test_tracez_dump_writes_durable_snapshot_trace_report_reads(tmp_path):
+    """POST /tracez/dump snapshots the recorder durably (atomic write +
+    checksum sidecar) in exactly the format tools/trace_report.py's
+    recorder mode parses."""
+    from keystone_tpu.utils import durable
+
+    import trace_report
+
+    with _service(max_batch=4, max_wait_ms=2.0) as svc:
+        with serve_http(
+            svc, port=0, trace_dump_dir=str(tmp_path)
+        ) as front:
+            base = f"http://127.0.0.1:{front.port}"
+            _post_json(
+                base + "/predict",
+                {"instance": [1.0] * DIM},
+                headers={"X-Request-Id": "dump-me"},
+            )
+            status, body, _ = _post_json(base + "/tracez/dump", {})
+            assert status == 200
+            path = body["path"]
+            assert os.path.dirname(path) == str(tmp_path)
+            assert path.endswith(".json")  # recorder-dump mode selector
+            assert body["stats"]["finished"] >= 1
+    assert durable.verify_checksum(path, required=True)
+    report = trace_report.summarize(trace_report.load(path))
+    assert report["source"] == "recorder"
+    rids = [r["request_id"] for r in report["top_slow"]]
+    assert "dump-me" in rids
+    # an explicit body dir overrides the configured one
+    with _service(max_batch=4, max_wait_ms=2.0) as svc:
+        with serve_http(svc, port=0) as front:
+            base = f"http://127.0.0.1:{front.port}"
+            _post_json(base + "/predict", {"instance": [1.0] * DIM})
+            override = str(tmp_path / "override")
+            status, body, _ = _post_json(
+                base + "/tracez/dump", {"dir": override}
+            )
+            assert status == 200
+            assert os.path.dirname(body["path"]) == override
+
+
+def test_tracez_dump_without_dir_or_recorder_is_409():
+    with _service(recorder=False) as svc:
+        with serve_http(svc, port=0) as front:
+            base = f"http://127.0.0.1:{front.port}"
+            code = None
+            try:
+                _post_json(base + "/tracez/dump", {})
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 409  # recorder off
+    with _service() as svc:
+        with serve_http(svc, port=0) as front:  # no trace_dump_dir
+            base = f"http://127.0.0.1:{front.port}"
+            code = None
+            try:
+                _post_json(base + "/tracez/dump", {})
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 409  # nowhere to write
+
+
+def test_trace_report_decomposes_cross_process_chain(tmp_path):
+    """trace_report folds the stitched batch-record fields (worker,
+    host, wire accounting, aligned worker spans) into the per-request
+    breakdown and a per-worker fleet rollup."""
+    import trace_report
+
+    dump = {
+        "traces": [
+            {
+                "request_id": "r1",
+                "ts": 100.0,
+                "outcome": "completed",
+                "slow": False,
+                "seconds": 0.02,
+                "events": [
+                    {
+                        "t": 0.002,
+                        "name": "serve.batch",
+                        "attrs": {
+                            "batch": "b1",
+                            "replica": 0,
+                            "queue_wait_seconds": 0.002,
+                        },
+                    }
+                ],
+            }
+        ],
+        "batches": [
+            {
+                "batch": "b1",
+                "rows": 2,
+                "bucket": 4,
+                "seconds": 0.01,
+                "worker": "net0",
+                "host": "hostA",
+                "wire": {"rtt_s": 0.0015, "send_s": 0.0006, "recv_s": 0.0004},
+                "worker_spans": [
+                    {"name": "worker.attach", "t_off": 0.001, "seconds": 0.0005},
+                    {"name": "worker.apply", "t_off": 0.0015, "seconds": 0.008},
+                ],
+            }
+        ],
+        "ops": [],
+    }
+    path = str(tmp_path / "dump.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(dump, f)
+    summary = trace_report.summarize(trace_report.load(path))
+    (r,) = summary["top_slow"]
+    assert r["worker"] == "net0" and r["host"] == "hostA"
+    assert r["wire_rtt_s"] == 0.0015
+    assert r["worker_apply_s"] == 0.008
+    assert summary["critical_path_mean"]["worker_apply_s"] == 0.008
+    assert summary["critical_path_mean"]["wire_rtt_s"] == 0.0015
+    fleet = summary["fleet"]["net0"]
+    assert fleet["host"] == "hostA" and fleet["flushes"] == 1
+    assert fleet["apply_s_mean"] == 0.008
+    text = trace_report.render(summary)
+    assert "worker net0@hostA" in text
+    assert "fleet (worker-shipped spans, stitched per flush):" in text
+
+
+def test_cli_trace_dump_refuses_no_recorder(tmp_path):
+    from keystone_tpu.cli import _serve_main
+
+    with pytest.raises(SystemExit):
+        _serve_main(
+            [
+                "--model",
+                str(tmp_path / "m.pkl"),
+                "--trace-dump",
+                str(tmp_path),
+                "--no-recorder",
+            ]
+        )

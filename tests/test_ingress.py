@@ -598,3 +598,48 @@ def test_stop_is_idempotent_and_unbinds():
         srv.stop()
         with pytest.raises(OSError):
             socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+# --------------------------------------- request-id parity (ISSUE 18)
+
+
+def test_binary_request_id_parity_with_http(served):
+    """KSBB has the HTTP front end's request-id contract: a supplied
+    ``request_id`` is honored (fanned out per row, exactly the HTTP
+    multi-instance rule), an absent one is minted server-side, and the
+    ids come back in success bodies AND typed refusals alike."""
+    svc, srv = served
+    with ing.BinaryClient("127.0.0.1", srv.port) as cli:
+        cli.predict(np.ones((2, DIM), np.float32), request_id="order-9")
+        assert cli.last_request_ids == ["order-9/0", "order-9/1"]
+        cli.predict(np.ones((1, DIM), np.float32), request_id="solo-1")
+        assert cli.last_request_ids == ["solo-1"]
+        cli.predict(np.ones((2, DIM), np.float32))
+        minted = cli.last_request_ids
+        assert len(minted) == 2 and all(minted)
+        # a typed refusal names the rows it refused
+        with pytest.raises(ing.IngressError) as ei:
+            cli.predict(
+                np.ones((2, DIM), np.float32),
+                deadline_ms=0.0001,
+                request_id="doomed-bin",
+            )
+        assert ei.value.kind == "deadline"
+        assert ei.value.request_ids == ["doomed-bin/0", "doomed-bin/1"]
+        # the ids enter the same /requestz loop as HTTP ids
+        if svc.recorder is not None:
+            assert svc.recorder.request("order-9/0") is not None
+
+
+def test_statusz_ingress_block_covers_binary_front_end(served):
+    svc, srv = served
+    with ing.BinaryClient("127.0.0.1", srv.port) as cli:
+        cli.predict(np.ones((2, DIM), np.float32))
+    blk = svc.status().get("ingress")
+    assert blk is not None
+    assert blk["accepts"] >= 1 and blk["bin_conns"] >= 1
+    assert blk["frames"] >= 1 and blk["batch_rows"] >= 2
+    assert isinstance(blk["frame_errors"], dict)
+    assert blk["parse_ms"] is None or blk["parse_ms"]["count"] >= 1
+    assert blk["admit_ms"] is None or blk["admit_ms"]["count"] >= 1
+    assert "bytes_copied" in blk

@@ -395,3 +395,29 @@ def test_socket_rule_ignores_lookalike_modules(sites):
     ):
         vs = _run(src, sites)
         assert not [v for v in vs if v.rule == "socket"], src
+
+
+def test_metric_fleet_label_rule_fires(sites):
+    """Fleet-scoped series (worker-shipped, ``fleet`` in the name) must
+    carry their fan-out as worker=/host= labels — a fleet series
+    without either silently aggregates every worker into one line."""
+    v = _run('metrics.observe("serve.fleet.apply_seconds", 0.1)', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    v = _run('metrics.inc("serve.fleet_exchanges")', sites)
+    assert [x.rule for x in v] == ["metric-name"]
+    # either label satisfies the rule
+    assert not _run(
+        'metrics.observe("serve.fleet.apply_seconds", 0.1, worker=w)', sites
+    )
+    assert not _run(
+        'metrics.inc("serve.fleet_exchanges", host=h)', sites
+    )
+    # non-fleet names with "fleet" as a word fragment are untouched
+    assert not _run('metrics.inc("serve.fleetingly")', sites)
+    assert not _run('metrics.set_gauge("serve.workers", 2)', sites)
+    # escape hatch stays available, visibly
+    assert not _run(
+        'metrics.observe("serve.fleet.x", 1.0)  '
+        "# lint: allow-metric-name",
+        sites,
+    )

@@ -848,6 +848,160 @@ def test_drain_ready_preserves_stashed_payload_bytes():
         _close_all(a, b)
 
 
+# ------------------------------------------- fleet telemetry over the wire
+
+
+def _doubling_worker(sock, telemetry=None):
+    """A _FakeWorker that doubles its input; ``telemetry`` (a callable
+    returning the reply's telemetry body) makes it a NEW-protocol
+    worker, None keeps it an OLD one (no telemetry keys anywhere)."""
+
+    def on_apply(msg, payload):
+        t_rx = time.monotonic()
+        arr = wire.payload_array(msg["meta"], payload)
+        rmeta, rp = wire.array_payload(arr * 2.0)
+        reply = {"op": "result", "fid": msg["fid"], "meta": rmeta}
+        if telemetry is not None:
+            reply["telemetry"] = telemetry(t_rx)
+        return reply, rp
+
+    return _FakeWorker(sock, on_apply=on_apply, beat_interval=0.1)
+
+
+def test_apply_frame_carries_trace_only_when_given():
+    """The recorder-off wire pin at frame granularity: without trace
+    context the apply frame has EXACTLY the pre-tracing keys (an old
+    worker sees the old protocol, byte-for-byte); with context the
+    ``trace`` body rides along verbatim."""
+    router, worker = _tcp_pair()
+    fw = _doubling_worker(worker)
+    try:
+        h = net.NetWorkerHandle(
+            "t", 0, router, {"name": "fw", "pid": 1},
+            b"gen", lease_s=2.0, ready_timeout=5.0,
+        )
+        try:
+            h.apply(_rows(2, seed=0), 2)
+            ctx = {"batch": "b1", "request_ids": ["r1", "r2"]}
+            h.apply(_rows(2, seed=1), 2, trace=ctx)
+            applies = [f for f in fw.frames if f.get("op") == "apply"]
+            assert len(applies) == 2
+            assert "trace" not in applies[0]
+            assert set(applies[0]) == {"op", "fid", "n", "meta", "deadline_s"}
+            assert applies[1]["trace"] == ctx
+        finally:
+            h.shutdown(timeout=1.0)
+    finally:
+        fw.close()
+        _close_all(router)
+
+
+def test_old_worker_without_telemetry_is_tolerated():
+    """Version skew, worker-side: a worker that never ships telemetry
+    (no keys in ready/replies/beats) serves normally and the attached
+    sink simply records nothing — absent field means old peer."""
+    from keystone_tpu.serve.telemetry import FleetTelemetry
+
+    router, worker = _tcp_pair()
+    fw = _doubling_worker(worker)
+    try:
+        h = net.NetWorkerHandle(
+            "t", 0, router, {"name": "fw", "pid": 1},
+            b"gen", lease_s=2.0, ready_timeout=5.0,
+        )
+        try:
+            sink = FleetTelemetry(registry=metrics.MetricsRegistry())
+            h.attach_telemetry(sink)
+            arr = _rows(3, seed=2)
+            out = h.apply(arr, 3, trace={"batch": "bX"})
+            assert out.tobytes() == (arr * 2.0).tobytes()
+            assert sink.known_workers() == []
+        finally:
+            h.shutdown(timeout=1.0)
+    finally:
+        fw.close()
+        _close_all(router)
+
+
+def test_worker_shipped_telemetry_stitches_and_aggregates():
+    """The full return path over a real socket: ready-frame metrics
+    flush on attach, reply spans stitch into the traced flush's batch
+    record, and a beat-piggybacked delta lands in the registry under
+    worker=/host= labels."""
+    from keystone_tpu.obs.recorder import FlightRecorder
+    from keystone_tpu.serve.telemetry import FleetTelemetry
+
+    router, worker = _tcp_pair()
+
+    def reply_telemetry(t_rx):
+        now = time.monotonic()
+        return {
+            "t_rx": t_rx,
+            "t_tx": now,
+            "spans": [{"name": "worker.apply", "t0": t_rx, "t1": now}],
+        }
+
+    fw = _doubling_worker(worker, telemetry=reply_telemetry)
+    try:
+        h = net.NetWorkerHandle(
+            "t", 0, router, {"name": "fw", "pid": 1, "host": "fakehost"},
+            b"gen", lease_s=2.0, ready_timeout=5.0,
+        )
+        try:
+            reg = metrics.MetricsRegistry()
+            rec = FlightRecorder()
+            sink = FleetTelemetry(registry=reg, recorder=rec)
+            h.attach_telemetry(sink)
+            rec.annotate("r1", "serve.replica", batch="b1", replica=0)
+            rec.batch("b1", ["r1"], replica=0, rows=2)
+            arr = _rows(2, seed=4)
+            out = h.apply(arr, 2, trace={"batch": "b1", "request_ids": ["r1"]})
+            assert out.tobytes() == (arr * 2.0).tobytes()
+            # the reply's spans were aligned + stitched into the record
+            assert sink.known_workers() == ["t-net0"]
+            rec.finish("r1", "completed", batch="b1")
+            (b,) = rec.request("r1")["batch_records"]
+            assert b["worker"] == "t-net0" and b["host"] == "fakehost"
+            assert b["wire"]["rtt_s"] is not None and b["wire"]["rtt_s"] >= 0.0
+            names = [s["name"] for s in b["worker_spans"]]
+            assert "worker.apply" in names
+            for s in b["worker_spans"]:
+                assert s["seconds"] >= 0.0 and s["t_off"] >= 0.0
+            assert (
+                reg.histogram_summary(
+                    "serve.fleet.apply_seconds", worker="t-net0", host="fakehost"
+                )["count"]
+                == 1
+            )
+            # a beat-piggybacked metrics delta merges under the labels
+            fw.send(
+                {
+                    "op": "beat",
+                    "telemetry": {
+                        "metrics": [["c", "serve.fake_beat_total", [], 3.0]]
+                    },
+                }
+            )
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if reg.counter_value(
+                    "serve.fake_beat_total", worker="t-net0", host="fakehost"
+                ):
+                    break
+                time.sleep(0.02)
+            assert (
+                reg.counter_value(
+                    "serve.fake_beat_total", worker="t-net0", host="fakehost"
+                )
+                == 3.0
+            )
+        finally:
+            h.shutdown(timeout=1.0)
+    finally:
+        fw.close()
+        _close_all(router)
+
+
 # --------------------------------------------------- live TCP fleet e2e
 @pytest.fixture(scope="module")
 def net_service():
@@ -963,3 +1117,41 @@ def test_partition_mid_flight_loses_nothing_and_heals(net_service):
         time.sleep(0.25)
     else:
         pytest.fail("fleet did not heal back to 2 live workers within 60s")
+
+
+def test_net_fleet_aggregates_metrics_and_stitches_trace(net_service):
+    """E2E acceptance, TCP edition: with two leased workers, the
+    router's ops surface covers the whole fleet — worker-shipped
+    series land in the registry under worker=/host= labels, /statusz
+    grows a fleet block with clock-sync state for BOTH workers, and a
+    traced request's /requestz chain crosses the wire (stitched
+    worker@host, wire accounting, aligned worker.apply span)."""
+    rid = "net-trace-e2e"
+    x = _rows(16, seed=13)
+    futs = [net_service.submit(x[0], request_id=rid)]
+    futs += [net_service.submit(r) for r in x[1:]]
+    for f in futs:
+        f.result(timeout=120)
+    # the deploy→ready exchange gave every worker a clock sample, so
+    # the fleet block lists both slots even before both serve a flush
+    fleet = net_service.status().get("fleet")
+    assert fleet is not None
+    assert set(fleet["workers"]) == {"netfleet_t-net0", "netfleet_t-net1"}
+    for entry in fleet["workers"].values():
+        assert entry["host"]
+        assert entry["clock_samples"] >= 1
+    series = metrics.REGISTRY.histogram_series("serve.fleet.apply_seconds")
+    assert series, "no worker-shipped apply series reached the registry"
+    assert all(lb.get("worker") and lb.get("host") for lb, _ in series)
+    net_workers = [
+        lb["worker"] for lb, _ in series if lb["worker"].startswith("netfleet_t-")
+    ]
+    assert net_workers, f"no net-fleet series in {series}"
+    tr = net_service.recorder.request(rid)
+    assert tr is not None
+    stitched = [b for b in tr["batch_records"] if b.get("worker")]
+    assert stitched, f"unstitched batch records: {tr['batch_records']}"
+    b = stitched[0]
+    assert b["worker"].startswith("netfleet_t-net") and b.get("host")
+    assert "wire" in b
+    assert "worker.apply" in [s["name"] for s in b.get("worker_spans", [])]

@@ -546,3 +546,43 @@ def test_windowed_histogram_percentiles_and_fraction():
     assert 0.1 <= frac <= 0.3  # 1 of 5 samples above 10 ms
     s = wh.summary()
     assert s["count"] == 5 and s["max"] == 0.100
+
+
+def test_obs_report_covers_ingress_and_fleet_sections(tmp_path):
+    """ISSUE 18: the offline report folds the front-end ingress block
+    and the worker-shipped fleet series out of a metrics snapshot —
+    per-label lines, never aggregated across workers."""
+    led = ledger.start_run(str(tmp_path))
+    reg = metrics.REGISTRY
+    reg.inc("ingress.accepts", 3)
+    reg.inc("ingress.bin_conns", 2)
+    reg.inc("ingress.frames", 5)
+    reg.inc("ingress.batch_rows", 40)
+    reg.inc("ingress.frame_errors", 2, kind="magic")
+    reg.observe("ingress.parse_seconds", 0.001)
+    reg.observe("ingress.admit_seconds", 0.002)
+    reg.observe(
+        "serve.fleet.apply_seconds", 0.004, worker="w0", host="hA"
+    )
+    reg.observe(
+        "serve.fleet.wire_rtt_seconds", 0.001, worker="w0", host="hA"
+    )
+    led.metrics_snapshot()
+    path = led.path
+    ledger.stop_run()
+
+    from obs_report import render, summarize
+
+    summary = summarize(path)
+    ing = summary["ingress"]
+    assert ing["accepts"] >= 3 and ing["bin_conns"] >= 2
+    assert ing["frame_errors"].get("magic", 0) >= 2
+    assert ing["parse_seconds"]["count"] >= 1
+    fleet = summary["fleet"]
+    apply_series = fleet["apply_seconds"]
+    assert any("worker=w0" in k and "host=hA" in k for k in apply_series)
+    assert any("worker=w0" in k for k in fleet["wire_rtt_seconds"])
+    text = render(summary)
+    assert "== ingress ==" in text
+    assert "== fleet (worker-shipped) ==" in text
+    assert "worker=w0" in text

@@ -286,3 +286,69 @@ def test_workers_and_replicas_are_exclusive():
 
     with pytest.raises(ValueError):
         serve(_pipeline(), workers=2, replicas=2)
+
+
+# ------------------------------------------- fleet telemetry (ISSUE 18)
+
+
+def test_apply_frame_trace_key_and_slab_ref_pins(monkeypatch):
+    """Frame-level byte pins: without trace context the apply control
+    frame has EXACTLY the pre-tracing keys (recorder-off wire is
+    unchanged), and the slab-ref fast path still ships the CALLER's
+    reference — telemetry added zero copies to zero-copy dispatch."""
+    from keystone_tpu.serve import procfleet as pf
+
+    h = object.__new__(pf.WorkerHandle)
+    h.name = "pin"
+    h._lock = threading.Lock()
+    h._closed = False
+    h._conn = object()
+    h._pool = None
+    h.telemetry = None
+    sent = []
+    monkeypatch.setattr(pf.wire, "send_frame", lambda conn, m: sent.append(m))
+    monkeypatch.setattr(pf.wire, "recv_frame", lambda conn: {"op": "pong"})
+    ref = {"slab": "s0", "count": 2}
+    h.apply(None, 2, slab_ref=ref)
+    assert set(sent[0]) == {"op", "n", "deadline_s", "ref"}
+    assert sent[0]["ref"] is ref
+    ctx = {"batch": "b1", "request_ids": ["r1"]}
+    h.apply(None, 2, slab_ref=ref, trace=ctx)
+    assert sent[1]["trace"] == ctx
+    assert set(sent[1]) == {"op", "n", "deadline_s", "ref", "trace"}
+
+
+def test_process_fleet_stitches_cross_process_trace(proc_service):
+    """E2E acceptance: a traced request served by a spawned worker
+    process shows the TRUE cross-process chain on /requestz — the
+    stitched batch record names the worker and host and carries the
+    worker-side apply span aligned to the router clock (non-negative,
+    inside the exchange window)."""
+    rid = "proc-trace-e2e"
+    x = _rows(4, seed=21)
+    futs = [proc_service.submit(x[0], request_id=rid)]
+    futs += [proc_service.submit(r) for r in x[1:]]
+    for f in futs:
+        f.result(timeout=60)
+    rec = proc_service.recorder
+    assert rec is not None
+    tr = rec.request(rid)
+    assert tr is not None and tr["batch_records"]
+    stitched = [b for b in tr["batch_records"] if b.get("worker")]
+    assert stitched, f"unstitched batch records: {tr['batch_records']}"
+    b = stitched[0]
+    assert b.get("host")
+    assert "wire" in b
+    names = [s["name"] for s in b.get("worker_spans", [])]
+    assert "worker.apply" in names
+    for s in b["worker_spans"]:
+        assert s["seconds"] >= 0.0 and s["t_off"] >= 0.0
+    # the ops surface sees the fleet: /statusz fleet block + labeled
+    # series in the router registry
+    st = proc_service.status()
+    assert st.get("fleet", {}).get("workers")
+    from keystone_tpu.obs import metrics
+
+    series = metrics.REGISTRY.histogram_series("serve.fleet.apply_seconds")
+    assert series
+    assert all(lb.get("worker") and lb.get("host") for lb, _ in series)

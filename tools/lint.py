@@ -128,6 +128,13 @@ METRIC_INDEX_SEGMENT_RE = re.compile(r"(^|_)\d+(_|$)")
 #: mints/merges series per tenant and fragments every dashboard
 METRIC_TENANT_WORD_RE = re.compile(r"(^|[._])tenants?(_|$|\.)")
 
+#: fleet-scoped metric names (``serve.fleet.apply_seconds``): series
+#: aggregated from worker-shipped telemetry span every worker and host
+#: in the fleet, so a write without ``worker=``/``host=`` labels merges
+#: every peer into one indistinguishable series — the per-worker
+#: breakdown is the entire point of shipping them
+METRIC_FLEET_WORD_RE = re.compile(r"(^|[._])fleet(_|$|\.)")
+
 #: metrics-registry write methods → instrument kind
 _METRIC_KINDS = {
     "inc": "counter",
@@ -636,6 +643,27 @@ def lint_source(
                             "without a tenant= label — per-tenant "
                             "fan-out rides {tenant=} labels, never the "
                             "metric name",
+                        )
+                    )
+                elif (
+                    METRIC_FLEET_WORD_RE.search(mname)
+                    and recv[1] != "remove_gauge"
+                    and not any(
+                        kw.arg in ("worker", "host")
+                        for kw in node.keywords
+                    )
+                    and not _allowed(lines, lineno, "metric-name")
+                ):
+                    out.append(
+                        Violation(
+                            rel_path,
+                            lineno,
+                            "metric-name",
+                            f"fleet-scoped metric {mname!r} recorded "
+                            "without a worker=/host= label — worker-"
+                            "shipped series carry their fan-out as "
+                            "{worker=,host=} labels, never the metric "
+                            "name",
                         )
                     )
                 kind = _METRIC_KINDS[recv[1]]

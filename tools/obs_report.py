@@ -20,6 +20,12 @@ Sections (each only when the run recorded it):
 - **io**: blockstore bytes read/written, durable corruption/fallback,
   stream batch latency, from the run's last metrics snapshot;
 - **memory**: HBM and host-RSS watermarks;
+- **ingress**: front-end health — accepts, binary/HTTP connection
+  split, frames, per-kind frame errors, parse/admit time, and the
+  bytes-copied counter that IS the zero-copy claim;
+- **fleet**: worker-shipped telemetry aggregated at the router —
+  per-worker/per-host apply and wire-RTT series plus
+  retransmit/late-discard counts;
 - **faults**: per-site injected counts (chaos runs).
 
 ``summarize()`` / ``render()`` are importable — bench.py embeds the
@@ -72,8 +78,9 @@ def _counter_total(snapshot: dict, name: str) -> float:
     )
 
 
-def _fault_sites(snapshot: dict, name: str) -> Dict[str, float]:
-    """``faults.injected{site=x}`` counters → {site: count}."""
+def _label_totals(snapshot: dict, name: str, label: str) -> Dict[str, float]:
+    """``name{...label=x...}`` counters → {x: total} (summed across the
+    other labels)."""
     out: Dict[str, float] = {}
     for k, v in (snapshot.get("counters") or {}).items():
         if not k.startswith(name + "{"):
@@ -81,9 +88,14 @@ def _fault_sites(snapshot: dict, name: str) -> Dict[str, float]:
         labels = k[len(name) + 1 : -1]
         for part in labels.split(","):
             lk, _, lv = part.partition("=")
-            if lk == "site":
+            if lk == label:
                 out[lv] = out.get(lv, 0.0) + v
     return out
+
+
+def _fault_sites(snapshot: dict, name: str) -> Dict[str, float]:
+    """``faults.injected{site=x}`` counters → {site: count}."""
+    return _label_totals(snapshot, name, "site")
 
 
 def summarize(path: str, top_k: int = 10) -> dict:
@@ -228,6 +240,71 @@ def summarize(path: str, top_k: int = 10) -> dict:
     ) and not any(p["count"] for p in artifacts["prime"].values()):
         artifacts = None
 
+    # ------------------------------------------------------------ ingress
+    # the front-end block (serve/ingress.py + serve/http.py): only when
+    # the run actually served connections
+    ingress = {
+        "accepts": int(_counter_total(snapshot, "ingress.accepts")),
+        "bin_conns": int(_counter_total(snapshot, "ingress.bin_conns")),
+        "http_conns": int(_counter_total(snapshot, "ingress.http_conns")),
+        "frames": int(_counter_total(snapshot, "ingress.frames")),
+        "batch_rows": int(_counter_total(snapshot, "ingress.batch_rows")),
+        "bytes_copied": _counter_total(snapshot, "ingress.bytes_copied"),
+        "frame_errors": {
+            k: int(v)
+            for k, v in sorted(
+                _label_totals(snapshot, "ingress.frame_errors", "kind").items()
+            )
+        },
+        "parse_seconds": _hist("ingress.parse_seconds"),
+        "admit_seconds": _hist("ingress.admit_seconds"),
+    }
+    if not (
+        ingress["accepts"]
+        or ingress["bin_conns"]
+        or ingress["http_conns"]
+        or ingress["frames"]
+    ):
+        ingress = None
+
+    # -------------------------------------------------------------- fleet
+    # worker-shipped telemetry aggregated into the router registry
+    # (serve/telemetry.py): per-worker/per-host series keyed by their
+    # label string, plus the wire-health counters from serve/net.py
+    def _hist_series(name: str) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for k, h in hists.items():
+            if k != name and not k.startswith(name + "{"):
+                continue
+            labels = k[len(name) + 1 : -1] if k != name else ""
+            out[labels] = {
+                "count": int(h.get("count") or 0),
+                "seconds": float(h.get("sum") or 0.0),
+                "max": h.get("max"),
+            }
+        return out
+
+    fleet = {
+        "apply_seconds": _hist_series("serve.fleet.apply_seconds"),
+        "wire_rtt_seconds": _hist_series("serve.fleet.wire_rtt_seconds"),
+        "retransmits": {
+            k: int(v)
+            for k, v in sorted(
+                _label_totals(snapshot, "serve.net.retransmits", "worker").items()
+            )
+        },
+        "late_discards": {
+            k: int(v)
+            for k, v in sorted(
+                _label_totals(
+                    snapshot, "serve.net.late_discards", "worker"
+                ).items()
+            )
+        },
+    }
+    if not (fleet["apply_seconds"] or fleet["wire_rtt_seconds"]):
+        fleet = None
+
     # ------------------------------------------------------------ faults
     faults: Dict[str, dict] = {}
     injected = _fault_sites(snapshot, "faults.injected")
@@ -267,6 +344,8 @@ def summarize(path: str, top_k: int = 10) -> dict:
         "memory": memory,
         "dataflow": dataflow,
         "artifacts": artifacts,
+        "ingress": ingress,
+        "fleet": fleet,
         "faults": faults,
         "fault_restarts": fault_events,
     }
@@ -398,6 +477,52 @@ def render(summary: dict) -> str:
                 out.append(
                     f"  prime[{src}]: n={p['count']} "
                     f"total={p['seconds']:.3f}s"
+                )
+
+    ing = summary.get("ingress")
+    if ing:
+        out.append("\n== ingress ==")
+        out.append(
+            f"  accepts: {ing['accepts']}  "
+            f"(binary {ing['bin_conns']}, http {ing['http_conns']})"
+        )
+        out.append(
+            f"  frames: {ing['frames']}  rows: {ing['batch_rows']}  "
+            f"bytes copied: {_fmt_bytes(ing.get('bytes_copied'))}"
+        )
+        for name in ("parse_seconds", "admit_seconds"):
+            h = ing.get(name) or {}
+            if h.get("count"):
+                mean = h["seconds"] / h["count"]
+                out.append(
+                    f"  {name}: n={h['count']} mean={mean * 1e3:.3f}ms"
+                )
+        if ing.get("frame_errors"):
+            errs = ", ".join(
+                f"{k}={v}" for k, v in ing["frame_errors"].items()
+            )
+            out.append(f"  frame errors: {errs}")
+
+    fl = summary.get("fleet")
+    if fl:
+        out.append("\n== fleet (worker-shipped) ==")
+        for name in ("apply_seconds", "wire_rtt_seconds"):
+            for labels, h in sorted((fl.get(name) or {}).items()):
+                if not h.get("count"):
+                    continue
+                mean = h["seconds"] / h["count"]
+                mx = h.get("max")
+                out.append(
+                    f"  {name}{{{labels}}}: n={h['count']} "
+                    f"mean={mean * 1e3:.2f}ms"
+                    + (f" max={mx * 1e3:.2f}ms" if mx is not None else "")
+                )
+        for name in ("retransmits", "late_discards"):
+            series = fl.get(name) or {}
+            if any(series.values()):
+                out.append(
+                    f"  {name}: "
+                    + ", ".join(f"{k}={v}" for k, v in series.items())
                 )
 
     faults = summary.get("faults") or {}

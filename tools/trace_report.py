@@ -11,7 +11,11 @@ Inputs (auto-detected):
   carries its event offsets, so the report decomposes each request's
   latency into **queue wait** (enqueue → flush start) vs **apply**
   (device time, from the batch record) vs **fan-out** (apply end →
-  terminal), plus the padding waste (``bucket - rows``).
+  terminal), plus the padding waste (``bucket - rows``).  When the
+  fleet telemetry stitched worker-shipped spans into a batch record,
+  the report also shows the cross-process chain: which
+  ``worker@host`` applied the flush, the exchange's wire RTT, and the
+  worker-clock ``worker.apply`` span aligned to the router timeline.
 - a **ledger file** — a ``run_<id>.jsonl`` written with the JSONL
   ledger active (``KEYSTONE_OBS_DIR``): ``serve.request`` events carry
   each request's outcome/latency/queue-wait and ``serve.batch``
@@ -66,6 +70,38 @@ def _first_event(trace: dict, name: str) -> Optional[dict]:
     return None
 
 
+def _fleet_from_batch(b: Optional[dict]) -> dict:
+    """Pull the worker-shipped stitching (``FleetTelemetry._ingest``'s
+    ``batch_update``) out of a batch record: who applied it, the wire
+    accounting around the exchange, and the router-aligned worker
+    spans.  Absent for local-replica flushes and pre-fleet dumps — every
+    field degrades to ``None`` so old dumps render unchanged."""
+    wire = (b or {}).get("wire") or {}
+    spans = (b or {}).get("worker_spans") or []
+    worker_apply = None
+    for sp in spans:
+        if isinstance(sp, dict) and sp.get("name") == "worker.apply":
+            worker_apply = sp.get("seconds")
+            break
+    return {
+        "worker": (b or {}).get("worker"),
+        "host": (b or {}).get("host"),
+        "wire_rtt_s": wire.get("rtt_s"),
+        "wire_send_s": wire.get("send_s"),
+        "wire_recv_s": wire.get("recv_s"),
+        "worker_apply_s": worker_apply,
+        "worker_spans": [
+            {
+                "name": sp.get("name"),
+                "t_off": sp.get("t_off"),
+                "seconds": sp.get("seconds"),
+            }
+            for sp in spans
+            if isinstance(sp, dict)
+        ],
+    }
+
+
 def _breakdown_from_trace(trace: dict, batches: Dict[str, dict]) -> Optional[dict]:
     rid = trace.get("request_id")
     if rid is None:
@@ -95,6 +131,7 @@ def _breakdown_from_trace(trace: dict, batches: Dict[str, dict]) -> Optional[dic
         "replica": attrs.get("replica"),
         "batch": bid,
         "pad_rows": pad_rows,
+        **_fleet_from_batch(b),
         "events": [e.get("name") for e in trace.get("events", [])],
     }
 
@@ -130,6 +167,7 @@ def load_ledger(path: str) -> dict:
                         "replica": attrs.get("replica"),
                         "batch": attrs.get("batch"),
                         "pad_rows": None,
+                        **_fleet_from_batch(None),
                         "events": [],
                         "error": attrs.get("error"),
                     }
@@ -202,11 +240,40 @@ def summarize(data: dict, top: int = 10, timeline: int = 25) -> dict:
     critical = {
         "queue_wait_s": _mean([r["queue_wait_s"] for r in finished]),
         "apply_s": _mean([r["apply_s"] for r in finished]),
+        "worker_apply_s": _mean([r.get("worker_apply_s") for r in finished]),
+        "wire_rtt_s": _mean([r.get("wire_rtt_s") for r in finished]),
         "fanout_s": _mean([r["fanout_s"] for r in finished]),
         "pad_rows": _mean(
             [r["pad_rows"] for r in finished if r["pad_rows"] is not None]
         ),
         "seconds": _mean([r["seconds"] for r in finished]),
+    }
+    # per-worker rollup of the stitched exchanges: batch records carry
+    # the shipping, so aggregate over batches (one entry per flush) to
+    # avoid multiply-counting a flush once per rider
+    workers: Dict[str, dict] = {}
+    for b in data["batches"].values():
+        w = b.get("worker")
+        if w is None:
+            continue
+        f = _fleet_from_batch(b)
+        agg = workers.setdefault(
+            str(w),
+            {"host": f["host"], "flushes": 0, "apply_s": [], "rtt_s": []},
+        )
+        agg["flushes"] += 1
+        if f["worker_apply_s"] is not None:
+            agg["apply_s"].append(f["worker_apply_s"])
+        if f["wire_rtt_s"] is not None:
+            agg["rtt_s"].append(f["wire_rtt_s"])
+    fleet = {
+        w: {
+            "host": agg["host"],
+            "flushes": agg["flushes"],
+            "apply_s_mean": _mean(agg["apply_s"]),
+            "wire_rtt_s_mean": _mean(agg["rtt_s"]),
+        }
+        for w, agg in sorted(workers.items())
     }
     timelines: Dict[str, List[dict]] = {}
     for b in sorted(data["batches"].values(), key=lambda b: b.get("ts") or 0):
@@ -231,6 +298,7 @@ def summarize(data: dict, top: int = 10, timeline: int = 25) -> dict:
         "top_slow": [
             {k: v for k, v in r.items() if k != "events"} for r in top_slow
         ],
+        "fleet": fleet,
         "replica_timelines": timelines,
         "ops": data["ops"][-max(1, top):],
     }
@@ -252,13 +320,29 @@ def render(summary: dict) -> str:
         f"top {len(summary['top_slow'])} slow requests:",
     ]
     for r in summary["top_slow"]:
-        lines.append(
+        line = (
             f"  {r['request_id']}: {ms(r['seconds'])} "
             f"[{r['outcome']}] queue {ms(r['queue_wait_s'])} "
             f"apply {ms(r['apply_s'])} fanout {ms(r['fanout_s'])} "
             f"replica {r['replica']} batch {r['batch']}"
         )
+        if r.get("worker") is not None:
+            line += (
+                f" | worker {r['worker']}@{r.get('host')}"
+                f" wire {ms(r.get('wire_rtt_s'))}"
+                f" worker-apply {ms(r.get('worker_apply_s'))}"
+            )
+        lines.append(line)
     lines.append("")
+    if summary.get("fleet"):
+        lines.append("fleet (worker-shipped spans, stitched per flush):")
+        for w, agg in summary["fleet"].items():
+            lines.append(
+                f"  {w}@{agg['host']}: flushes {agg['flushes']} "
+                f"apply {ms(agg['apply_s_mean'])} "
+                f"wire rtt {ms(agg['wire_rtt_s_mean'])}"
+            )
+        lines.append("")
     for rep, tl in sorted(summary["replica_timelines"].items()):
         lines.append(f"replica {rep} timeline (last {len(tl)} flushes):")
         for b in tl:
