@@ -195,6 +195,33 @@ def _serve_main(argv) -> int:
         "(burn rate = windowed bad fraction / (1 - target))",
     )
     ap.add_argument(
+        "--slo-window-s",
+        type=float,
+        default=None,
+        help="sliding window the SLO burn rate (and rollout guardrails) "
+        "measure over (default 60s): shorter windows react faster but "
+        "judge canaries on fewer samples",
+    )
+    ap.add_argument(
+        "--canary",
+        type=float,
+        default=None,
+        metavar="FRACTION",
+        help="guarded rollouts (serve/rollout.py): --watch swaps stage "
+        "the new version to this fraction of traffic (seeded hash of "
+        "request id — replayable), judge it against the SLO-burn/error-"
+        "rate/p99 guardrails, then auto-commit or roll back and "
+        "quarantine the version.  Requires --watch.",
+    )
+    ap.add_argument(
+        "--bake-s",
+        type=float,
+        default=0.0,
+        help="post-commit bake: watch the SLO burn this long after a "
+        "canary commit and auto-revert to the prior version on "
+        "sustained violation (0 = off; needs --canary)",
+    )
+    ap.add_argument(
         "--no-recorder",
         action="store_true",
         help="disable the in-memory flight recorder (request tracing; "
@@ -328,6 +355,10 @@ def _serve_main(argv) -> int:
     if args.trace_dump and args.no_recorder:
         ap.error("--trace-dump needs the flight recorder; drop "
                  "--no-recorder")
+    if args.canary is not None and args.watch is None:
+        ap.error("--canary guards --watch swaps; add --watch SECONDS")
+    if args.bake_s and args.canary is None:
+        ap.error("--bake-s needs --canary")
     fleet_kw = (
         dict(workers=args.workers)
         if args.workers
@@ -361,6 +392,7 @@ def _serve_main(argv) -> int:
         hedge_ms=args.hedge_ms,
         bisect=not args.no_bisect,
         autoscale=autoscale,
+        slo_window_s=args.slo_window_s,
     )
     registry = None
     artifacts = None
@@ -420,8 +452,15 @@ def _serve_main(argv) -> int:
     if args.watch is not None:
         from keystone_tpu.serve import RegistryWatcher
 
+        rollout_cfg = None
+        if args.canary is not None:
+            from keystone_tpu.serve import RolloutConfig
+
+            rollout_cfg = RolloutConfig(
+                canary=args.canary, bake_s=args.bake_s
+            )
         watcher = RegistryWatcher(
-            svc, registry, poll_seconds=args.watch
+            svc, registry, poll_seconds=args.watch, rollout=rollout_cfg
         ).start()
     front = HttpFrontend(
         svc,

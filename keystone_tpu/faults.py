@@ -26,6 +26,11 @@ Named **sites** are threaded through the codebase::
                         flushed tenant with ctx ``tenant=NAME`` — a
                         tenant-targeted fault fails that tenant's
                         riders only, co-tenants deliver
+    serve.rollout       guarded rollout episode (serve/rollout.py) —
+                        fires before the canary generation stages, so
+                        ``raise`` fails the episode with the old
+                        generation untouched (the ``serve.swap``
+                        contract for guarded swaps)
     serve.worker        serve replica worker loop, per popped flush —
                         ``raise`` CRASHES the worker thread (the
                         in-hand flush is requeued for the supervisor's
@@ -111,6 +116,7 @@ SITES = {
     "serve.batch",
     "serve.replica",
     "serve.swap",
+    "serve.rollout",
     "serve.worker",
     "serve.artifact_load",
     "serve.net.connect",

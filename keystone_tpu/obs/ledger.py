@@ -80,6 +80,7 @@ ATTR_VOCABULARY = {
     "budget_bytes",
     "budget_seconds",
     "cache_hits",
+    "canary_fraction",
     "checkpoint_save_seconds",
     "chunk_seconds",
     "degraded",
@@ -90,6 +91,7 @@ ATTR_VOCABULARY = {
     "failed_attempt_seconds",
     "from_state",
     "from_replica",
+    "from_version",
     "grad_norm",
     "host",
     "instances",
@@ -138,6 +140,8 @@ ATTR_VOCABULARY = {
     "tenants",
     "to_state",
     "to_replica",
+    "to_version",
+    "verdict",
     "version",
     "waited_seconds",
     "wire",

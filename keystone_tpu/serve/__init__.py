@@ -47,6 +47,12 @@ from keystone_tpu.serve.registry import (  # noqa: F401
     RegistryError,
     RegistryWatcher,
 )
+from keystone_tpu.serve.rollout import (  # noqa: F401
+    CanaryController,
+    RollbackGuard,
+    RolloutConfig,
+    guarded_swap,
+)
 from keystone_tpu.serve.service import (  # noqa: F401
     Overloaded,
     PipelineService,
@@ -73,6 +79,7 @@ __all__ = [
     "AutoscalePolicy",
     "Autoscaler",
     "BinaryClient",
+    "CanaryController",
     "ClockSync",
     "ConnectRetriesExhausted",
     "FleetTelemetry",
@@ -99,11 +106,14 @@ __all__ = [
     "ReplicaSupervisor",
     "RegistryError",
     "RegistryWatcher",
+    "RollbackGuard",
+    "RolloutConfig",
     "ServiceClosed",
     "UnknownTenant",
     "WorkerTelemetry",
     "clamp_span",
     "default_buckets",
+    "guarded_swap",
     "run_worker",
     "serve",
     "serve_http",

@@ -1017,6 +1017,80 @@ class ReplicaPool:
             chosen.enqueue(batch)
         return chosen
 
+    def dispatch_staged(self, batch, staged) -> Optional[Replica]:
+        """Best-effort dispatch onto a STAGED generation (the canary
+        split — serve/rollout.py): least-outstanding routable replica
+        among ``staged`` with window headroom and an admitting breaker.
+        Never blocks and never raises — returns None when no staged
+        replica can take the batch (the caller serves it on the live
+        generation instead; a canary must degrade to live traffic, not
+        stall the batcher).  No ``serve.replica_outstanding`` gauge
+        write: staged indices shadow live ones, and a staged enqueue
+        overwriting the live replica's gauge would corrupt the series
+        mid-canary (``complete`` skips the gauge for non-pool replicas
+        for the same reason)."""
+        with self._cond:
+            if self._draining:
+                return None
+            cands = sorted(
+                (
+                    r
+                    for r in staged
+                    if r.routable() and r.outstanding < self._window
+                ),
+                key=lambda r: (r.outstanding, r.index),
+            )
+            chosen = None
+            for r in cands:
+                if r.breaker.allow():
+                    chosen = r
+                    break
+            if chosen is None:
+                return None
+            try:
+                batch.primary = chosen.index
+            except AttributeError:
+                pass
+            chosen.outstanding += 1
+            # enqueue UNDER the router lock: the same sentinel-ordering
+            # discipline as dispatch() — abandon_staged retires under
+            # this lock's shadow, so a canary flush is queued ahead of
+            # the retire sentinel and the draining worker serves it
+            chosen.enqueue(batch)
+        return chosen
+
+    def abandon_staged(self, staged, timeout: float = 30.0) -> list:
+        """Retire a staged generation WITHOUT committing it (a canary
+        rollback): clear the staged source/artifacts/payload captured
+        by :meth:`stage`, retire every staged replica (the sentinel
+        queues BEHIND already-routed canary flushes, which the worker
+        drains and serves first), then join each worker and collect
+        what it could not serve.  Returns the leftover flushes — the
+        caller re-dispatches them onto the live generation (the
+        scale-down discipline), so a rollback loses zero futures."""
+        with self._cond:
+            self._staged_source = None
+            self._staged_artifacts = None
+            self._staged_artifacts_set = False
+            path, self._staged_payload_path = self._staged_payload_path, None
+            for r in staged:
+                # retire under the router lock: a concurrent
+                # dispatch_staged enqueue cannot slot a flush behind
+                # the sentinel (its futures would hang — swap-retired
+                # replicas are never joined; abandoned ones are, below)
+                r.retire()
+        leftovers: list = []
+        for r in staged:
+            leftovers.extend(r.join(timeout))
+        if path:
+            try:
+                import os
+
+                os.unlink(path)
+            except OSError:
+                pass
+        return leftovers
+
     # ------------------------------------------------------ availability
     def _compute_available(self) -> bool:
         with self._lock:

@@ -36,7 +36,23 @@ Endpoints (JSON unless noted):
   version — the registry stays the source of truth, so a ``--watch``
   poller (or a restart) agrees with an admin rollback instead of
   reverting it.  Replies with the swap info dict (version, pause,
-  prime seconds, replicas).
+  prime seconds, replicas).  An optional ``{"canary": 0.1,
+  "bake_s": 30, ...}`` body routes the swap through the guarded
+  rollout (``serve/rollout.py``): the staged version serves that
+  traffic fraction, is judged against the SLO/error guardrails, and
+  commits or auto-rolls-back — the reply's ``verdict``/``reason``
+  say which (a rollback answers 200 with ``"verdict":
+  "rolled_back"``; the old version never stopped serving).
+  ``{"clear_bad": true}`` lifts a quarantine mark on the named
+  version first (the explicit admin override).
+- ``POST /rollback`` — admin: revert to the newest prior version in
+  the service's swap history that is still published and not
+  quarantined; moves ``CURRENT`` with it.  409 when there is no
+  registry attached or no viable prior version.
+- ``GET /rolloutz`` — guarded-rollout status
+  (``PipelineService.rollout_status``): the live canary/bake phase,
+  recent episode verdicts, swap history, and the windowed SLO burn
+  detail the judge reads.
 - ``GET /metrics`` — the process metrics registry in Prometheus text
   exposition format (``obs.metrics.to_prometheus_text``): queue depth,
   batch occupancy, latency histograms, shed/rejected counters — the
@@ -217,6 +233,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"replicas": self.service.replica_statuses()})
         elif path == "/statusz":
             self._send(200, self.service.status())
+        elif path == "/rolloutz":
+            self._send(200, self.service.rollout_status())
         elif path == "/tracez":
             self._do_tracez(query)
         elif path.startswith("/requestz/"):
@@ -295,6 +313,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/swap":
             self._do_swap()
+            return
+        if self.path == "/rollback":
+            self._do_rollback()
             return
         if self.path == "/tracez/dump":
             self._do_trace_dump()
@@ -503,18 +524,48 @@ class _Handler(BaseHTTPRequestHandler):
                 # client error, not a handler crash
                 raise ValueError("body must be a JSON object")
             version = body.get("version")
+            # the guarded-rollout body keys ("canary" fraction et al):
+            # parsed here so a malformed guard config is a 400, not a
+            # 502 from deep inside the episode
+            rollout_cfg = None
+            if body.get("canary") is not None:
+                from keystone_tpu.serve.rollout import RolloutConfig
+
+                rollout_cfg = RolloutConfig.from_request(body)
         except (ValueError, json.JSONDecodeError) as e:
             self._send(400, {"error": f"bad request: {e}"})
             return
         from keystone_tpu.serve.registry import RegistryError
 
         try:
+            if body.get("clear_bad") and version:
+                # explicit admin override of a rollout quarantine: the
+                # operator says THIS version is deployable after all
+                registry.clear_quarantine(version)
             fitted, ver = registry.load(version)
             # ship the version's AOT artifacts like the watcher does:
             # an admin swap must not silently drop the pool's artifact
             # tier (the commit moves the bundle with the generation, so
             # a None here would also cost every later supervisor heal)
             arts = registry.load_artifacts(ver)
+            if rollout_cfg is not None:
+                # the guarded path: the controller owns the CURRENT
+                # pointer move (commit) / quarantine + restore
+                # (rollback), so the plain path's pointer block below
+                # must not run — a rolled-back version must not become
+                # CURRENT
+                from keystone_tpu.serve.rollout import guarded_swap
+
+                info = guarded_swap(
+                    self.service,
+                    fitted,
+                    version=ver,
+                    artifacts=arts,
+                    config=rollout_cfg,
+                    registry=registry,
+                )
+                self._send(200, info)
+                return
             info = self.service.swap(fitted, version=ver, artifacts=arts)
         except RegistryError as e:
             self._send(404, {"error": str(e)})
@@ -539,6 +590,97 @@ class _Handler(BaseHTTPRequestHandler):
             )
             info = dict(info)
             info["current_pointer_error"] = f"{type(e).__name__}: {e}"
+        self._send(200, info)
+
+    def _do_rollback(self):
+        """Admin revert: swap back to the newest version in the
+        service's swap history that is published in the registry and
+        not quarantined, and move ``CURRENT`` with it.  Codes: 200
+        reverted (the swap info dict plus ``rolled_back_to`` /
+        ``rolled_back_from``), 409 no registry attached or no viable
+        prior version, 503 service closed, 502 the load/swap failed."""
+        registry = getattr(self.server, "registry", None)
+        if registry is None:
+            self._send(
+                409,
+                {
+                    "error": "no model registry attached; start the "
+                    "frontend with serve_http(svc, registry=...) or "
+                    "`cli serve --model-dir`"
+                },
+            )
+            return
+        svc = self.service
+        from keystone_tpu.serve.registry import RegistryError
+
+        history = getattr(svc, "_version_history", [])
+        published = set(registry.versions())
+        target = None
+        target_idx = None
+        for idx in range(len(history) - 1, -1, -1):
+            cand = history[idx]
+            if cand == svc.version or cand not in published:
+                continue
+            if registry.quarantined(cand) is not None:
+                continue
+            target, target_idx = cand, idx
+            break
+        if target is None:
+            self._send(
+                409,
+                {
+                    "error": "no viable prior version in swap history "
+                    "(nothing swapped yet, or every prior version is "
+                    "unpublished/quarantined)",
+                    "history": list(history),
+                },
+            )
+            return
+        from_version = svc.version
+        try:
+            fitted, ver = registry.load(target)
+            arts = registry.load_artifacts(ver)
+            info = svc.swap(fitted, version=ver, artifacts=arts)
+        except RegistryError as e:
+            self._send(404, {"error": str(e)})
+            return
+        except ServiceClosed as e:
+            self._send(503, {"error": str(e)})
+            return
+        except Exception as e:
+            logger.warning("admin rollback failed: %s: %s", type(e).__name__, e)
+            self._send(
+                502, {"error": f"rollback failed: {type(e).__name__}: {e}"}
+            )
+            return
+        # truncate the walked-past suffix (including the entry swap()
+        # just appended for the version we reverted FROM): a repeated
+        # /rollback walks further back, never ping-pongs
+        del history[target_idx:]
+        metrics.inc("serve.rollout.manual_rollbacks")
+        rec = svc.recorder
+        if rec is not None:
+            rec.ops(
+                "serve.rollout",
+                from_version=from_version,
+                to_version=ver,
+                verdict="rolled_back",
+                reason="manual",
+            )
+        try:
+            if registry.current() != ver:
+                registry.set_current(ver)
+        except Exception as e:
+            logger.warning(
+                "rollback to %s succeeded but CURRENT update failed: %s",
+                ver,
+                e,
+            )
+            info = dict(info)
+            info["current_pointer_error"] = f"{type(e).__name__}: {e}"
+        info = dict(info)
+        info["rolled_back_to"] = ver
+        info["rolled_back_from"] = from_version
         self._send(200, info)
 
 

@@ -9,6 +9,7 @@ one)::
     <root>/
       v0001/model.pkl     (+ model.pkl.b2 sidecar)
       v0002/model.pkl     (+ sidecar)
+      v0002/BAD           (+ sidecar)  — rollout-rollback quarantine mark
       CURRENT             (+ sidecar)  — the version id serving traffic
 
 ``publish`` writes the model blob FIRST and flips ``CURRENT`` last, so
@@ -46,6 +47,12 @@ CURRENT = "CURRENT"
 MODEL_FILE = "model.pkl"
 ARTIFACTS_DIR = "artifacts"
 MANIFEST_FILE = "MANIFEST.json"
+#: the quarantine sidecar (ISSUE 19): an automatic rollout rollback
+#: durably marks the condemned version with ``<vdir>/BAD`` (checksummed
+#: like every other registry file), so the watcher and the ``load(None)``
+#: deploy walk skip it instead of re-rolling into the same bad publish.
+#: Re-publishing the version id (or an explicit admin clear) removes it.
+BAD_FILE = "BAD"
 
 _VERSION_RE = re.compile(r"^v(\d+)$")
 
@@ -121,6 +128,9 @@ class ModelRegistry:
     def _current_path(self) -> str:
         return os.path.join(self.root, CURRENT)
 
+    def bad_path(self, version: str) -> str:
+        return os.path.join(self.version_dir(version), BAD_FILE)
+
     # ------------------------------------------------------------ reads
     def versions(self) -> List[str]:
         """Published version ids, oldest → newest (numeric order)."""
@@ -134,6 +144,71 @@ class ModelRegistry:
             if m and os.path.exists(self.model_path(name)):
                 out.append((int(m.group(1)), name))
         return [name for _, name in sorted(out)]
+
+    def quarantined(self, version: str) -> Optional[str]:
+        """The quarantine reason when ``version`` carries a ``BAD``
+        mark, else None.  Fail-safe: an unreadable/corrupt mark still
+        counts as quarantined — a half-written condemnation must not
+        re-admit the version it condemns."""
+        path = self.bad_path(version)
+        if not os.path.exists(path):
+            return None
+        try:
+            durable.verify_checksum(path)
+            with open(path) as f:
+                return f.read().strip() or "quarantined"
+        except (OSError, durable.CorruptStateError):
+            return "quarantined (mark unreadable)"
+
+    def quarantine(self, version: str, reason: str = "") -> None:
+        """Durably mark ``version`` bad: an automatic rollout rollback
+        (serve/rollout.py) calls this so the watcher's next poll — and
+        the ``load(None)`` deploy walk — skip the version instead of
+        re-deploying the publish the guard just condemned.  Same
+        atomic-write + BLAKE2b-sidecar discipline as every other
+        registry file.  Cleared by re-publishing the version id
+        (:meth:`publish`) or :meth:`clear_quarantine`."""
+        if not os.path.exists(self.model_path(version)):
+            raise RegistryError(
+                f"cannot quarantine unpublished version {version!r}"
+            )
+        text = (reason or "quarantined").strip() + "\n"
+
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                f.write(text)
+                f.flush()
+                os.fsync(f.fileno())
+
+        durable.with_retries(
+            lambda: durable.atomic_write(self.bad_path(version), _write),
+            description=f"registry quarantine {version}",
+        )
+        metrics.inc("serve.registry_quarantines")
+        logger.warning(
+            "quarantined %s in registry %s: %s",
+            version,
+            self.root,
+            text.strip(),
+        )
+
+    def clear_quarantine(self, version: str) -> bool:
+        """Remove ``version``'s quarantine mark (the explicit operator
+        override — ``keystone publish`` of the same id does this
+        implicitly).  Returns True when a mark was removed."""
+        path = self.bad_path(version)
+        removed = False
+        for p in (path, path + durable.CHECKSUM_SUFFIX):
+            try:
+                os.unlink(p)
+                removed = True
+            except OSError:
+                pass
+        if removed:
+            logger.info(
+                "cleared quarantine on %s in registry %s", version, self.root
+            )
+        return removed
 
     def current(self, strict: bool = False) -> Optional[str]:
         """The version id ``CURRENT`` points at (None: nothing
@@ -193,6 +268,20 @@ class ModelRegistry:
         if not candidates:
             raise RegistryError(f"registry {self.root} has no versions")
         for i, cand in enumerate(candidates):
+            why_bad = self.quarantined(cand)
+            if why_bad is not None:
+                # a rollout-condemned version is as undeployable as a
+                # corrupt one: the walk degrades to the next candidate
+                # (but the explicit load(version) forensic path still
+                # reads it — an operator debugging the bad publish must
+                # be able to load exactly what failed)
+                metrics.inc("serve.registry_quarantine_skips")
+                logger.warning(
+                    "skipping quarantined registry version %s: %s",
+                    cand,
+                    why_bad,
+                )
+                continue
             try:
                 fitted = self._read_model(cand)
             except Exception as e:
@@ -257,6 +346,11 @@ class ModelRegistry:
             lambda: durable.atomic_write(self.model_path(version), _write),
             description=f"registry publish {version}",
         )
+        # re-publishing a version id is the operator's explicit word
+        # that the content is good again: lift any quarantine BEFORE
+        # the pointer moves, or set_current would re-point at a version
+        # the watcher still refuses
+        self.clear_quarantine(version)
         if set_current:
             self.set_current(version)
         metrics.inc("serve.registry_published")
@@ -372,6 +466,7 @@ class RegistryWatcher:
         poll_seconds: float = 5.0,
         on_swap=None,
         max_backoff_seconds: float = 300.0,
+        rollout=None,
     ):
         self.service = service
         self.registry = registry
@@ -380,6 +475,14 @@ class RegistryWatcher:
             self.poll_seconds, float(max_backoff_seconds)
         )
         self.on_swap = on_swap
+        #: a :class:`~keystone_tpu.serve.rollout.RolloutConfig` (with a
+        #: canary fraction) routes every watcher swap through the
+        #: guarded-rollout path (``cli serve --watch --canary``): a bad
+        #: publish canaries, rolls back, and is quarantined instead of
+        #: taking the fleet.  None = the plain swap path, unchanged.
+        self.rollout = rollout
+        #: once-per-version log damper for quarantined-CURRENT skips
+        self._last_quarantine_skip: Optional[str] = None
         self._consecutive_errors = 0
         self._rng = random.Random()  # jitter only; no determinism contract
         self._stop = threading.Event()
@@ -439,16 +542,49 @@ class RegistryWatcher:
         cur = self.registry.current(strict=True)
         if not cur or cur == self.service.version:
             return
+        why_bad = self.registry.quarantined(cur)
+        if why_bad is not None:
+            # a quarantined CURRENT is "no news", not an error: a
+            # rollout rollback condemned exactly this version, and
+            # re-deploying it every poll would undo the rollback.
+            # Logged once per version (the poll loop is hot).
+            metrics.inc("serve.watch_quarantine_skips")
+            if cur != self._last_quarantine_skip:
+                self._last_quarantine_skip = cur
+                logger.warning(
+                    "watcher skipping quarantined CURRENT %s: %s",
+                    cur,
+                    why_bad,
+                )
+            return
         fitted, ver = self.registry.load(cur)
         # best-effort AOT tier: a version published without artifacts
         # (or with damaged ones) swaps in via the compile ladder
         arts = self.registry.load_artifacts(ver)
-        info = self.service.swap(fitted, version=ver, artifacts=arts)
+        if self.rollout is not None and self.rollout.canary is not None:
+            from keystone_tpu.serve.rollout import CanaryController
+
+            info = CanaryController(
+                self.service, self.rollout, registry=self.registry
+            ).run(fitted, version=ver, artifacts=arts)
+            if info.get("verdict") != "committed":
+                metrics.inc("serve.watch_rollbacks")
+                logger.warning(
+                    "watcher canary of %s rolled back (%s); version "
+                    "quarantined",
+                    ver,
+                    info.get("reason"),
+                )
+                if self.on_swap is not None:
+                    self.on_swap(info)
+                return
+        else:
+            info = self.service.swap(fitted, version=ver, artifacts=arts)
         metrics.inc("serve.watch_swaps")
         logger.info(
             "watcher swapped in %s (pause %.1f ms)",
             ver,
-            1000.0 * info["pause_seconds"],
+            1000.0 * info.get("pause_seconds", 0.0),
         )
         rec = getattr(self.service, "recorder", None)
         if rec is not None:
@@ -458,7 +594,7 @@ class RegistryWatcher:
             rec.ops(
                 "serve.watch_swap",
                 version=ver,
-                pause_seconds=info["pause_seconds"],
+                pause_seconds=info.get("pause_seconds", 0.0),
             )
         if self.on_swap is not None:
             self.on_swap(info)

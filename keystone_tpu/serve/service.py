@@ -265,6 +265,7 @@ class _Request:
         "tenant",
         "block",
         "row",
+        "gen",
     )
 
     def __init__(
@@ -292,6 +293,12 @@ class _Request:
         #: worker.  None on every other submit path.
         self.block = None
         self.row = 0
+        #: rollout generation tag (serve/rollout.py): "canary" when the
+        #: flush carrying this request was routed to a staged canary
+        #: generation, "live" when a canary window explicitly kept it on
+        #: the serving generation; None outside any canary window —
+        #: every rollout hook treats None as "live"
+        self.gen: Optional[str] = None
 
 
 def _block_of(reqs) -> Optional[object]:
@@ -459,6 +466,7 @@ class PipelineService:
         recorder=True,
         slo_ms: Optional[float] = None,
         slo_target: float = 0.99,
+        slo_window_s: Optional[float] = None,
         supervise: bool = True,
         heartbeat_s: float = 30.0,
         supervise_interval_s: float = 0.5,
@@ -606,13 +614,27 @@ class PipelineService:
         #: rolling-window latency/batch instruments backing /statusz
         #: percentiles; every observe also feeds the cumulative
         #: registry series of the same name (/metrics)
-        self._lat_win = metrics.WindowedHistogram("serve.latency_seconds")
+        #: ``slo_window_s`` resizes the SLO observation window (burn
+        #: rate, /statusz percentiles, the rollout judge) — short
+        #: windows make a canary/bake verdict reflect NOW, long ones
+        #: smooth bursts.  Only the request-outcome windows resize:
+        #: ``serve.batch_seconds`` keeps the default window because
+        #: occupancy() divides by window_seconds × replicas and the
+        #: autoscaler's thresholds are tuned against that default.
+        slo_window = (
+            max(1.0, float(slo_window_s)) if slo_window_s else 60.0
+        )
+        self._lat_win = metrics.WindowedHistogram(
+            "serve.latency_seconds", window_seconds=slo_window
+        )
         self._batch_win = metrics.WindowedHistogram("serve.batch_seconds")
         #: time failed requests (shed/rejected/errored) spent waiting
         #: before their terminal — and, for the SLO burn rate, the
         #: windowed COUNT of failures: a shed flood must drain the
         #: error budget, not hide from a completed-only latency window
-        self._fail_win = metrics.WindowedHistogram("serve.failed_wait_seconds")
+        self._fail_win = metrics.WindowedHistogram(
+            "serve.failed_wait_seconds", window_seconds=slo_window
+        )
         #: SLO latency objective (seconds): explicit slo_ms, else the
         #: service deadline, else no SLO section in /statusz
         self._slo_s = (
@@ -650,6 +672,20 @@ class PipelineService:
         self._bisect = bool(bisect)
         self._poison_cache: "OrderedDict[bytes, float]" = OrderedDict()
         self._poison_lock = threading.Lock()
+        #: guarded-rollout hooks (serve/rollout.py).  ``_rollout``: the
+        #: live CanaryController while a canary window is open — the
+        #: batcher offers it every formed flush (take) and the request
+        #: terminals report outcomes to it (observe); None outside a
+        #: window, making every hook a single attribute read on the
+        #: pinned path.  ``_rollout_guard``: the post-commit bake watch.
+        #: ``_version_history``: prior version ids, newest last — what
+        #: POST /rollback walks.  ``_rollout_history``: recent episode
+        #: verdicts for /rolloutz.
+        self._rollout = None
+        self._rollout_guard = None
+        self._rollout_state: Optional[dict] = None
+        self._rollout_history: deque = deque(maxlen=16)
+        self._version_history: list = []
         if example is not None:
             self.prime()
         self._pool.start(
@@ -1289,23 +1325,47 @@ class PipelineService:
         metrics.set_gauge("serve.occupancy", occ)
         return occ
 
-    def slo_burn_rate(self) -> Optional[float]:
-        """The windowed SLO error-budget burn rate (None when no
-        objective is configured) — the same number ``/statusz`` embeds,
-        exposed directly for the autoscaler."""
+    def slo_burn(self) -> Optional[dict]:
+        """The windowed SLO burn detail (None when no objective is
+        configured): ``burn_rate`` plus the ``window_requests`` /
+        ``window_failed`` sample counts behind it, so a consumer — the
+        rollout judge (serve/rollout.py), the bake guard, ``/statusz``
+        — can refuse to read a near-empty window as a verdict instead
+        of treating noise as signal.  ``bad`` counts completed-but-
+        over-objective requests PLUS every failed terminal in the
+        window (a shed flood is the worst latency violation there is
+        and must drain the budget); ``burn_rate`` is None when the
+        target leaves zero error budget."""
         if self._slo_s is None:
             return None
         lat = self._lat_win.summary()
         n_ok = lat["count"]
         n_fail = self._fail_win.summary()["count"]
         n = n_ok + n_fail
-        if n == 0:
-            return 0.0
         bad = (
-            self._lat_win.fraction_above(self._slo_s) * n_ok + n_fail
-        ) / n
+            0.0
+            if n == 0
+            else (self._lat_win.fraction_above(self._slo_s) * n_ok + n_fail)
+            / n
+        )
         budget = 1.0 - self._slo_target
-        return None if budget <= 0.0 else bad / budget
+        return {
+            "objective_ms": round(1000.0 * self._slo_s, 3),
+            "target": self._slo_target,
+            "window_seconds": self._lat_win.window_seconds,
+            "window_requests": n,
+            "window_failed": n_fail,
+            "bad_fraction": bad,
+            "burn_rate": None if budget <= 0.0 else bad / budget,
+        }
+
+    def slo_burn_rate(self) -> Optional[float]:
+        """The windowed SLO error-budget burn rate (None when no
+        objective is configured, or the target leaves no budget) — the
+        same number ``/statusz`` embeds, exposed directly for the
+        autoscaler; :meth:`slo_burn` carries the sample counts."""
+        detail = self.slo_burn()
+        return None if detail is None else detail["burn_rate"]
 
     @property
     def host_capacity(self) -> Optional[int]:
@@ -1523,33 +1583,46 @@ class PipelineService:
             # workers shipped over their existing reply/beat frames
             out["fleet"] = self._telemetry.fleet_status()
         if self._slo_s is not None:
-            # bad = completed-but-over-objective PLUS every failed
-            # terminal (shed/rejected/error) in the window: a shed
-            # flood is the worst latency violation there is and must
-            # drain the budget, not hide from a completed-only window
-            n_ok = lat["count"]
-            n_fail = self._fail_win.summary()["count"]
-            n = n_ok + n_fail
-            bad = (
-                0.0
-                if n == 0
-                else (self._lat_win.fraction_above(self._slo_s) * n_ok + n_fail)
-                / n
-            )
-            budget = 1.0 - self._slo_target
+            # slo_burn() carries the window sample counts next to the
+            # rate — the same refuse-to-decide-on-noise detail the
+            # rollout judge reads
+            detail = self.slo_burn()
+            bad = detail["bad_fraction"]
             out["slo"] = {
-                "objective_ms": round(1000.0 * self._slo_s, 3),
-                "target": self._slo_target,
-                "window_seconds": self._lat_win.window_seconds,
-                "window_requests": n,
-                "window_failed": n_fail,
+                "objective_ms": detail["objective_ms"],
+                "target": detail["target"],
+                "window_seconds": detail["window_seconds"],
+                "window_requests": detail["window_requests"],
+                "window_failed": detail["window_failed"],
                 "bad_fraction": round(bad, 6),
                 "compliance": round(1.0 - bad, 6),
                 "burn_rate": (
-                    None if budget <= 0.0 else round(bad / budget, 3)
+                    None
+                    if detail["burn_rate"] is None
+                    else round(detail["burn_rate"], 3)
                 ),
             }
         return out
+
+    def rollout_status(self) -> dict:
+        """The ``GET /rolloutz`` block: the live rollout phase (canary
+        window or bake watch) when one is active, the recent episode
+        verdicts, and the swap history ``POST /rollback`` would walk."""
+        active = self._rollout_state
+        guard_ = self._rollout_guard
+        if guard_ is not None:
+            active = guard_.status()
+        rollout = self._rollout
+        if rollout is not None and isinstance(active, dict):
+            active = dict(active)
+            active["canary"] = rollout.snapshot()
+        return {
+            "version": self.version,
+            "active": active,
+            "history": list(self._rollout_history),
+            "prior_versions": list(self._version_history),
+            "slo": self.slo_burn(),
+        }
 
     def dump_trace(self, dir_path: str) -> Optional[str]:
         """Write the flight recorder's full state (the ``/tracez?full=1``
@@ -1621,6 +1694,7 @@ class PipelineService:
                 raise ServiceClosed(f"service {self.name!r} is closed")
             self._swap_seq += 1
             version = version or f"swap{self._swap_seq}"
+            prev_version = self.version
             with ledger.span("serve.swap", version=version):
                 fault_point("serve.swap", version=version)
                 t0 = time.monotonic()
@@ -1648,6 +1722,10 @@ class PipelineService:
                     raise
                 prime_s = time.monotonic() - t0
                 pause_s = self._pool.commit(staged, version)
+            # swap-history bookkeeping for POST /rollback: the version
+            # this commit displaced, newest last (internal — the pinned
+            # swap return/ops surface is unchanged)
+            self._version_history.append(prev_version)
             metrics.inc("serve.swaps")
             metrics.observe("serve.swap_pause_seconds", pause_s)
             metrics.observe("serve.swap_prime_seconds", prime_s)
@@ -1703,6 +1781,12 @@ class PipelineService:
             self.supervisor.stop()
         if self._hedge is not None:
             self._hedge.stop()
+        # the bake guard is a healer too: a revert swap racing the
+        # teardown below would stage a generation into a closing pool
+        # (its loop also exits on _closing; this bounds the join)
+        guard_ = self._rollout_guard
+        if guard_ is not None:
+            guard_.stop()
         # wait out an in-flight swap: with _closing set no NEW swap can
         # start, and an in-flight one either commits into the still-live
         # pool (its generation is then retired below) or fails on its
@@ -1777,6 +1861,17 @@ class PipelineService:
             flush = self._next_batch()
             if flush is None:
                 return
+            # the canary split (serve/rollout.py): while a guarded
+            # rollout's judge window is open, the controller claims a
+            # deterministic seeded-hash fraction of flushes for the
+            # staged generation; everything else (and everything when
+            # no window is open — one attribute read) routes normally.
+            # A claimed flush is NOT hedged: hedging re-enqueues onto
+            # the live generation, which would both pollute the canary
+            # sample and mask a slow canary behind a fast live win.
+            rollout = self._rollout
+            if rollout is not None and rollout.take(flush):
+                continue
             try:
                 self._pool.dispatch(flush)
             except FleetUnavailable as e:
@@ -1842,6 +1937,9 @@ class PipelineService:
         else:
             outcome = "error"
         self._account_tenant(req, outcome, waited)
+        rollout = self._rollout
+        if rollout is not None:
+            rollout.observe(req, outcome, waited)
         rid = req.request_id
         if rid is not None:
             rec = self.recorder
@@ -2123,6 +2221,7 @@ class PipelineService:
         # inert-path cost of N module-frontend calls is real at serving
         # rates (part of the recorder overhead budget)
         led_on = ledger.active() is not None
+        rollout = self._rollout
         for i, req in enumerate(reqs):
             if req.future.done():
                 continue
@@ -2135,6 +2234,8 @@ class PipelineService:
                 metrics.inc("serve.deadline_miss")
             metrics.inc("serve.completed")
             self._account_tenant(req, outcome, done_t - req.t_submit)
+            if rollout is not None:
+                rollout.observe(req, outcome, done_t - req.t_submit)
             if req.request_id is not None:
                 if rec is not None:
                     rec.finish(
@@ -2429,6 +2530,7 @@ def serve(
     recorder=True,
     slo_ms: Optional[float] = None,
     slo_target: float = 0.99,
+    slo_window_s: Optional[float] = None,
     supervise: bool = True,
     heartbeat_s: float = 30.0,
     supervise_interval_s: float = 0.5,
@@ -2479,7 +2581,11 @@ def serve(
       configured :class:`~keystone_tpu.obs.recorder.FlightRecorder`.
     - ``slo_ms`` / ``slo_target`` — the latency objective behind
       ``GET /statusz``'s error-budget burn rate (default objective:
-      ``deadline_ms``; no deadline, no SLO section).
+      ``deadline_ms``; no deadline, no SLO section).  ``slo_window_s``
+      resizes the burn observation window (default 60 s) — the knob a
+      guarded rollout's judge/bake guard (``serve/rollout.py``) reads
+      through, so short windows make rollback verdicts reflect the
+      canary's now rather than the last minute.
     - ``supervise`` (default ON) — the self-healing
       :class:`~keystone_tpu.serve.fleet.ReplicaSupervisor`: dead/wedged
       replica workers are restarted in place (re-clone + re-place from
@@ -2552,6 +2658,7 @@ def serve(
         recorder=recorder,
         slo_ms=slo_ms,
         slo_target=slo_target,
+        slo_window_s=slo_window_s,
         supervise=supervise,
         heartbeat_s=heartbeat_s,
         supervise_interval_s=supervise_interval_s,

@@ -1,0 +1,608 @@
+"""Guarded rollouts (ISSUE 19): canary-fraction swaps with automatic
+rollback (serve/rollout.py), registry bad-version quarantine, the
+windowed SLO-burn knob, HTTP parity (/rolloutz, /rollback, canary
+bodies on /swap), the seeded workload zoo's replay pin, and the
+end-to-end chaos drill.
+
+All tier-1 (seconds-scale, CPU): conftest forces 8 host-platform
+devices, so multi-replica pools run in-process.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.obs import metrics
+from keystone_tpu.serve import (
+    ModelRegistry,
+    RegistryWatcher,
+    RolloutConfig,
+    serve,
+    serve_http,
+)
+from keystone_tpu.serve.rollout import CanaryController, canary_hash, guarded_swap
+from keystone_tpu.utils import durable
+from tools.workloads import MARK, build_zoo_pipeline, make_scenario, payload
+
+pytestmark = pytest.mark.serve
+
+DIM = 6
+
+
+def _pipeline(scale: float = 2.0, gate: bool = False):
+    return build_zoo_pipeline(dim=DIM, scale=scale, gate=gate)
+
+
+def _service(replicas: int, name: str, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("queue_bound", 512)
+    kw.setdefault("example", np.zeros(DIM, np.float32))
+    kw.setdefault("version", "v0001")
+    return serve(_pipeline(), replicas=replicas, name=name, **kw)
+
+
+def _rows(k: int, seed: int = 0) -> np.ndarray:
+    return (
+        np.random.default_rng(seed).normal(size=(k, DIM)).astype(np.float32)
+    )
+
+
+def _norm(out) -> float:
+    return float(np.linalg.norm(np.asarray(out)))
+
+
+def _counter(name: str) -> float:
+    return metrics.REGISTRY.counter_total(name)
+
+
+class _Pump:
+    """Background traffic: submit rows until stopped, collect futures."""
+
+    def __init__(self, svc, make_rows):
+        self.svc = svc
+        self.make_rows = make_rows
+        self.futs = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        i = 0
+        while not self._stop.is_set():
+            for row in self.make_rows(i):
+                try:
+                    f = self.svc.submit(row)
+                except Exception:
+                    continue
+                with self._lock:
+                    self.futs.append(f)
+            i += 1
+            time.sleep(0.005)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+    def resolve_all(self, timeout=30.0) -> int:
+        """Resolve every submitted future; returns the HUNG count (a
+        typed failure is an acceptable terminal, a hang never is)."""
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        with self._lock:
+            futs = list(self.futs)
+        hung = 0
+        for f in futs:
+            try:
+                f.result(timeout=timeout)
+            except FutTimeout:
+                hung += 1
+            except Exception:
+                pass
+        return hung
+
+
+# ----------------------------------------------------------- determinism
+def test_canary_hash_seeded_replay_pin():
+    """The routing split is a pure function of (seed, request id) —
+    pinned to literal values so the hash can never silently change
+    (a changed split makes every recorded canary episode unreplayable)."""
+    assert canary_hash(0, "req-000") == 0.22911944990885413
+    assert canary_hash(7, "req-000") == 0.9493967629409243
+    ids = [f"r{i}" for i in range(200)]
+    split = [i for i, r in enumerate(ids) if canary_hash(3, r) < 0.25]
+    assert len(split) == 48
+    assert split[:12] == [2, 3, 4, 5, 7, 13, 20, 29, 31, 32, 35, 36]
+    assert all(0.0 <= canary_hash(11, r) < 1.0 for r in ids)
+
+
+def test_workload_zoo_seeded_replay():
+    """Same (name, seed) = identical schedule, digest, and payload
+    bytes; a different seed diverges.  The zoo's whole value is that a
+    scenario that killed a canary replays bit-exactly."""
+    a = make_scenario("poison_flood", seed=7)
+    b = make_scenario("poison_flood", seed=7)
+    assert a.trace_digest() == b.trace_digest()
+    assert a.trace() == b.trace()
+    assert a.trace_digest() != make_scenario("poison_flood", seed=8).trace_digest()
+    for ea, eb in zip(a.events[:8], b.events[:8]):
+        np.testing.assert_array_equal(payload(ea, a.dim), payload(eb, b.dim))
+    poison = [e for e in a.events if e["kind"] == "poison"]
+    assert poison, "poison_flood produced no poison events"
+    assert all(payload(e, a.dim)[:, 0][0] == MARK for e in poison[:4])
+    digests = set()
+    for name in ("bursty", "diurnal", "heavy_tailed", "tenant_skewed", "drift"):
+        sc = make_scenario(name, seed=3)
+        assert sc.events
+        assert sc.trace_digest() == make_scenario(name, seed=3).trace_digest()
+        digests.add(sc.trace_digest())
+    assert len(digests) == 5  # scenarios don't collapse onto one schedule
+
+
+def test_rollout_config_validation():
+    with pytest.raises(ValueError):
+        RolloutConfig(canary=0.0)
+    with pytest.raises(ValueError):
+        RolloutConfig(canary=1.5)
+    with pytest.raises(ValueError):
+        RolloutConfig(insufficient="explode")
+    cfg = RolloutConfig.from_request(
+        {"canary": 0.25, "min_samples": 5, "version": "v0002", "junk": 1}
+    )
+    assert cfg.canary == 0.25 and cfg.min_samples == 5
+    with pytest.raises(ValueError):
+        RolloutConfig.from_request({"canary": "a lot"})
+    assert RolloutConfig(canary=None).canary is None
+
+
+# -------------------------------------------------------------- episodes
+def test_canary_catches_poison_flood(tmp_path):
+    """The tentpole contract: a bad version (fails marker rows) canaried
+    under a poison flood is rolled back on the error-rate guardrail —
+    the live generation keeps serving, the version is durably
+    quarantined, and no future hangs across the abandoned generation."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0, gate=True), set_current=False)
+    svc = _service(2, "rollout_poison", version=v1)
+
+    def poison_wave(i):
+        rows = _rows(3, seed=1000 + i)
+        rows[0, 0] = MARK  # one marker row per wave, distinct content
+        return rows
+
+    rollbacks0 = _counter("serve.rollout.rollbacks")
+    try:
+        with _Pump(svc, poison_wave) as pump:
+            cfg = RolloutConfig(
+                canary=1.0,  # every flush canaried: deterministic drill
+                min_samples=8,
+                decide_s=20.0,
+                max_error_rate=0.2,
+                p99_ratio=None,
+                insufficient="rollback",
+            )
+            info = CanaryController(svc, cfg, registry=reg).run(
+                reg.load(v2)[0], version=v2
+            )
+            assert info["verdict"] == "rolled_back", info
+            assert info["reason"] == "error_rate", info
+            assert info["canary"]["canary"]["bad"] > 0
+        assert pump.resolve_all() == 0  # zero hung futures
+        assert svc.version == v1
+        # the live generation still answers with the OLD fingerprint
+        y = svc.submit(_rows(1, seed=5)[0]).result(timeout=30.0)
+        assert abs(_norm(y) - 2.0) < 1e-3
+        # durable condemnation: the registry carries the BAD mark and
+        # the deploy walk refuses the version
+        assert reg.quarantined(v2) is not None
+        assert reg.load()[1] == v1
+        assert _counter("serve.rollout.rollbacks") > rollbacks0
+        hist = svc.rollout_status()["history"]
+        assert hist and hist[-1]["verdict"] == "rolled_back"
+        assert svc.rollout_status()["active"] is None
+    finally:
+        svc.close()
+
+
+def test_canary_passes_clean_commits(tmp_path):
+    """A healthy version under clean traffic commits: the service flips
+    to the new generation, CURRENT follows, and the info dict is a
+    superset of the plain swap's."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0), set_current=False)
+    svc = _service(2, "rollout_clean", version=v1)
+    commits0 = _counter("serve.rollout.commits")
+    try:
+        with _Pump(svc, lambda i: _rows(3, seed=2000 + i)) as pump:
+            cfg = RolloutConfig(
+                canary=0.5,
+                seed=3,
+                min_samples=8,
+                decide_s=20.0,
+                p99_ratio=None,
+                insufficient="rollback",
+            )
+            info = CanaryController(svc, cfg, registry=reg).run(
+                reg.load(v2)[0], version=v2
+            )
+            assert info["verdict"] == "committed", info
+            assert info["reason"] == "guardrails_clean"
+            assert {"pause_seconds", "prime_seconds", "replicas"} <= set(info)
+        assert pump.resolve_all() == 0
+        assert svc.version == v2
+        y = svc.submit(_rows(1, seed=6)[0]).result(timeout=30.0)
+        assert abs(_norm(y) - 3.0) < 1e-3
+        assert reg.current() == v2  # CURRENT moved with the commit
+        assert reg.quarantined(v2) is None
+        assert _counter("serve.rollout.commits") > commits0
+        assert v1 in svc.rollout_status()["prior_versions"]
+    finally:
+        svc.close()
+
+
+def test_canary_insufficient_samples_decides_conservatively(tmp_path):
+    """No traffic in the judge window: the default refuses to commit on
+    noise (rollback); insufficient='commit' is the operator's explicit
+    opt-out.  Single-use controllers cannot be replayed."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0), set_current=False)
+    svc = _service(1, "rollout_quiet", version=v1)
+    try:
+        cfg = RolloutConfig(
+            canary=0.5, min_samples=10_000, decide_s=0.3, insufficient="rollback"
+        )
+        ctl = CanaryController(svc, cfg, registry=reg)
+        info = ctl.run(reg.load(v2)[0], version=v2)
+        assert info["verdict"] == "rolled_back"
+        assert info["reason"] == "insufficient_samples"
+        assert svc.version == v1
+        with pytest.raises(RuntimeError):
+            ctl.run(reg.load(v2)[0], version=v2)  # single-use
+        # the quarantined mark from the rollback blocks the deploy walk
+        assert reg.quarantined(v2) is not None
+        reg.clear_quarantine(v2)
+        cfg2 = RolloutConfig(
+            canary=0.5, min_samples=10_000, decide_s=0.3, insufficient="commit"
+        )
+        info2 = CanaryController(svc, cfg2, registry=reg).run(
+            reg.load(v2)[0], version=v2
+        )
+        assert info2["verdict"] == "committed"
+        assert info2["reason"] == "insufficient_samples"
+        assert svc.version == v2
+    finally:
+        svc.close()
+
+
+def test_bake_rollback_on_sustained_burn(tmp_path):
+    """Post-commit bake: the committed version passes its canary window
+    (drift hasn't bitten yet) but burns the SLO during the bake — the
+    RollbackGuard reverts to the prior generation and quarantines the
+    baked version.  The drift scenario's shifted payloads drive the
+    traffic; a microscopic objective makes the burn deterministic."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0), set_current=False)
+    svc = _service(
+        2,
+        "rollout_bake",
+        version=v1,
+        slo_ms=1e-4,  # everything breaches: burn is deterministic
+        slo_target=0.99,
+    )
+    drift = make_scenario("drift", seed=4, duration_s=2.0, qps=100.0, dim=DIM)
+    drift_rows = [payload(e, DIM) for e in drift.events[:64]]
+    bake_rb0 = _counter("serve.rollout.bake_rollbacks")
+    try:
+        cfg = RolloutConfig(
+            canary=1.0,
+            min_samples=4,
+            decide_s=0.2,
+            insufficient="commit",  # skip the canary judge into the bake
+            max_burn=float("inf"),
+            max_error_rate=1.1,
+            p99_ratio=None,
+            bake_s=30.0,
+            bake_max_burn=1.0,
+            bake_sustain_s=0.1,
+        )
+        info = CanaryController(svc, cfg, registry=reg).run(
+            reg.load(v2)[0], version=v2
+        )
+        assert info["verdict"] == "committed", info
+        assert svc.version == v2
+        state = svc.rollout_status()["active"]
+        assert state is not None and state["phase"] == "bake"
+        # drift-era traffic burns the objective; the guard must revert
+        deadline = time.monotonic() + 30.0
+        i = 0
+        while svc.version != v1 and time.monotonic() < deadline:
+            rows = drift_rows[i % len(drift_rows)]
+            for f in svc.submit_many(rows):
+                try:
+                    f.result(timeout=30.0)
+                except Exception:
+                    pass
+            i += 1
+        assert svc.version == v1, "bake guard never reverted"
+        y = svc.submit(_rows(1, seed=8)[0]).result(timeout=30.0)
+        assert abs(_norm(y) - 2.0) < 1e-3
+        assert _counter("serve.rollout.bake_rollbacks") > bake_rb0
+        assert reg.quarantined(v2) is not None
+        assert reg.current() == v1
+        hist = svc.rollout_status()["history"]
+        assert hist[-1]["reason"] == "bake_burn"
+        deadline = time.monotonic() + 5.0
+        while svc._rollout_guard is not None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc._rollout_guard is None  # guard cleared itself
+    finally:
+        svc.close()
+
+
+def test_canary_fallback_when_no_staged_capacity():
+    """take() never blocks and never fails a flush: with no routable
+    staged replica the flush falls back to the live generation and the
+    fallback is counted."""
+
+    class _Flush:
+        riders = ()
+        bid = "b-fallback"
+
+    svc = _service(1, "rollout_fallback")
+    try:
+        ctl = CanaryController(svc, RolloutConfig(canary=1.0))
+        ctl._open = True  # window open, but zero staged replicas
+        before = _counter("serve.rollout.canary_fallbacks")
+        assert ctl.take(_Flush()) is False
+        assert ctl.snapshot()["canary_fallbacks"] == 1
+        assert _counter("serve.rollout.canary_fallbacks") > before
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------ swap path pinned
+def test_plain_swap_surface_pinned():
+    """With canary=None nothing of the rollout machinery runs: the swap
+    info dict is exactly the PR-8/11 surface (no rollout keys), and
+    guarded_swap degrades to the identical call."""
+    svc = _service(2, "rollout_pinned")
+    try:
+        info = svc.swap(_pipeline(3.0), version="v0002")
+        assert set(info) == {
+            "version",
+            "pause_seconds",
+            "prime_seconds",
+            "replicas",
+        }
+        info2 = guarded_swap(svc, _pipeline(4.0), version="v0003", config=None)
+        assert set(info2) == set(info)
+        info3 = guarded_swap(
+            svc,
+            _pipeline(5.0),
+            version="v0004",
+            config=RolloutConfig(canary=None),
+        )
+        assert set(info3) == set(info)
+        assert svc.version == "v0004"
+        # internal: swap history accumulated for /rollback anyway
+        assert svc.rollout_status()["prior_versions"] == [
+            "v0001",
+            "v0002",
+            "v0003",
+        ]
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------- slo windowing
+def test_slo_burn_windowing_knob():
+    """slo_window_s sizes the burn window, and slo_burn() reports
+    window_requests so a judge can refuse to decide on too-few
+    samples."""
+    svc = _service(1, "rollout_slo", slo_ms=250.0, slo_window_s=5.0)
+    try:
+        detail = svc.slo_burn()
+        assert detail["window_seconds"] == 5.0
+        assert detail["window_requests"] == 0
+        assert detail["burn_rate"] == 0.0
+        for f in svc.submit_many(_rows(4, seed=3)):
+            f.result(timeout=30.0)
+        detail = svc.slo_burn()
+        assert detail["window_requests"] >= 4
+        assert svc.slo_burn_rate() == detail["burn_rate"]
+        assert {"objective_ms", "target", "bad_fraction"} <= set(detail)
+    finally:
+        svc.close()
+    # no objective -> no burn block at all
+    svc2 = _service(1, "rollout_noslo")
+    try:
+        assert svc2.slo_burn() is None
+        assert svc2.slo_burn_rate() is None
+    finally:
+        svc2.close()
+
+
+# ------------------------------------------------------------- registry
+def test_registry_quarantine_checksummed_sidecar(tmp_path):
+    """The BAD mark is durable (checksummed sidecar), fail-safe (an
+    unreadable mark still condemns), skipped by the deploy walk but not
+    the forensic path, and cleared by republish or the explicit API."""
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0))
+    assert reg.current() == v2
+    with pytest.raises(Exception):
+        reg.quarantine("v9999")  # unpublished: typed refusal
+    reg.quarantine(v2, reason="rollout rollback: error_rate")
+    assert "error_rate" in reg.quarantined(v2)
+    import os
+
+    assert os.path.exists(reg.bad_path(v2) + durable.CHECKSUM_SUFFIX)
+    # deploy walk skips it (CURRENT still points at it)
+    skips0 = _counter("serve.registry_quarantine_skips")
+    fitted, ver = reg.load()
+    assert ver == "v0001"
+    assert _counter("serve.registry_quarantine_skips") > skips0
+    # forensic path still reads the condemned version strictly
+    assert reg.load(v2)[1] == v2
+    # an unreadable mark is still a mark (fail-safe)
+    with open(reg.bad_path(v2), "w") as f:
+        f.write("torn garbage")
+    assert reg.quarantined(v2) is not None
+    # explicit clear, then republish-clears
+    assert reg.clear_quarantine(v2) is True
+    assert reg.quarantined(v2) is None
+    assert reg.clear_quarantine(v2) is False
+    reg.quarantine(v2, reason="again")
+    reg.publish(_pipeline(3.0), version=v2)
+    assert reg.quarantined(v2) is None
+    assert reg.load()[1] == v2
+
+
+def test_watcher_skips_quarantined_version(tmp_path):
+    """The poll watcher refuses to deploy a version carrying the BAD
+    mark even when CURRENT points straight at it, and deploys it after
+    the mark clears."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    svc = _service(1, "rollout_watch", version=v1)
+    try:
+        v2 = reg.publish(_pipeline(3.0))  # CURRENT -> v2
+        reg.quarantine(v2, reason="rollout rollback: slo_burn")
+        w = RegistryWatcher(svc, reg, poll_seconds=3600.0)
+        skips0 = _counter("serve.watch_quarantine_skips")
+        w._poll_once()
+        assert svc.version == v1  # refused
+        assert _counter("serve.watch_quarantine_skips") > skips0
+        w._poll_once()  # idempotent: still refused, no crash
+        assert svc.version == v1
+        reg.clear_quarantine(v2)
+        w._poll_once()
+        assert svc.version == v2
+    finally:
+        svc.close()
+
+
+def test_watcher_guarded_rollout_path(tmp_path):
+    """A watcher built with a rollout config canaries new versions
+    instead of hard-swapping: a version that fails the judge is rolled
+    back + quarantined, and the next poll does not retry it."""
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    svc = _service(1, "rollout_watch_canary", version=v1)
+    try:
+        cfg = RolloutConfig(
+            canary=1.0, min_samples=10_000, decide_s=0.2, insufficient="rollback"
+        )
+        w = RegistryWatcher(svc, reg, poll_seconds=3600.0, rollout=cfg)
+        v2 = reg.publish(_pipeline(3.0))
+        rb0 = _counter("serve.watch_rollbacks")
+        w._poll_once()
+        assert svc.version == v1  # judged insufficient -> rolled back
+        assert _counter("serve.watch_rollbacks") > rb0
+        assert reg.quarantined(v2) is not None
+        assert reg.current() == v1  # rollback restored the pointer
+        # even with CURRENT forced back at the condemned version (a
+        # crashed deploy, a confused operator) the watcher refuses
+        reg.set_current(v2)
+        skips0 = _counter("serve.watch_quarantine_skips")
+        w._poll_once()
+        assert svc.version == v1
+        assert _counter("serve.watch_quarantine_skips") > skips0
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------------------ http
+def test_http_rollout_endpoints(tmp_path):
+    """HTTP parity: GET /rolloutz mirrors rollout_status(), POST
+    /rollback walks the swap history (409 with nothing to revert to),
+    and POST /swap grows the canary body (400 on a bad config; a
+    guarded verdict comes back 200 either way)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish(_pipeline(2.0))
+    v2 = reg.publish(_pipeline(3.0), set_current=False)
+    with _service(2, "rollout_http", version=v1) as svc:
+        with serve_http(svc, port=0, registry=reg) as front:
+            base = f"http://127.0.0.1:{front.port}"
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(body).encode()
+                )
+                return json.load(urllib.request.urlopen(req, timeout=60))
+
+            rz = json.load(
+                urllib.request.urlopen(base + "/rolloutz", timeout=10)
+            )
+            assert rz["version"] == v1
+            assert rz["history"] == [] and rz["prior_versions"] == []
+            # nothing to roll back to yet
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post("/rollback", {})
+            assert err.value.code == 409
+            # plain swap to v2, then /rollback reverts to v1
+            info = post("/swap", {"version": v2})
+            assert svc.version == v2 and info["version"] == v2
+            assert reg.current() == v2
+            info = post("/rollback", {})
+            assert info["rolled_back_to"] == v1
+            assert info["rolled_back_from"] == v2
+            assert svc.version == v1
+            assert reg.current() == v1
+            # history consumed: a second rollback has nowhere to go
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post("/rollback", {})
+            assert err.value.code == 409
+            # canary body: a bad config is a 400, not a 502
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post("/swap", {"version": v2, "canary": 2.0})
+            assert err.value.code == 400
+            # guarded swap that rolls back still answers 200 + verdict
+            info = post(
+                "/swap",
+                {
+                    "version": v2,
+                    "canary": 1.0,
+                    "min_samples": 10_000,
+                    "decide_s": 0.2,
+                    "insufficient": "rollback",
+                },
+            )
+            assert info["verdict"] == "rolled_back"
+            assert svc.version == v1
+            assert reg.quarantined(v2) is not None
+            rz = json.load(
+                urllib.request.urlopen(base + "/rolloutz", timeout=10)
+            )
+            assert rz["history"][-1]["verdict"] == "rolled_back"
+            # clear_bad: the operator's explicit override rides /swap
+            info = post("/swap", {"version": v2, "clear_bad": True})
+            assert svc.version == v2
+            assert reg.quarantined(v2) is None
+
+
+# ------------------------------------------------------------ chaos drill
+@pytest.mark.chaos
+def test_rollout_chaos_drill(tmp_path):
+    """The tier-1 end-to-end drill: tools/chaos.py --workload rollout —
+    a bad version canaried under the seeded poison-flood zoo scenario
+    is rolled back, quarantined, refused by the watcher, and loses no
+    futures (the workload raises on any violated invariant)."""
+    from tools.chaos import WORKLOADS
+
+    WORKLOADS["rollout"](str(tmp_path), 1)

@@ -23,7 +23,13 @@ sweep), ``kernel`` (checkpointed out-of-core kernel BCD — spills a
 RowBlockStore and sweeps gram blocks, exercising blockstore.* +
 kernel.sweep + ckpt.*), ``nethost`` (a live 2-worker CROSS-HOST TCP
 fleet — ``serve/net.py`` — severed by a seeded network partition
-mid-wave and required to heal with zero lost futures).
+mid-wave and required to heal with zero lost futures), ``rollout``
+(a guarded canary rollout — ``serve/rollout.py`` — of a bad model
+version under the seeded ``poison_flood`` zoo workload from
+``tools/workloads.py``: the canary generation must concentrate the
+failures, the judge must roll back and quarantine the version in the
+registry, the watcher must refuse to redeploy it, and zero futures
+may hang across the abandoned staged generation).
 
 Network plans: the ``serve.net.connect``/``serve.net.send``/
 ``serve.net.recv`` sites take ``drop`` (the frame vanishes — silence,
@@ -594,6 +600,152 @@ def _nethost(tmp, restarts):
         svc.close()
 
 
+def _rollout(tmp, restarts):
+    """The guarded-rollout drill: a good version serves live while a
+    BAD version (``tools/workloads.py`` MarkerGate — fails exactly the
+    rows the seeded ``poison_flood`` scenario floods) is canaried at
+    50% of traffic.  The contract being proven is PR-19's guard
+    invariant: canary-hashed requests concentrate the failures on the
+    staged generation while live traffic stays clean, the judge rolls
+    back on the error-rate guardrail and QUARANTINES the version in
+    the registry (checksummed ``BAD`` sidecar), the watcher refuses to
+    redeploy the quarantined version even with ``CURRENT`` pointing at
+    it, every future across the abandoned staged generation resolves
+    (a hung future raises → chaos exit 1), and a clean final wave
+    serves 100% from the untouched live generation."""
+    import threading as _threading
+    from concurrent.futures import TimeoutError as _FTimeout
+
+    import numpy as np
+
+    from keystone_tpu.obs import metrics as _metrics
+    from keystone_tpu.serve import (
+        ModelRegistry,
+        RegistryWatcher,
+        RolloutConfig,
+        serve,
+    )
+    from keystone_tpu.serve.rollout import CanaryController
+    from tools import workloads as zoo
+
+    dim = 8
+    reg = ModelRegistry(os.path.join(tmp, "registry"))
+    good = zoo.build_zoo_pipeline(dim=dim, scale=2.0, gate=False)
+    bad = zoo.build_zoo_pipeline(dim=dim, scale=3.0, gate=True)
+    v1 = reg.publish(good)
+    v2 = reg.publish(bad, set_current=False)
+    fitted, ver = reg.load(v1)
+    svc = serve(
+        fitted,
+        version=ver,
+        max_batch=8,
+        max_wait_ms=2.0,
+        queue_bound=512,
+        example=np.zeros((dim,), np.float32),
+        name="chaos_rollout",
+        replicas=2,
+        slo_ms=250.0,
+    )
+    scenario = zoo.make_scenario(
+        "poison_flood", seed=int(restarts), duration_s=2.0, qps=300.0, dim=dim
+    )
+    flood_at = scenario.duration_s / 3.0
+    futs: list = []
+    futs_lock = _threading.Lock()
+
+    def _submit(event, rows):
+        try:
+            fs = svc.submit_many(rows)
+        except Exception:
+            return None  # typed admission refusal: a scheduled outcome
+        with futs_lock:
+            futs.extend(fs)
+        return len(fs)
+
+    pump = _threading.Thread(
+        target=lambda: zoo.play(scenario, _submit, time_scale=1.0),
+        daemon=True,
+    )
+    try:
+        pump.start()
+        # judge inside the flood window: the scenario's clean warmup
+        # third would otherwise commit the bad version before the first
+        # marker row arrives
+        time.sleep(flood_at)
+        cfg = RolloutConfig(
+            canary=0.5,
+            seed=int(restarts),
+            min_samples=16,
+            decide_s=20.0,
+            max_error_rate=0.1,
+            insufficient="rollback",
+        )
+        info = CanaryController(svc, cfg, registry=reg).run(
+            reg.load(v2)[0], version=v2
+        )
+        if info["verdict"] != "rolled_back":
+            raise _ChaosCheckFailed(
+                f"canary let the bad version through: {info!r}"
+            )
+        if svc.version != v1:
+            raise _ChaosCheckFailed(
+                f"service serves {svc.version!r} after rollback, not {v1!r}"
+            )
+        if reg.quarantined(v2) is None:
+            raise _ChaosCheckFailed(
+                f"rollback did not quarantine {v2} in the registry"
+            )
+        # the watcher must refuse the quarantined version even when an
+        # operator (or a crashed deploy) points CURRENT straight at it
+        reg.set_current(v2)
+        RegistryWatcher(svc, reg, poll_seconds=3600.0)._poll_once()
+        if svc.version != v1:
+            raise _ChaosCheckFailed(
+                "watcher redeployed a quarantined version"
+            )
+        reg.set_current(v1)
+        pump.join(timeout=30.0)
+        if pump.is_alive():
+            raise _ChaosCheckFailed("workload pump never finished")
+        hung = 0
+        with futs_lock:
+            pending = list(futs)
+        for f in pending:
+            try:
+                f.result(timeout=30.0)
+            except _FTimeout:
+                hung += 1
+            except Exception:
+                pass  # typed failure (poison, shed): acceptable
+        if hung:
+            raise _ChaosCheckFailed(
+                f"{hung} future(s) hung across the abandoned canary "
+                "generation — the rollout lost admitted work"
+            )
+        if _metrics.REGISTRY.counter_total("serve.rollout.rollbacks") < 1:
+            raise _ChaosCheckFailed("serve.rollout.rollbacks never counted")
+        hist = svc.rollout_status()["history"]
+        if not hist or hist[-1]["verdict"] != "rolled_back":
+            raise _ChaosCheckFailed(
+                f"rollout history missing the rollback: {hist!r}"
+            )
+        # exit gate: a clean marker-free wave serves 100% from the
+        # live generation (norm fingerprints the GOOD version's scale)
+        xs = np.random.default_rng(13).normal(size=(16, dim)).astype(
+            np.float32
+        )
+        for i in range(xs.shape[0]):
+            y = np.asarray(svc.submit(xs[i]).result(timeout=30.0))
+            norm = float(np.linalg.norm(y))
+            if abs(norm - 2.0) > 1e-3:
+                raise _ChaosCheckFailed(
+                    f"post-rollback result norm {norm:.4f} fingerprints "
+                    "the wrong version (want 2.0, the good scale)"
+                )
+    finally:
+        svc.close()
+
+
 WORKLOADS = {
     "bcd": _bcd,
     "ooc": _ooc,
@@ -604,11 +756,13 @@ WORKLOADS = {
     "tenants": _tenants,
     "procfleet": _procfleet,
     "nethost": _nethost,
+    "rollout": _rollout,
 }
 
 #: workloads that activate their own fault plan mid-run (a seeded
-#: partition, a timed sever) — runnable with no --plan at all
-SELF_INJECTING = frozenset({"nethost"})
+#: partition, a timed sever, a canaried bad version under a poison
+#: flood) — runnable with no --plan at all
+SELF_INJECTING = frozenset({"nethost", "rollout"})
 
 
 # --------------------------------------------------------------- soak

@@ -1801,6 +1801,103 @@ def run_restart_ab(
     )
 
 
+def run_scenario(
+    name: str,
+    seed: int = 0,
+    duration: float = 3.0,
+    qps: float = 200.0,
+    dim: int = 64,
+    max_batch: int = 32,
+    max_wait_ms: float = 2.0,
+    queue_bound: int = 128,
+    deadline_ms: float | None = 250.0,
+    replicas: int = 1,
+    time_scale: float = 1.0,
+) -> dict:
+    """Replay one seeded zoo scenario (``tools/workloads.py``) against
+    a live service and report outcomes + latency percentiles.  The
+    report carries the scenario's ``trace_digest`` so a regression
+    found here replays bit-exactly (same name + seed = same traffic)."""
+    import numpy as np
+
+    from keystone_tpu.serve import Overloaded
+    from keystone_tpu.utils import guard
+    from tools import workloads as zoo
+
+    scenario = zoo.make_scenario(
+        name, seed=seed, duration_s=duration, qps=qps, dim=dim
+    )
+    svc, _item_shape = build_service(
+        dim=dim,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_bound=queue_bound,
+        deadline_ms=deadline_ms,
+        replicas=replicas,
+    )
+    deadline_s = None if not deadline_ms else float(deadline_ms) / 1000.0
+    lock = threading.Lock()
+    latencies: list = []
+    outcomes = {"completed": 0, "shed": 0, "rejected": 0, "errors": 0}
+    futs: list = []
+
+    def record(fut, t_submit):
+        t_done = time.monotonic()
+        exc = fut.exception()
+        with lock:
+            if exc is None:
+                outcomes["completed"] += 1
+                latencies.append(t_done - t_submit)
+            elif isinstance(exc, guard.DeadlineExceeded):
+                outcomes["shed"] += 1
+            else:
+                outcomes["errors"] += 1
+
+    def _submit(event, rows):
+        t_submit = time.monotonic()
+        try:
+            fs = svc.submit_many(rows, deadline=deadline_s)
+        except Overloaded:
+            with lock:
+                outcomes["rejected"] += rows.shape[0]
+            return 0
+        for f in fs:
+            f.add_done_callback(lambda fut, t0=t_submit: record(fut, t0))
+        with lock:
+            futs.extend(fs)
+        return len(fs)
+
+    t0 = time.monotonic()
+    try:
+        zoo.play(scenario, _submit, time_scale=time_scale)
+        for f in list(futs):
+            try:
+                f.result(timeout=30.0)
+            except Exception:
+                pass
+    finally:
+        svc.close()
+    wall = time.monotonic() - t0
+    lat = sorted(latencies)
+
+    def _pct(p):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1000.0, 3)
+
+    return {
+        "scenario": scenario.summary(),
+        "wall_seconds": round(wall, 3),
+        "outcomes": outcomes,
+        "submitted_rows": scenario.total_rows(),
+        "qps_achieved": (
+            round(outcomes["completed"] / wall, 1) if wall > 0 else None
+        ),
+        "p50_ms": _pct(0.50),
+        "p99_ms": _pct(0.99),
+    }
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # single-arm entries the A/B driver spawns (fresh process per
@@ -1978,7 +2075,38 @@ def main(argv=None) -> int:
         help="concurrent binary batch clients in the --ingress-ab "
         "fast-path arm",
     )
+    ap.add_argument(
+        "--scenario",
+        default=None,
+        metavar="NAME",
+        help="replay a seeded adversarial zoo scenario "
+        "(tools/workloads.py: bursty, diurnal, heavy_tailed, "
+        "poison_flood, tenant_skewed, drift) instead of the open-loop "
+        "generator; the report carries the replay digest",
+    )
+    ap.add_argument(
+        "--scenario-seed",
+        type=int,
+        default=0,
+        help="zoo scenario seed (same name + seed = same traffic)",
+    )
     args = ap.parse_args(argv)
+
+    if args.scenario:
+        report = run_scenario(
+            args.scenario,
+            seed=args.scenario_seed,
+            duration=args.duration,
+            qps=args.qps,
+            dim=args.dim,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_bound=args.queue_bound,
+            deadline_ms=args.deadline_ms,
+            replicas=args.replicas,
+        )
+        print(json.dumps(report, indent=2))
+        return 0
 
     if args.ingress_ab:
         report = run_ingress_ab(
