@@ -258,6 +258,21 @@ INGRESS_LEGS = int(os.environ.get("BENCH_INGRESS_LEGS", "1"))
 INGRESS_DURATION_S = float(os.environ.get("BENCH_INGRESS_DURATION", "1.5"))
 INGRESS_ROUNDS = int(os.environ.get("BENCH_INGRESS_ROUNDS", "2"))
 INGRESS_SHARDS = int(os.environ.get("BENCH_INGRESS_SHARDS", "2"))
+
+# --- plan leg (ISSUE 20): the cost-based physical planner's A/B — the
+# same fitted pipeline with the sampled PhysicalPlan installed (stage
+# winners + derived serving knobs) vs the static defaults, on the raw
+# forward leg and the open-loop serve leg, plus a live PlanTuner retune
+# under the workload zoo's drift scenario.  Acceptance: speedup >= 1.0
+# (off-TPU both arms run identical physics, so ~1.0 is the honest
+# expectation) and the drift retune improves windowed p99 or reverts
+# through the bake guard with zero lost futures.
+PLAN_LEGS = int(os.environ.get("BENCH_PLAN_LEGS", "1"))
+PLAN_QPS = float(os.environ.get("BENCH_PLAN_QPS", "300"))
+PLAN_DURATION_S = float(os.environ.get("BENCH_PLAN_DURATION", "2.5"))
+PLAN_DRIFT_DURATION_S = float(os.environ.get("BENCH_PLAN_DRIFT_DURATION", "3"))
+
+
 def _f32_peak() -> float:
     """TPU v5 lite f32 peak, from the repo's single roofline source."""
     from keystone_tpu.workflow.profiling import _ROOFLINE_PEAKS
@@ -998,6 +1013,20 @@ def main():
         )
         return
 
+    if "--leg-plan" in sys.argv:
+        from tools import serve_bench
+
+        print(
+            json.dumps(
+                serve_bench.run_plan_ab(
+                    qps=PLAN_QPS,
+                    duration=PLAN_DURATION_S,
+                    drift_duration=PLAN_DRIFT_DURATION_S,
+                )
+            )
+        )
+        return
+
     if "--leg-solver-scale" in sys.argv:
         print(json.dumps(measure_solver_at_scale()))
         return
@@ -1235,6 +1264,14 @@ def main():
         else None
     )
 
+    # plan leg (ISSUE 20): planned vs static-default A/B + the live
+    # drift-retune sub-check
+    plan_leg = (
+        subprocess_leg("--leg-plan", required=("speedup", "drift_retune"))
+        if PLAN_LEGS > 0
+        else None
+    )
+
     # precision-mode sweep: same headline program and estimator, one
     # process leg per mode (KEYSTONE_MATMUL pinned in the child).  The
     # "auto" mode IS the headline measurement when the parent env does
@@ -1419,6 +1456,12 @@ def main():
         # HTTP/JSON per-datum QPS ceiling, p99 for both arms,
         # predictions bit-identical across JSON and binary
         out["serve_ingress"] = ingress_leg
+    if plan_leg:
+        # the ISSUE-20 acceptance: the planned configuration matches or
+        # beats static defaults (speedup >= 1.0) and the live drift
+        # retune improves p99 or reverts via the bake guard with zero
+        # lost futures
+        out["plan"] = plan_leg
     if hedge_leg:
         # p99_ratio < 1 = hedging rescued the straggler's queue;
         # qps_cost <= 0.05 = the acceptance budget

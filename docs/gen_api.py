@@ -26,6 +26,7 @@ MODULES = [
     ("keystone_tpu.utils", "Utils"),
     ("keystone_tpu.obs", "Observability"),
     ("keystone_tpu.serve", "Serving"),
+    ("keystone_tpu.planner", "Physical planning"),
     ("keystone_tpu.analysis", "Static analysis"),
 ]
 

@@ -8,7 +8,11 @@ returns an :class:`~keystone_tpu.analysis.findings.AnalysisReport`:
 - ``signatures`` — CSE / cache-signature collision audit (d);
 - ``precision``  — solver-jaxpr precision lint (b; graph-independent
   and the only pass that traces solver code, so it is NOT in the
-  default set — ``cli.py check`` adds it).
+  default set — ``cli.py check`` adds it);
+- ``plan``       — installed physical-plan audit (stale-plan /
+  bad-plan-candidate; inert with no plan installed, so it rides
+  ``validate_freeze`` and ``cli.py check`` but not the fit default
+  set).
 
 Entry points used by the framework wiring:
 
@@ -47,7 +51,7 @@ ENV_VALIDATE = "KEYSTONE_VALIDATE"
 #: the cheap pre-flight set (no solver tracing, no device work beyond
 #: an optional stream peek / deadline cost estimate)
 DEFAULT_PASSES = ("shapes", "robustness", "signatures")
-ALL_PASSES = DEFAULT_PASSES + ("precision",)
+ALL_PASSES = DEFAULT_PASSES + ("precision", "plan")
 
 
 def validation_enabled(explicit: Optional[bool] = None) -> bool:
@@ -112,6 +116,17 @@ def analyze(
             from keystone_tpu.analysis import precision as _precision
 
             report.extend(_precision.run())
+        elif p == "plan":
+            from keystone_tpu.analysis import plan as _plan
+
+            report.extend(
+                _plan.run(
+                    graph,
+                    pipeline=None
+                    if isinstance(pipeline, G.Graph)
+                    else pipeline,
+                )
+            )
         else:
             raise ValueError(f"unknown analyzer pass {p!r}; known: {ALL_PASSES}")
     return report
@@ -136,9 +151,14 @@ def validate_fit(pipeline, deadline=None, example=None) -> AnalysisReport:
 def validate_freeze(pipeline, example=None) -> AnalysisReport:
     """The ``Pipeline.freeze(validate=…)`` pre-flight: apply-mode
     analysis (unfitted estimators are errors) before the serve path
-    primes any bucket program."""
+    primes any bucket program.  Includes the ``plan`` pass — a frozen
+    pipeline is about to serve, so a stale or backend-mismatched
+    installed plan is worth a warning here."""
     report = analyze(
-        pipeline, example=example, passes=DEFAULT_PASSES, mode="apply"
+        pipeline,
+        example=example,
+        passes=DEFAULT_PASSES + ("plan",),
+        mode="apply",
     )
     _log_warnings(report, "freeze")
     report.raise_for_errors()

@@ -27,11 +27,12 @@ from typing import List, Optional, Sequence
 
 SEVERITIES = ("error", "warning")
 
-#: pass identifiers (the tentpole's a–d)
+#: pass identifiers (the tentpole's a–d, plus the planner audit)
 PASS_SHAPES = "shapes"
 PASS_PRECISION = "precision"
 PASS_ROBUSTNESS = "robustness"
 PASS_SIGNATURES = "signatures"
+PASS_PLAN = "plan"
 
 
 @dataclasses.dataclass(frozen=True)

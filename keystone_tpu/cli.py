@@ -163,9 +163,12 @@ def _serve_main(argv) -> int:
     ap.add_argument(
         "--max-wait-ms",
         type=float,
-        default=5.0,
+        default=None,
         help="flush the micro-batch when the oldest request has waited "
-        "this long (or when --max-batch requests are queued)",
+        "this long (or when --max-batch requests are queued).  Default: "
+        "the installed PhysicalPlan's value if the model ships one, "
+        "else 5.0 — passing a value always wins (the explicit tier of "
+        "the planner precedence ladder)",
     )
     ap.add_argument(
         "--queue-bound",
@@ -472,7 +475,8 @@ def _serve_main(argv) -> int:
     print(
         f"serving {source} on http://{args.host}:{front.port} "
         f"(replicas={svc.replicas}, max_batch={args.max_batch}, "
-        f"max_wait_ms={args.max_wait_ms}, queue_bound={args.queue_bound}"
+        f"max_wait_ms={svc.max_wait_s * 1000.0:g}, "
+        f"queue_bound={args.queue_bound}"
         + (f", watching every {args.watch:g}s" if watcher else "")
         + (", tracing off" if args.no_recorder else ", tracing on")
         + (", artifacts on" if artifacts else "")
@@ -616,6 +620,21 @@ def _export_main(argv) -> int:
         help="write the bundle to this directory instead of a registry "
         "(MANIFEST.json + one .hlo blob per bucket, BLAKE2b sidecars)",
     )
+    ap.add_argument(
+        "--plan",
+        action="store_true",
+        help="cost-based physical planning at freeze "
+        "(keystone_tpu.planner): micro-profile candidate "
+        "implementations on seeded sampling batches and ship the "
+        "PhysicalPlan in the manifest — every install of this bundle "
+        "serves the planned configuration (inspect: keystone plan)",
+    )
+    ap.add_argument(
+        "--plan-seed",
+        type=int,
+        default=0,
+        help="sampling seed for --plan (plan identity includes it)",
+    )
     args = ap.parse_args(argv)
     if args.model is None and args.model_dir is None:
         ap.error("pass --model and/or --model-dir")
@@ -644,7 +663,19 @@ def _export_main(argv) -> int:
 
         registry = ModelRegistry(args.model_dir)
         fitted, version = registry.load()
-    frozen = fitted.freeze()
+    if args.plan:
+        from keystone_tpu.planner import build_plan
+
+        rng = np.random.default_rng(args.plan_seed)
+        sample = rng.normal(size=(32,) + shape).astype(np.dtype(args.dtype))
+        plan = build_plan(
+            fitted, example=sample, max_batch=max(buckets),
+            seed=args.plan_seed,
+        )
+        frozen = fitted.freeze(plan=plan)
+        print(f"planned: {plan.fingerprint()} (keystone plan to inspect)")
+    else:
+        frozen = fitted.freeze()
     bundle = frozen.export_artifacts(example=example, buckets=buckets)
     ents = bundle["manifest"]["entries"]
     n_cache = sum(
@@ -765,7 +796,9 @@ def _check_main(argv) -> int:
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
-    passes = DEFAULT_PASSES if args.no_solver_lint else ALL_PASSES
+    passes = (
+        DEFAULT_PASSES + ("plan",) if args.no_solver_lint else ALL_PASSES
+    )
     report = analyze(
         pipe,
         example=example,
@@ -788,6 +821,144 @@ def _check_main(argv) -> int:
     return 0 if report.ok else 1
 
 
+def _plan_main(argv) -> int:
+    """``plan`` subcommand: inspect (or build) a cost-based
+    ``PhysicalPlan`` — per-stage candidates, sampled costs, the chosen
+    winner and why, and the serving knobs (``keystone_tpu.planner``).
+    Reads the plan a published registry version or exported bundle
+    ships in its manifest, a raw ``plan.json``, or builds one fresh by
+    sampling a saved fitted model."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.cli plan",
+        description="show or build the cost-based physical plan that "
+        "ships with a model: candidate implementations, sampled cost "
+        "curves, winners, and serving knobs",
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--model-dir",
+        metavar="DIR",
+        help="model registry root: read the plan the CURRENT (or "
+        "--version) version's artifact manifest ships",
+    )
+    src.add_argument(
+        "--bundle",
+        metavar="DIR",
+        help="exported artifact bundle directory (MANIFEST.json)",
+    )
+    src.add_argument(
+        "--file", metavar="PLAN.json", help="a raw serialized plan file"
+    )
+    src.add_argument(
+        "--model",
+        metavar="MODEL.pkl",
+        help="build a plan NOW by sampling this saved fitted pipeline "
+        "(needs --example-shape)",
+    )
+    ap.add_argument(
+        "--version",
+        default=None,
+        help="registry version (with --model-dir; default CURRENT)",
+    )
+    ap.add_argument(
+        "--example-shape",
+        default=None,
+        metavar="D0[,D1,...]",
+        help="per-datum input shape for --model sampling batches",
+    )
+    ap.add_argument(
+        "--dtype", default="float32", help="--model sampling dtype"
+    )
+    ap.add_argument(
+        "--seed", type=int, default=0, help="--model sampling seed"
+    )
+    ap.add_argument(
+        "--out",
+        default=None,
+        metavar="PLAN.json",
+        help="write the (read or built) plan to this file",
+    )
+    ap.add_argument(
+        "--explain",
+        action="store_true",
+        help="full explain: every candidate's samples, fitted curve, "
+        "cost at the serving batch, and the winner's why",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the plan dict as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    import json
+
+    from keystone_tpu.planner import PhysicalPlan, build_plan
+
+    plan = None
+    if args.file:
+        with open(args.file) as f:
+            plan = PhysicalPlan.from_dict(json.load(f))
+    elif args.bundle:
+        with open(os.path.join(args.bundle, "MANIFEST.json")) as f:
+            manifest = json.load(f).get("manifest") or {}
+        if manifest.get("plan") is None:
+            print("bundle ships no plan (exported without planning)",
+                  file=sys.stderr)
+            return 1
+        plan = PhysicalPlan.from_dict(manifest["plan"])
+    elif args.model_dir:
+        from keystone_tpu.serve import ModelRegistry
+
+        reg = ModelRegistry(args.model_dir)
+        version = args.version or (reg.versions() or [None])[-1]
+        if version is None:
+            print(f"no versions published in {args.model_dir}",
+                  file=sys.stderr)
+            return 1
+        bundle = reg.load_artifacts(version)
+        plan_dict = ((bundle or {}).get("manifest") or {}).get("plan")
+        if plan_dict is None:
+            print(f"version {version} ships no plan", file=sys.stderr)
+            return 1
+        plan = PhysicalPlan.from_dict(plan_dict)
+    else:
+        if not args.example_shape:
+            ap.error("--model needs --example-shape for sampling batches")
+        import numpy as np
+
+        from keystone_tpu.workflow import FittedPipeline
+
+        shape = tuple(int(d) for d in args.example_shape.split(","))
+        rng = np.random.default_rng(args.seed)
+        example = rng.normal(size=(32,) + shape).astype(np.dtype(args.dtype))
+        fitted = FittedPipeline.load(args.model)
+        plan = build_plan(fitted, example=example, seed=args.seed)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(plan.to_dict(), f, indent=2, sort_keys=True)
+        print(f"wrote plan {plan.fingerprint()} to {args.out}")
+    if args.json:
+        print(json.dumps(plan.to_dict(), indent=2, sort_keys=True))
+    elif args.explain:
+        print(plan.explain())
+    else:
+        print(
+            f"plan {plan.fingerprint()}  backend={plan.backend} "
+            f"source={plan.source} stages={len(plan.stages)}"
+        )
+        for s in plan.stages:
+            print(f"  {s.gate}: {s.winner}  ({s.why})")
+        for k in sorted(plan.knobs):
+            print(f"  knob {k} = {plan.knobs[k]}")
+        print("(--explain for candidates, sampled costs, and fits)")
+    problems = plan.validate()
+    for code, msg in problems:
+        print(f"WARNING [{code}] {msg}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("--list", "-l", "--help", "-h"):
@@ -796,6 +967,7 @@ def main(argv=None):
         print("       python -m keystone_tpu.cli worker --connect HOST:PORT [flags]")
         print("       python -m keystone_tpu.cli export --model model.pkl --example-shape D0[,D1,...] [flags]")
         print("       python -m keystone_tpu.cli check <PipelineName>|--model model.pkl [flags]")
+        print("       python -m keystone_tpu.cli plan --model-dir DIR|--bundle DIR|--file plan.json|--model model.pkl [flags]")
         print("pipelines:")
         for name in _PIPELINE_MODULES:
             print(f"  {name}")
@@ -804,6 +976,9 @@ def main(argv=None):
     if name == "check":
         _apply_platform_env()
         return _check_main(rest)
+    if name == "plan":
+        _apply_platform_env()
+        return _plan_main(rest)
     if name == "serve":
         _apply_platform_env()
         from keystone_tpu.utils.compile_cache import enable_compilation_cache

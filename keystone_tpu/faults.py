@@ -123,6 +123,7 @@ SITES = {
     "serve.net.send",
     "serve.net.recv",
     "kernel.sweep",
+    "plan.sample",
 }
 
 _ACTIONS = ("raise", "corrupt", "truncate", "exit", "delay", "hang", "drop")

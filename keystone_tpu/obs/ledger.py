@@ -97,6 +97,7 @@ ATTR_VOCABULARY = {
     "instances",
     "it",
     "key",
+    "knob",
     "late",
     "leader",
     "n",
@@ -133,6 +134,7 @@ ATTR_VOCABULARY = {
     "site",
     "solver",
     "source",
+    "stages",
     "stats",
     "substitute",
     "tag",

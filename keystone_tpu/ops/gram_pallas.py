@@ -138,11 +138,24 @@ def _gram_block_xla(x, z, gamma, solver_grade: bool = True):
 
 def gram_pallas_enabled(d: int = None) -> bool:
     """Should gram blocks route to the Pallas kernel?  True only on a
-    TPU-capable target (``pallas_supported``), with the
-    ``KEYSTONE_GRAM_PALLAS=0`` escape hatch, and only while the untiled
-    feature dim fits the VMEM budget."""
+    TPU-capable target (``pallas_supported``), and only while the
+    untiled feature dim fits the VMEM budget.
+
+    The ``gram_pallas`` gate resolves through the planner precedence
+    (``keystone_tpu.planner.registry``): ``KEYSTONE_GRAM_PALLAS=0`` is
+    the documented env override; with the env unset, an installed
+    ``PhysicalPlan`` that sampled the XLA chain as cheaper routes there;
+    with neither, the historical default (Pallas wherever it runs)."""
     if os.environ.get("KEYSTONE_GRAM_PALLAS", "1") == "0":
         return False
+    if os.environ.get("KEYSTONE_GRAM_PALLAS") is None:
+        try:
+            from keystone_tpu.planner import registry as _plans
+
+            if _plans.planned_gate("gram_pallas") == "xla":
+                return False
+        except Exception:
+            pass
     if d is not None and d > GRAM_MAX_D:
         return False
     return pallas_supported()

@@ -1,0 +1,46 @@
+"""Cost-based physical planning (KeystoneML ICDE 2017 §4, rebuilt).
+
+``registry``  — every physical gate and serving knob, one precedence:
+                explicit arg > env override > installed plan > default.
+``plan``      — the plain-data :class:`PhysicalPlan` that ships with
+                the model (manifest, registry blob, pickled applier).
+``cost``      — the freeze-time sampling cost model (:func:`build_plan`).
+``tuner``     — the live :class:`PlanTuner` (telemetry-driven knob
+                retunes under the rollback-bake discipline).
+"""
+
+from keystone_tpu.planner.cost import build_plan
+from keystone_tpu.planner.plan import (
+    CandidateCost,
+    PhysicalPlan,
+    StageChoice,
+    stage_signature,
+)
+from keystone_tpu.planner.registry import (
+    GATES,
+    KNOBS,
+    clear_plan,
+    current_plan,
+    install_plan,
+    plan_status,
+    planned_gate,
+    planned_knob,
+)
+from keystone_tpu.planner.tuner import PlanTuner
+
+__all__ = [
+    "GATES",
+    "KNOBS",
+    "CandidateCost",
+    "PhysicalPlan",
+    "PlanTuner",
+    "StageChoice",
+    "build_plan",
+    "clear_plan",
+    "current_plan",
+    "install_plan",
+    "plan_status",
+    "planned_gate",
+    "planned_knob",
+    "stage_signature",
+]

@@ -241,6 +241,36 @@ def _poison_suspect(exc: BaseException) -> bool:
     )
 
 
+#: distinguishes "caller said nothing" from an explicit None for knobs
+#: where None is itself a meaningful setting (hedge_ms=None = hedging
+#: OFF must stay OFF even when a plan carries a hedge)
+_UNSET = object()
+
+
+def _planned_knob(name: str):
+    """The installed PhysicalPlan's value for a serving knob, or None —
+    the third tier of the precedence ladder (explicit arg > env > plan >
+    static default).  Guarded import: with no planner in play this is a
+    cheap no-op and the legacy path stays byte-identical."""
+    try:
+        from keystone_tpu.planner import registry as _plans
+
+        return _plans.planned_knob(name)
+    except Exception:
+        return None
+
+
+def _plan_status_safe():
+    """The installed plan's ``/statusz`` section, or None (guarded the
+    same way as :func:`_planned_knob`)."""
+    try:
+        from keystone_tpu.planner import registry as _plans
+
+        return _plans.plan_status()
+    except Exception:
+        return None
+
+
 def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
     """Power-of-two padding buckets up to (and including) ``max_batch``.
     The smallest bucket bounds single-datum padding waste; the largest
@@ -453,7 +483,7 @@ class PipelineService:
         self,
         pipeline,
         max_batch: int = 32,
-        max_wait_ms: float = 5.0,
+        max_wait_ms: Optional[float] = None,
         queue_bound: int = 128,
         buckets: Optional[Sequence[int]] = None,
         deadline_ms: Optional[float] = None,
@@ -472,7 +502,7 @@ class PipelineService:
         supervise_interval_s: float = 0.5,
         restart_limit: int = 3,
         restart_window_s: float = 60.0,
-        hedge_ms: Optional[float] = None,
+        hedge_ms=_UNSET,
         bisect: bool = True,
         artifacts: Optional[dict] = None,
         workers: int = 0,
@@ -520,14 +550,37 @@ class PipelineService:
             # replica primes, so the first deploy on a fresh host skips
             # the backend compile of the deserialized modules too
             seed_compile_cache(artifacts)
+        # cost-based PhysicalPlan (keystone_tpu.planner): the artifact
+        # manifest or the frozen applier may ship one.  Installed BEFORE
+        # any serving knob resolves, so buckets / max_wait / dispatch
+        # window / hedge read the planned values through the one
+        # precedence ladder (explicit arg > env > plan > static default)
+        self._plan = None
+        try:
+            from keystone_tpu import planner as _planner
+
+            plan_dict = ((artifacts or {}).get("manifest") or {}).get("plan")
+            if plan_dict is not None:
+                self._plan = _planner.PhysicalPlan.from_dict(plan_dict)
+            else:
+                self._plan = getattr(pipeline, "plan", None)
+            if self._plan is not None:
+                _planner.install_plan(self._plan, source="serve")
+        except Exception:
+            self._plan = None
         # the bucket/shape contract is resolved BEFORE the pool builds:
         # process workers prime their padding buckets at spawn, so the
         # worker_opts must carry the final bucket set and item shape
         self.max_batch = int(max_batch)
+        planned_buckets = None if buckets else _planned_knob("buckets")
         self.buckets = (
             tuple(sorted({int(b) for b in buckets}))
             if buckets
-            else default_buckets(self.max_batch)
+            else (
+                tuple(sorted({int(b) for b in planned_buckets}))
+                if planned_buckets
+                else default_buckets(self.max_batch)
+            )
         )
         if self.buckets[-1] < self.max_batch:
             # a flush larger than every bucket would have nowhere to pad
@@ -591,6 +644,11 @@ class PipelineService:
             worker_opts=pool_worker_opts,
             telemetry=self._telemetry,
         )
+        # planned dispatch window: the pool's starting point (the
+        # autoscaler / PlanTuner may retune it live from here)
+        planned_window = _planned_knob("dispatch_window")
+        if planned_window is not None and int(planned_window) != self._pool.window:
+            self._pool.set_window(int(planned_window))
         #: the flight recorder: True (default) = a fresh bounded
         #: recorder, False/None = tracing fully off (request ids stay
         #: None, no trace hook runs — the PR-5 path, pinned), or a
@@ -649,6 +707,11 @@ class PipelineService:
         #: restored in the batcher and every replica worker, so ledger
         #: spans emitted there nest under the constructor's open span
         self._obs_ctx = ledger.capture_context()
+        # flush wait: explicit arg > plan > the historical 5 ms default
+        if max_wait_ms is None:
+            max_wait_ms = _planned_knob("max_wait_ms")
+        if max_wait_ms is None:
+            max_wait_ms = 5.0
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
         self.queue_bound = int(queue_bound)
         self.default_deadline_s = (
@@ -702,7 +765,11 @@ class PipelineService:
         #: = off — no monitor thread, the PR-9 dispatch path unchanged.
         #: Needs a second replica to hedge onto.
         #: hedge_ms=0 is a MEANINGFUL floor (delay = pure 3×EWMA);
-        #: only None disables hedging
+        #: only None disables hedging.  _UNSET (nothing passed) lets an
+        #: installed plan's hedge_ms apply; an EXPLICIT None keeps
+        #: hedging off regardless of the plan
+        if hedge_ms is _UNSET:
+            hedge_ms = _planned_knob("hedge_ms")
         self._hedge_floor_s = (
             None if hedge_ms is None else max(0.0, float(hedge_ms)) / 1000.0
         )
@@ -1456,6 +1523,30 @@ class PipelineService:
         """Retune the router's dispatch window live (autoscaler lever)."""
         return self._pool.set_window(n)
 
+    def retune_buckets(self, buckets) -> Tuple[int, ...]:
+        """Retune the padding-bucket ladder live (the PlanTuner lever).
+
+        An atomic tuple swap: in-flight flushes already carry their
+        bucket, queued requests pick from the new ladder at flush time,
+        and an unprimed new bucket rides the existing prime fallback
+        ladder on first use — padding changes, results never do, so no
+        future is lost.  Thread fleets only: process workers bake their
+        bucket set into spawned programs at startup."""
+        if self.workers > 0:
+            raise ValueError(
+                "retune_buckets applies to thread fleets; process workers "
+                "prime their bucket ladder at spawn"
+            )
+        from keystone_tpu.planner import registry as _plans
+
+        ok, coerced, why = _plans.validate_knob("buckets", buckets)
+        if not ok:
+            raise ValueError(f"bad bucket retune: {why}")
+        if coerced[-1] < self.max_batch:
+            coerced = coerced + (self.max_batch,)
+        self.buckets = coerced
+        return self.buckets
+
     # ------------------------------------------------------------- statusz
     @classmethod
     def _ingress_ms(cls, reg, name: str) -> Optional[dict]:
@@ -1551,6 +1642,7 @@ class PipelineService:
             "autoscaler": (
                 None if self.autoscaler is None else self.autoscaler.status()
             ),
+            "plan": _plan_status_safe(),
             "recorder": None if rec is None else rec.stats(),
         }
         # front-end ingress health (present once any front end has
@@ -1722,6 +1814,27 @@ class PipelineService:
                     raise
                 prime_s = time.monotonic() - t0
                 pause_s = self._pool.commit(staged, version)
+                # the incoming version's PhysicalPlan replaces the old
+                # one AT the commit (the plan ships with the model):
+                # from the bundle manifest, or the pickled applier
+                try:
+                    from keystone_tpu import planner as _planner
+
+                    plan_dict = (
+                        (artifacts or {}).get("manifest") or {}
+                    ).get("plan")
+                    new_plan = (
+                        _planner.PhysicalPlan.from_dict(plan_dict)
+                        if plan_dict is not None
+                        else getattr(pipeline, "plan", None)
+                    )
+                    if new_plan is not None:
+                        self._plan = new_plan
+                        _planner.install_plan(new_plan, source="swap")
+                except Exception:
+                    logger.warning(
+                        "swap %s: shipped plan failed to install", version
+                    )
             # swap-history bookkeeping for POST /rollback: the version
             # this commit displaced, newest last (internal — the pinned
             # swap return/ops surface is unchanged)
@@ -2517,7 +2630,7 @@ def serve(
     pipeline,
     *,
     max_batch: int = 32,
-    max_wait_ms: float = 5.0,
+    max_wait_ms: Optional[float] = None,
     queue_bound: int = 128,
     buckets: Optional[Sequence[int]] = None,
     deadline_ms: Optional[float] = None,
@@ -2536,7 +2649,7 @@ def serve(
     supervise_interval_s: float = 0.5,
     restart_limit: int = 3,
     restart_window_s: float = 60.0,
-    hedge_ms: Optional[float] = None,
+    hedge_ms=_UNSET,
     bisect: bool = True,
     artifacts: Optional[dict] = None,
     workers: int = 0,
@@ -2547,7 +2660,13 @@ def serve(
     """Freeze a fitted pipeline and stand up a :class:`PipelineService`.
 
     - ``max_batch`` / ``max_wait_ms`` — flush the micro-batch when either
-      bound is hit (count, or oldest-request age).
+      bound is hit (count, or oldest-request age).  ``max_wait_ms``,
+      ``buckets``, ``hedge_ms``, and the dispatch window resolve through
+      the physical-plan precedence (explicit arg > env > installed
+      ``PhysicalPlan`` > static default — ``keystone_tpu.planner``);
+      passing a value always wins, and with no plan the defaults are
+      the historical ones (5 ms wait, power-of-two buckets, hedging
+      off).
     - ``queue_bound`` — admission control: ``submit`` past this depth
       raises :class:`Overloaded`.
     - ``buckets`` — padding-bucket batch sizes (default: powers of two

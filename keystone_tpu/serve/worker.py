@@ -117,6 +117,19 @@ def _build_applier(payload: dict):
                 type(e).__name__,
                 e,
             )
+    plan = getattr(applier, "plan", None)
+    if plan is not None:
+        # artifact installs re-install the shipped plan themselves; an
+        # artifact-less (or rejected-bundle) spawn still carries the plan
+        # in the pickled applier — install it so this worker process
+        # serves the planned physical configuration
+        try:
+            from keystone_tpu import planner
+
+            if planner.current_plan() is None:
+                planner.install_plan(plan, source="spawn")
+        except Exception as e:
+            logger.warning("worker plan install failed (%s)", e)
     return applier, installed
 
 

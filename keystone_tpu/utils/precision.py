@@ -68,6 +68,12 @@ _MODES = ("auto", "bf16", "f32", "bf16_apply")
 _MODE = os.environ.get("KEYSTONE_MATMUL", "auto")
 if _MODE not in _MODES:
     raise ValueError(f"KEYSTONE_MATMUL must be one of {_MODES}, got {_MODE!r}")
+#: True once the mode was pinned by a stronger tier than the plan — the
+#: KEYSTONE_MATMUL env override at import, or set_matmul()/matmul()
+#: (explicit calls).  While False and 'auto', an installed PhysicalPlan
+#: may refine the mode (the planner precedence: explicit > env > plan >
+#: static default).
+_MODE_EXPLICIT = "KEYSTONE_MATMUL" in os.environ
 
 #: test/dev override: lets ``bf16_apply`` resolve ACTIVE on non-TPU
 #: backends so the bf16 numerics are exercisable on CPU meshes (the
@@ -105,35 +111,60 @@ def _on_tpu() -> bool:
 
 
 def set_matmul(mode: str) -> None:
-    global _MODE
+    global _MODE, _MODE_EXPLICIT
     if mode not in _MODES:
         raise ValueError(f"matmul mode must be one of {_MODES}, got {mode!r}")
     _MODE = mode
+    _MODE_EXPLICIT = True
+
+
+def _planned_matmul() -> str | None:
+    """The installed PhysicalPlan's matmul winner, or None.  Guarded
+    lazy import: with no planner in play this costs one cheap call and
+    the legacy resolution is untouched."""
+    try:
+        from keystone_tpu.planner import registry as _plans
+
+        return _plans.planned_gate("matmul")
+    except Exception:
+        return None
 
 
 def matmul_mode() -> str:
     """The resolved mode: 'bf16', 'f32', or 'bf16_apply' (never 'auto').
 
-    ``bf16_apply`` gates on REAL TPU hardware: off-chip it resolves to
-    'f32' — the inert policy — so CPU test meshes (and the multichip
-    dryrun's CPU mesh on a TPU host) produce bit-identical outputs with
-    the policy set or not.  ``force_bf16_apply`` /
-    ``KEYSTONE_BF16_APPLY_FORCE=1`` lifts the gate for parity testing."""
-    if _MODE == "auto":
+    With nothing pinned (no ``KEYSTONE_MATMUL`` env, no ``set_matmul``),
+    an installed ``PhysicalPlan``'s sampled winner applies first — the
+    plan tier of the precedence ladder.  ``bf16_apply`` gates on REAL
+    TPU hardware: off-chip it resolves to 'f32' — the inert policy — so
+    CPU test meshes (and the multichip dryrun's CPU mesh on a TPU host)
+    produce bit-identical outputs with the policy set or not.
+    ``force_bf16_apply`` / ``KEYSTONE_BF16_APPLY_FORCE=1`` lifts the
+    gate for parity testing."""
+    mode = _MODE
+    if not _MODE_EXPLICIT:
+        planned = _planned_matmul()
+        if planned in _MODES:
+            mode = planned
+    if mode == "auto":
         return "bf16" if _on_tpu() else "f32"
-    if _MODE == "bf16_apply":
+    if mode == "bf16_apply":
         return "bf16_apply" if (_on_tpu() or _APPLY_FORCE) else "f32"
-    return _MODE
+    return mode
 
 
 @contextmanager
 def matmul(mode: str):
-    prev = _MODE
+    global _MODE, _MODE_EXPLICIT
+    prev, prev_explicit = _MODE, _MODE_EXPLICIT
     set_matmul(mode)
     try:
         yield
     finally:
-        set_matmul(prev)
+        _MODE = prev
+        # restore the explicitness too: a scoped matmul() inside an
+        # otherwise-unpinned process must not permanently mask the plan
+        _MODE_EXPLICIT = prev_explicit
 
 
 @contextmanager

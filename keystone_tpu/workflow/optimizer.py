@@ -518,8 +518,11 @@ class PallasFvFusionRule(Rule):
     Fires only when the computation targets a Pallas-capable device
     (``pallas_supported()``); CPU meshes and dryruns keep the pre-rule
     graph, so compile-count and byte-identity pins are untouched.
-    ``KEYSTONE_FUSED_FV=0`` disables the rule outright (the operator's
-    escape hatch, mirroring the transformer's ``use_pallas=False``)."""
+    The ``fused_fv`` gate resolves through the planner precedence:
+    ``KEYSTONE_FUSED_FV=0`` (the documented env override) disables the
+    rule outright, else an installed ``PhysicalPlan`` that sampled the
+    chain as cheaper ('xla' winner) disables it; with neither, the rule
+    fires wherever Pallas runs — the historical static default."""
 
     name = "PallasFvFusion"
 
@@ -528,6 +531,16 @@ class PallasFvFusionRule(Rule):
 
         if os.environ.get("KEYSTONE_FUSED_FV", "1") == "0":
             return graph
+        if os.environ.get("KEYSTONE_FUSED_FV") is None:
+            # env unset: consult the installed plan (env stays the
+            # stronger override; no plan leaves the legacy path intact)
+            try:
+                from keystone_tpu.planner import registry as _plans
+
+                if _plans.planned_gate("fused_fv") == "xla":
+                    return graph
+            except Exception:
+                pass
         from keystone_tpu.ops.fisher_pallas import pallas_supported
 
         if not pallas_supported():

@@ -327,7 +327,7 @@ class Pipeline(Chainable):
         g = StageFusionRule().apply(g)
         return FittedPipeline(g, self.source, self.sink)
 
-    def freeze(self, validate=None, example=None) -> "FrozenApplier":
+    def freeze(self, validate=None, example=None, plan=None) -> "FrozenApplier":
         """Freeze this pipeline for repeated online application: run the
         whole-pipeline optimizer ONCE now, and return a
         :class:`FrozenApplier` that binds each incoming batch to the
@@ -342,8 +342,16 @@ class Pipeline(Chainable):
         ``PipelineValidationError`` instead of failing request-by-
         request.  ``example`` (a per-item shape tuple, batch array, or
         Dataset) seeds shape propagation from the open source.  Default
-        ``None`` reads ``KEYSTONE_VALIDATE``; off, the path is inert."""
-        return FrozenApplier(self, validate=validate, example=example)
+        ``None`` reads ``KEYSTONE_VALIDATE``; off, the path is inert.
+
+        ``plan`` opts into cost-based physical planning
+        (``keystone_tpu.planner``): ``True`` samples candidate
+        implementations on ``example`` batches and builds a
+        :class:`~keystone_tpu.planner.plan.PhysicalPlan` here (installed
+        before the optimizer runs, shipped in the applier and its
+        artifacts); a ``PhysicalPlan`` instance installs as-is.  Default
+        ``None``: no plan — the legacy path, byte-identical."""
+        return FrozenApplier(self, validate=validate, example=example, plan=plan)
 
     def to_dot(
         self, name: str = "pipeline", timings=None, retries=None, findings=None
@@ -550,7 +558,8 @@ class FrozenApplier:
     With nothing installed the cost is one empty-dict check per call
     (the pre-artifact path, byte-identical)."""
 
-    def __init__(self, pipeline: "Pipeline", validate=None, example=None):
+    def __init__(self, pipeline: "Pipeline", validate=None, example=None,
+                 plan=None):
         for op in pipeline.graph.operators.values():
             if isinstance(op, G.EstimatorOperator):
                 raise TypeError(
@@ -561,6 +570,19 @@ class FrozenApplier:
             from keystone_tpu.analysis import validate_freeze
 
             validate_freeze(pipeline, example=example)
+        #: the cost-based PhysicalPlan (keystone_tpu.planner), or None.
+        #: Built/installed BEFORE the optimizer executes so planning
+        #: rules (fused-FV) consult it; plain data, so it pickles with
+        #: the applier (replica clones) and rides export_artifacts.
+        self.plan = None
+        if plan is not None and plan is not False:
+            from keystone_tpu import planner
+
+            if plan is True:
+                self.plan = planner.build_plan(pipeline, example=example)
+            else:
+                self.plan = plan
+            planner.install_plan(self.plan, source="freeze")
         opt = PipelineEnv.get_optimizer()
         self.graph = opt.execute(pipeline.graph)
         self.source = pipeline.source
@@ -598,6 +620,7 @@ class FrozenApplier:
         self.__dict__.setdefault("_artifact_meta", {})
         self.__dict__.setdefault("_frozen_from", None)
         self.__dict__.setdefault("_degradable", True)
+        self.__dict__.setdefault("plan", None)
 
     def __call__(self, data, deadline=None) -> Dataset:
         """Apply the frozen graph to one batch (a Dataset or batch-like
@@ -824,6 +847,12 @@ class FrozenApplier:
             "buckets": buckets,
             "entries": entries,
         }
+        if getattr(self, "plan", None) is not None:
+            # the PhysicalPlan ships INSIDE the manifest: it rides the
+            # registry's blob-before-pointer publish (MANIFEST.json is
+            # written last) and re-installs on every artifact install —
+            # clone, worker spawn, swap, heal
+            manifest["plan"] = self.plan.to_dict()
         return {"manifest": manifest, "blobs": blobs}
 
     def install_artifacts(
@@ -890,6 +919,21 @@ class FrozenApplier:
                 "pipeline signature drift (artifact "
                 f"{manifest.get('signature')!r}, pipeline {want!r})"
             )
+        plan_dict = manifest.get("plan")
+        if plan_dict is not None:
+            # past the reject ladder the bundle IS this pipeline's: its
+            # plan is re-installed verbatim so a cloned replica / spawned
+            # worker / swapped or healed fleet serves the planned
+            # physical configuration, not whatever the env says here
+            try:
+                from keystone_tpu import planner
+
+                self.plan = planner.PhysicalPlan.from_dict(plan_dict)
+                planner.install_plan(self.plan, source="artifacts")
+            except Exception as e:
+                if strict:
+                    raise ArtifactMismatch(f"plan failed to install: {e}")
+                log.warning("shipped plan failed to install (%s)", e)
         item_shape = tuple(int(d) for d in manifest.get("item_shape") or ())
         dtype = str(manifest.get("dtype") or "float32")
         installed = 0

@@ -221,7 +221,11 @@ def pool_budget_bytes(fraction: float = 0.25) -> int:
     NEXT TO every tenant's resident model weights and the serve
     batches, so it gets a deliberately smaller slice than the fit-time
     cache budget.  ``KEYSTONE_POOL_BUDGET_BYTES`` overrides outright
-    (the eviction tests provoke pressure on small data with it)."""
+    (the eviction tests provoke pressure on small data with it); with
+    the env unset, an installed ``PhysicalPlan``'s pinned
+    ``pool_budget_bytes`` knob applies (the planner precedence — a
+    deploy host with different headroom serves what was planned); with
+    neither, the device-derived default."""
     import os
 
     env = os.environ.get("KEYSTONE_POOL_BUDGET_BYTES", "").strip()
@@ -230,6 +234,14 @@ def pool_budget_bytes(fraction: float = 0.25) -> int:
             return int(env)
         except ValueError:
             logger.warning("KEYSTONE_POOL_BUDGET_BYTES=%r is not an int", env)
+    try:
+        from keystone_tpu.planner import registry as _plans
+
+        planned = _plans.planned_knob("pool_budget_bytes")
+    except Exception:
+        planned = None
+    if planned is not None:
+        return int(planned)
     return device_hbm_budget(fraction=fraction)
 
 

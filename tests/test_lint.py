@@ -34,6 +34,7 @@ def _run(
     metric_kinds=None,
     solver_scoped=False,
     attr_vocab=None,
+    gate_env=None,
 ):
     return lint.lint_source(
         "seeded.py",
@@ -43,6 +44,7 @@ def _run(
         supervised=supervised,
         solver_scoped=solver_scoped,
         attr_vocab=attr_vocab,
+        gate_env=gate_env,
     )
 
 
@@ -420,4 +422,68 @@ def test_metric_fleet_label_rule_fires(sites):
         'metrics.observe("serve.fleet.x", 1.0)  '
         "# lint: allow-metric-name",
         sites,
+    )
+
+
+# --------------------------------------------------------- seeded: gate
+@pytest.fixture(scope="module")
+def gate_env():
+    return lint.load_gate_env()
+
+
+def test_gate_env_parsed_without_import(gate_env):
+    """The allowed set comes from the planner registry's literals — the
+    GATES/KNOBS ``env`` values plus OPERATIONAL_ENV — without importing
+    the package (the fault-site registry discipline)."""
+    from keystone_tpu.planner import registry
+
+    expected = set(registry.OPERATIONAL_ENV)
+    expected.update(
+        s["env"] for s in registry.GATES.values() if s.get("env")
+    )
+    expected.update(
+        s["env"] for s in registry.KNOBS.values() if s.get("env")
+    )
+    assert gate_env == frozenset(expected)
+    assert "KEYSTONE_MATMUL" in gate_env
+    assert "KEYSTONE_POOL_BUDGET_BYTES" in gate_env
+
+
+def test_gate_rule_fires_on_unregistered_env(sites, gate_env):
+    """Every literal KEYSTONE_* read form is caught: .get, getenv,
+    subscript, and membership tests."""
+    for src in (
+        'os.environ.get("KEYSTONE_SECRET_GATE", "1")',
+        'os.getenv("KEYSTONE_SECRET_GATE")',
+        'os.environ["KEYSTONE_SECRET_GATE"]',
+        '"KEYSTONE_SECRET_GATE" in os.environ',
+        '"KEYSTONE_SECRET_GATE" not in os.environ',
+        'os.environ.pop("KEYSTONE_SECRET_GATE", None)',
+        'os.environ.setdefault("KEYSTONE_SECRET_GATE", "1")',
+    ):
+        v = _run(src, sites, gate_env=gate_env)
+        assert [x.rule for x in v] == ["gate"], src
+
+
+def test_gate_rule_accepts_registered_env(sites, gate_env):
+    for src in (
+        'os.environ.get("KEYSTONE_MATMUL", "auto")',
+        'os.environ.get("KEYSTONE_FUSED_FV", "1")',
+        '"KEYSTONE_MATMUL" in os.environ',
+        'os.environ.get("KEYSTONE_POOL_BUDGET_BYTES")',
+        # non-KEYSTONE env is out of scope entirely
+        'os.environ.get("JAX_PLATFORMS", "")',
+        'os.environ["HOME"]',
+    ):
+        assert not _run(src, sites, gate_env=gate_env), src
+
+
+def test_gate_rule_scoping_and_escape(sites, gate_env):
+    # gate_env=None (no registry loaded) skips the rule
+    assert not _run('os.environ.get("KEYSTONE_SECRET_GATE")', sites)
+    # the escape hatch allowlists one line, visibly
+    assert not _run(
+        'os.environ.get("KEYSTONE_SECRET_GATE")  # lint: allow-gate',
+        sites,
+        gate_env=gate_env,
     )

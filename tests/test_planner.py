@@ -1,0 +1,573 @@
+"""Cost-based physical planner (ISSUE 20).
+
+What must hold:
+
+- the sampled cost model is DETERMINISTIC under a fixed seed (injected
+  timing): two builds emit byte-identical plans;
+- a ``plan.sample`` fault-site delay on one candidate flips the winner
+  (the cost model believes its measurements) — in both directions;
+- the plan ships: freeze -> manifest -> ModelRegistry.publish ->
+  load_artifacts -> install re-installs the IDENTICAL plan (fingerprint
+  equality), and the pickled applier a process worker spawns from
+  carries it too;
+- precedence at every site is explicit arg > env > installed plan >
+  static default, and the no-plan path is byte-identical to the legacy
+  path;
+- the PlanTuner retunes safe knobs from telemetry, bakes every retune
+  under the rollback discipline (burn -> revert, quiet -> commit into
+  the plan), including under the workload zoo's ``drift`` scenario;
+- the analysis ``plan`` pass flags stale plans and unrunnable
+  candidates as warnings, and is inert with no plan installed.
+"""
+
+import json
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import faults, planner
+from keystone_tpu.models.linear import LinearMapper
+from keystone_tpu.ops.stats import NormalizeRows
+from keystone_tpu.planner import registry as plans
+from keystone_tpu.planner.cost import fit_curve, price, select_knobs
+from keystone_tpu.planner.plan import PhysicalPlan, StageChoice, stage_signature
+from keystone_tpu.serve import ModelRegistry, serve
+from keystone_tpu.serve.autoscale import Signals
+from keystone_tpu.utils import precision
+from keystone_tpu.workflow import Dataset, Pipeline
+
+pytestmark = pytest.mark.serve
+
+DIM = 8
+CLASSES = 3
+
+
+@pytest.fixture(autouse=True)
+def _no_installed_plan():
+    """Every test starts AND ends on the legacy no-plan path."""
+    planner.clear_plan()
+    yield
+    planner.clear_plan()
+
+
+def _pipeline(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(DIM, CLASSES)).astype(np.float32))
+    return (Pipeline.of(NormalizeRows()) | LinearMapper(w)).fit()
+
+
+def _X(n: int = 64, seed: int = 0):
+    return np.random.default_rng(seed).normal(size=(n, DIM)).astype(np.float32)
+
+
+def _one_device():
+    import jax
+
+    return [jax.devices()[0]]
+
+
+def _flat_runner(costs):
+    """Injected deterministic timer: ``costs[(gate, candidate)]`` is the
+    (a, b) of a seconds = a + b*n line."""
+
+    def run(fn, *, gate, candidate, n, **_kw):
+        a, b = costs.get((gate, candidate), (1e-3, 1e-6))
+        return a + b * float(n)
+
+    return run
+
+
+# ------------------------------------------------------------ cost model
+def test_fit_curve_recovers_linear_cost():
+    a, b = fit_curve([(8, 1.8), (32, 4.2), (128, 13.8)])
+    assert a == pytest.approx(1.0, abs=1e-6)
+    assert b == pytest.approx(0.1, abs=1e-6)
+    assert price((a, b), 64) == pytest.approx(1.0 + 6.4, abs=1e-5)
+    # degenerate sets collapse to a flat curve, never explode
+    assert fit_curve([]) == (0.0, 0.0)
+    assert fit_curve([(32, 2.0)]) == (2.0, 0.0)
+
+
+def test_cost_model_is_deterministic_under_a_fixed_seed():
+    fitted = _pipeline()
+    X = _X(64)
+    run = _flat_runner({("matmul", "auto"): (1e-3, 1e-6),
+                        ("matmul", "f32"): (2e-3, 2e-6)})
+    p1 = planner.build_plan(fitted, example=X, seed=7, runner=run)
+    p2 = planner.build_plan(fitted, example=X, seed=7, runner=run)
+    assert p1.to_json() == p2.to_json()
+    assert p1.fingerprint() == p2.fingerprint()
+    # the sampled schedule rides the seed: it is part of plan identity
+    p3 = planner.build_plan(fitted, example=X, seed=8, runner=run)
+    assert p3.seed == 8
+    # and the plan is honest about what it measured
+    assert p1.backend == plans.current_backend()
+    assert p1.choice_for("matmul") == "auto"
+    assert any(s.gate == "matmul" for s in p1.stages)
+    for s in p1.stages:
+        for c in s.candidates:
+            assert c.samples, f"candidate {c.name} shipped no samples"
+
+
+def test_fault_site_delay_flips_the_winner_both_ways():
+    """Stalling one candidate's timed region through the ``plan.sample``
+    fault site makes the OTHER candidate win — the cost model picks from
+    measurements, not priors."""
+    fitted = _pipeline()
+    X = _X(32)
+    kw = dict(example=X, batch_sizes=(4, 8), full_batch=8, seed=0,
+              candidates={"matmul": ("auto", "f32")})
+    with faults.inject("plan.sample:ctx.candidate=auto:delay=0.05"):
+        slow_auto = planner.build_plan(fitted, **kw)
+    assert slow_auto.choice_for("matmul") == "f32"
+    (stage,) = [s for s in slow_auto.stages if s.gate == "matmul"]
+    by_name = {c.name: c for c in stage.candidates}
+    assert by_name["auto"].full_seconds >= 0.05
+    with faults.inject("plan.sample:ctx.candidate=f32:delay=0.05"):
+        slow_f32 = planner.build_plan(fitted, **kw)
+    assert slow_f32.choice_for("matmul") == "auto"
+
+
+def test_select_knobs_from_forward_curve():
+    knobs = select_knobs((0.002, 0.0001), max_batch=32)
+    ok, coerced, why = plans.validate_knob("buckets", knobs["buckets"])
+    assert ok, why
+    assert coerced[-1] == 32
+    # ~2 fixed overheads, clamped to [1, 20] ms
+    assert knobs["max_wait_ms"] == pytest.approx(4.0, abs=0.5)
+    assert knobs["dispatch_window"] == 2
+    assert knobs["pool_budget_bytes"] >= 1 << 20
+    assert knobs["hedge_ms"] >= 50.0
+    # no curve: the knob set stays conservative
+    bare = select_knobs(None, max_batch=32)
+    assert bare["max_wait_ms"] == 5.0
+    assert "hedge_ms" not in bare
+
+
+# ------------------------------------------------------- plan + registry
+def test_plan_json_roundtrip_and_validation():
+    plan = planner.build_plan(
+        _pipeline(), example=_X(32), seed=3,
+        runner=_flat_runner({}),
+    )
+    back = PhysicalPlan.from_json(plan.to_json())
+    assert back.fingerprint() == plan.fingerprint()
+    assert back.to_dict() == plan.to_dict()
+    # a fresh same-backend plan validates clean
+    assert plan.validate(backend=plans.current_backend()) == []
+    # format drift is rejected loudly (never half-read)
+    d = plan.to_dict()
+    d["format"] = 99
+    with pytest.raises(ValueError):
+        PhysicalPlan.from_dict(d)
+    # unknown gates / non-candidates / unrunnable winners / bad knobs
+    bad = PhysicalPlan(
+        backend="cpu",
+        stages=[
+            StageChoice(gate="nope", signature="s", label="l",
+                        winner="x", why=""),
+            StageChoice(gate="matmul", signature="s", label="l",
+                        winner="fp4", why=""),
+            StageChoice(gate="gram_pallas", signature="s", label="l",
+                        winner="pallas", why=""),
+        ],
+        knobs={"max_wait_ms": 1e9},
+    )
+    codes = [c for c, _ in bad.validate(backend="cpu")]
+    assert codes.count("bad-plan-candidate") == 4
+
+
+def test_registry_precedence_forced_over_plan_over_nothing():
+    assert plans.planned_gate("matmul") is None
+    assert plans.planned_knob("max_wait_ms") is None
+    assert plans.plan_status() is None
+    plan = PhysicalPlan(
+        backend="cpu",
+        stages=[StageChoice(gate="matmul", signature="s", label="l",
+                            winner="f32", why="test")],
+        knobs={"max_wait_ms": 2.5, "buckets": [4, 2]},
+        source="test",
+    )
+    planner.install_plan(plan)
+    assert plans.planned_gate("matmul") == "f32"
+    assert plans.planned_knob("max_wait_ms") == 2.5
+    assert plans.planned_knob("buckets") == (2, 4)  # coerced sorted set
+    assert plans.planned_knob("hedge_ms") is None  # plan doesn't carry it
+    status = plans.plan_status()
+    assert status["source"] == "install"
+    assert status["choices"] == {"matmul": "f32"}
+    assert status["fingerprint"] == plan.fingerprint()
+    # the cost model's sampling lever sits ABOVE the plan
+    with plans.forced("matmul", "bf16"):
+        assert plans.planned_gate("matmul") == "bf16"
+    assert plans.planned_gate("matmul") == "f32"
+    # a corrupt/foreign plan never forces a bad dispatch
+    plan.stages[0].winner = "not-a-candidate"
+    assert plans.planned_gate("matmul") is None
+    plan.knobs["max_wait_ms"] = -4.0
+    assert plans.planned_knob("max_wait_ms") is None
+    planner.clear_plan()
+    assert plans.plan_status() is None
+
+
+def test_matmul_mode_explicit_wins_over_plan(monkeypatch):
+    monkeypatch.setattr(precision, "_MODE", "auto")
+    monkeypatch.setattr(precision, "_MODE_EXPLICIT", False)
+    assert precision.matmul_mode() == "f32"  # auto resolves off-TPU
+    planner.install_plan(PhysicalPlan(
+        backend="cpu",
+        stages=[StageChoice(gate="matmul", signature="s", label="l",
+                            winner="bf16", why="test")],
+    ))
+    assert precision.matmul_mode() == "bf16"  # the plan tier applies
+    with precision.matmul("auto"):  # explicit masks the plan...
+        assert precision.matmul_mode() == "f32"
+    assert precision.matmul_mode() == "bf16"  # ...and unmasks on exit
+
+
+# ------------------------------------------------- shipping (the tentpole)
+def test_plan_ships_freeze_manifest_registry_spawn(tmp_path):
+    fitted = _pipeline()
+    X = _X(32)
+    frozen = fitted.freeze(plan=True, example=X)
+    plan = frozen.plan
+    assert plan is not None
+    fp = plan.fingerprint()
+    assert plans.plan_status()["source"] == "freeze"
+
+    bundle = frozen.export_artifacts(example=X[0], buckets=(2, 4))
+    assert bundle["manifest"]["plan"] == plan.to_dict()
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    version = reg.publish(fitted, artifacts=bundle)
+    arts = reg.load_artifacts(version)
+    assert arts is not None
+    assert arts["manifest"]["plan"] == plan.to_dict()
+
+    # a fresh host (no plan installed) installs the bundle: the shipped
+    # plan re-installs verbatim
+    planner.clear_plan()
+    loaded, got_version = reg.load()
+    assert got_version == version
+    ap2 = loaded.freeze()
+    assert ap2.install_artifacts(arts) > 0
+    assert ap2.plan.fingerprint() == fp
+    assert planner.current_plan().fingerprint() == fp
+    assert plans.plan_status()["source"] == "artifacts"
+
+    # the pickled applier (replica clone / process-worker spawn payload)
+    # carries the plan even without artifacts
+    planner.clear_plan()
+    ap3 = pickle.loads(pickle.dumps(frozen))
+    assert ap3.plan.fingerprint() == fp
+    planner.install_plan(ap3.plan, source="spawn")
+    assert plans.plan_status()["source"] == "spawn"
+
+    # and the planned freeze serves the same bytes as the legacy path
+    planner.clear_plan()
+    y_legacy = np.asarray(fitted.freeze()(Dataset(X, shard=False)).array)
+    planner.install_plan(plan)
+    y_planned = np.asarray(frozen(Dataset(X, shard=False)).array)
+    assert np.array_equal(y_legacy, y_planned)
+
+
+def test_service_knobs_resolve_explicit_over_plan_over_default():
+    fitted = _pipeline()
+    example = np.zeros((DIM,), np.float32)
+    plan = PhysicalPlan(
+        backend="cpu",
+        knobs={"buckets": [2, 4], "max_wait_ms": 2.5, "dispatch_window": 3},
+        source="test",
+    )
+    planner.install_plan(plan)
+    svc = serve(fitted, max_batch=8, example=example, name="plan_knobs",
+                supervise=False, devices=_one_device())
+    try:
+        # planned tier; max_batch is always appended as the top bucket
+        assert svc.buckets == (2, 4, 8)
+        assert svc.max_wait_s == pytest.approx(0.0025)
+        assert svc._pool.window == 3
+        assert svc.status()["plan"]["fingerprint"] == plan.fingerprint()
+    finally:
+        svc.close()
+    svc = serve(fitted, max_batch=8, example=example, name="plan_knobs2",
+                supervise=False, devices=_one_device(),
+                max_wait_ms=7.0, buckets=(8,))
+    try:
+        # explicit args beat the installed plan
+        assert svc.buckets == (8,)
+        assert svc.max_wait_s == pytest.approx(0.007)
+    finally:
+        svc.close()
+    planner.clear_plan()
+    svc = serve(fitted, max_batch=8, example=example, name="plan_knobs3",
+                supervise=False, devices=_one_device())
+    try:
+        # no plan: the historical static defaults, byte-identical
+        assert svc.max_wait_s == pytest.approx(0.005)
+        assert svc.buckets == (8,)
+        assert svc.status()["plan"] is None
+    finally:
+        svc.close()
+
+
+def test_retune_buckets_guardrails():
+    fitted = _pipeline()
+    svc = serve(fitted, max_batch=8, example=np.zeros((DIM,), np.float32),
+                name="plan_retune", supervise=False, devices=_one_device())
+    try:
+        assert svc.retune_buckets((2, 4)) == (2, 4, 8)
+        out = np.asarray(
+            svc.submit(np.ones((DIM,), np.float32)).result(timeout=30)
+        )
+        assert np.all(np.isfinite(out))
+        with pytest.raises(ValueError):
+            svc.retune_buckets(())
+        with pytest.raises(ValueError):
+            svc.retune_buckets((0,))
+        assert svc.buckets == (2, 4, 8)  # a rejected retune changes nothing
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------- PlanTuner
+class _StubService:
+    """The tuner's service surface, minus the threads: buckets +
+    retune_buckets, no process workers, an autoscaler holding the
+    window knob (so only the bucket branch is in play)."""
+
+    name = "stub"
+    workers = 0
+    _closing = False
+    recorder = None
+
+    def __init__(self):
+        self.buckets = (8, 32)
+        self.max_batch = 32
+        self.autoscaler = object()  # the window knob is owned elsewhere
+
+    def retune_buckets(self, buckets):
+        self.buckets = tuple(buckets)
+        return self.buckets
+
+
+def _idle_signals():
+    return Signals(workers=1, queue_depth=0, queue_bound=64,
+                   occupancy=0.2, burn_rate=0.0, pool_hit_rate=None)
+
+
+def _tuner(svc, plan, clock, rows, burn):
+    return planner.PlanTuner(
+        svc, plan=plan, clock=clock,
+        signal_source=_idle_signals,
+        rows_source=rows, burn_source=burn,
+        bake_s=1.0, bake_max_burn=2.0, min_samples=2, cooldown_s=0.0,
+    )
+
+
+def test_tuner_retunes_bakes_and_commits_into_the_plan():
+    svc = _StubService()
+    plan = PhysicalPlan(backend="cpu", knobs={"buckets": [8, 32]})
+    now = [0.0]
+    hist = {"count": 0.0, "sum": 0.0}
+
+    def rows():  # every tick: 10 flushes averaging 1.4 rows
+        hist["count"] += 10
+        hist["sum"] += 14.0
+        return dict(hist)
+
+    burn = {"burn_rate": 0.0, "window_requests": 50}
+    tuner = _tuner(svc, plan, lambda: now[0], rows, lambda: dict(burn))
+    assert tuner.tick() is None  # first read only establishes the base
+    now[0] = 0.1
+    assert tuner.tick() == "retune"
+    assert svc.buckets == (4, 8, 32)
+    assert tuner.status()["baking"]["knob"] == "buckets"
+    now[0] = 0.5
+    assert tuner.tick() is None  # baking, burn quiet
+    now[0] = 1.2  # past bake_s
+    assert tuner.tick() == "commit"
+    assert tuner.commits == 1 and tuner.reverts == 0
+    assert plan.knobs["buckets"] == [4, 8, 32]  # the refined model ships
+    assert tuner.last_action["outcome"] == "kept"
+    status = tuner.status()
+    assert status["retunes"] == 1 and status["baking"] is None
+    assert status["plan"] == plan.fingerprint()
+
+
+def test_tuner_reverts_a_retune_that_burns_the_budget():
+    svc = _StubService()
+    plan = PhysicalPlan(backend="cpu", knobs={"buckets": [8, 32]})
+    now = [0.0]
+    hist = {"count": 0.0, "sum": 0.0}
+
+    def rows():
+        hist["count"] += 10
+        hist["sum"] += 14.0
+        return dict(hist)
+
+    burn = {"burn_rate": 0.0, "window_requests": 50}
+    tuner = _tuner(svc, plan, lambda: now[0], rows, lambda: dict(burn))
+    tuner.tick()
+    now[0] = 0.1
+    assert tuner.tick() == "retune"
+    burn["burn_rate"] = 5.0  # the bake window burns
+    now[0] = 0.2
+    assert tuner.tick() == "revert"
+    assert svc.buckets == (8, 32)  # the pre-retune ladder is restored
+    assert tuner.reverts == 1 and tuner.commits == 0
+    assert plan.knobs["buckets"] == [8, 32]  # nothing committed
+    assert tuner.last_action["outcome"] == "reverted"
+    # too few windowed samples must NOT trigger a revert
+    hist2 = {"count": 0.0, "sum": 0.0}
+
+    def rows2():
+        hist2["count"] += 10
+        hist2["sum"] += 14.0
+        return dict(hist2)
+
+    svc2 = _StubService()
+    tuner2 = _tuner(svc2, plan, lambda: now[0], rows2,
+                    lambda: {"burn_rate": 5.0, "window_requests": 1})
+    tuner2.tick()
+    now[0] += 0.1
+    assert tuner2.tick() == "retune"
+    now[0] += 0.1
+    assert tuner2.tick() is None  # n < min_samples: keep baking
+    assert tuner2.reverts == 0
+
+
+def test_tuner_revert_on_burn_under_the_zoo_drift_scenario():
+    """The PR-19 drill: telemetry derived from the workload zoo's
+    ``drift`` scenario (payload mean shifting across the window) drives
+    the tuner; the retune committed while traffic was clean is followed
+    by one that reverts when the drifted half burns the budget — and no
+    event is ever lost (bucket retunes only change padding)."""
+    from tools.workloads import make_scenario, payload, play
+
+    scenario = make_scenario("drift", seed=3, duration_s=2.0, qps=100,
+                             dim=DIM)
+    served = []
+
+    def submit(event, x):
+        served.append(x.shape[0])
+        return x.shape[0]
+
+    results = play(scenario, submit, time_scale=0.0)
+    assert len(results) == len(scenario.events)
+    assert sum(served) == sum(e["rows"] for e in scenario.events)
+
+    # fold the replay into tick-by-tick telemetry: flush occupancy from
+    # the event sizes, burn from the drifted fraction of each slice
+    ticks = 8
+    per = max(1, len(scenario.events) // ticks)
+    slices = [scenario.events[i * per:(i + 1) * per] for i in range(ticks)]
+    state = {"i": 0, "count": 0.0, "sum": 0.0}
+
+    def rows():
+        sl = slices[min(state["i"], ticks - 1)]
+        state["count"] += len(sl)
+        state["sum"] += float(sum(e["rows"] for e in sl))
+        return {"count": state["count"], "sum": state["sum"]}
+
+    def burn():
+        sl = slices[min(state["i"], ticks - 1)]
+        drifted = sum(1 for e in sl if (e.get("shift") or 0.0) > 2.0)
+        return {"burn_rate": 6.0 if drifted > len(sl) / 2 else 0.0,
+                "window_requests": len(sl)}
+
+    svc = _StubService()
+    plan = PhysicalPlan(backend="cpu", knobs={"buckets": [8, 32]})
+    now = [0.0]
+    tuner = _tuner(svc, plan, lambda: now[0], rows, burn)
+    outcomes = []
+    for i in range(ticks):
+        state["i"] = i
+        now[0] = i * 0.45
+        out = tuner.tick()
+        if out:
+            outcomes.append(out)
+        if out == "revert":
+            # the rollback restored exactly the pre-retune ladder
+            assert svc.buckets == tuple(tuner.last_action["new"])
+    assert "retune" in outcomes
+    assert "revert" in outcomes  # the drifted window burned the bake
+    assert tuner.reverts >= 1
+
+
+# --------------------------------------------------------- analysis pass
+def test_analysis_plan_pass_inert_clean_and_stale():
+    from keystone_tpu.analysis import plan as plan_pass
+
+    fitted = _pipeline()
+    # inert with no plan anywhere
+    assert plan_pass.run(fitted.graph, pipeline=fitted) == []
+    # a fresh plan for THIS pipeline audits clean
+    fresh = planner.build_plan(fitted, example=_X(32),
+                               runner=_flat_runner({}))
+    assert plan_pass.run(fitted.graph, pipeline=fitted, plan=fresh) == []
+    # the same plan against a DIFFERENT pipeline is stale
+    rng = np.random.default_rng(9)
+    other = (
+        Pipeline.of(NormalizeRows())
+        | LinearMapper(jnp.asarray(
+            rng.normal(size=(DIM, CLASSES + 1)).astype(np.float32)))
+    ).fit()
+    findings = plan_pass.run(other.graph, pipeline=other, plan=fresh)
+    assert findings, "a foreign plan must be flagged"
+    assert {f.code for f in findings} == {"stale-plan"}
+    assert all(f.severity == "warning" for f in findings)
+    # an unrunnable winner is a bad-plan-candidate finding
+    bad = PhysicalPlan(
+        backend="cpu",
+        stages=[StageChoice(
+            gate="gram_pallas",
+            signature=stage_signature(NormalizeRows()),
+            label="NormalizeRows", winner="pallas", why="")],
+    )
+    codes = {f.code for f in plan_pass.run(fitted.graph, pipeline=fitted,
+                                           plan=bad)}
+    assert "bad-plan-candidate" in codes
+
+
+def test_validate_freeze_runs_the_plan_pass():
+    """A stale installed plan surfaces at freeze-validate time (warning:
+    freeze still succeeds — dispatch re-validates)."""
+    from keystone_tpu.analysis import validate_freeze
+
+    fitted = _pipeline()
+    stale = PhysicalPlan(
+        backend="cpu",
+        stages=[StageChoice(gate="matmul", signature="Gone:000000000000",
+                            label="Gone", winner="f32", why="")],
+    )
+    planner.install_plan(stale)
+    report = validate_freeze(fitted, example=np.zeros((DIM,), np.float32))
+    assert any(f.code == "stale-plan" for f in report.findings)
+    # and the pipeline still freezes + serves (warnings never block)
+    frozen = fitted.freeze()
+    y = np.asarray(frozen(Dataset(_X(4), shard=False)).array)
+    assert y.shape == (4, CLASSES)
+
+
+# ----------------------------------------------------------------- CLI
+def test_cli_plan_renders_and_explains(tmp_path, capsys):
+    from keystone_tpu import cli
+
+    plan = planner.build_plan(
+        _pipeline(), example=_X(32), seed=1,
+        runner=_flat_runner({("matmul", "auto"): (1e-3, 1e-6)}),
+    )
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(plan.to_dict(), sort_keys=True))
+    assert cli.main(["plan", "--file", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert plan.fingerprint() in out
+    assert "matmul" in out
+    assert cli.main(["plan", "--file", str(path), "--explain"]) == 0
+    out = capsys.readouterr().out
+    assert "winner=" in out and "serving knobs" in out
+    assert cli.main(["plan", "--file", str(path), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out) == plan.to_dict()

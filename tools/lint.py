@@ -62,6 +62,15 @@ Rules:
   discipline and the ``serve.net.*`` fault sites that make network
   failure injectable.  A deliberate use takes a trailing
   ``# lint: allow-socket``;
+- ``gate``         — every literal ``KEYSTONE_*`` environment read
+  (``os.environ.get/[]``/``in os.environ``/``os.getenv``) names a
+  variable registered in ``keystone_tpu/planner/registry.py`` — either
+  a gate's ``env`` (``GATES``) or the ``OPERATIONAL_ENV`` set (parsed
+  from the AST, never imported).  A scattered un-registered gate is
+  exactly what the cost-based planner consolidated: dispatch would
+  read an env the plan registry doesn't know, so the plan could never
+  own the choice and ``keystone plan`` would lie about precedence.
+  One-off escape: ``# lint: allow-gate``;
 - ``attr``         — literal keyword attribute keys at span/event emit
   sites (``ledger.span/event(...)``, flight-recorder
   ``rec.annotate/finish/batch/batch_update/ops(...)``) must be
@@ -94,6 +103,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TARGET = os.path.join(REPO_ROOT, "keystone_tpu")
 FAULTS_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "faults.py")
 OBS_LEDGER_PATH = os.path.join(REPO_ROOT, "keystone_tpu", "obs", "ledger.py")
+PLANNER_REGISTRY_PATH = os.path.join(
+    REPO_ROOT, "keystone_tpu", "planner", "registry.py"
+)
 
 #: span/event attribute keys must be snake_case (and registered)
 ATTR_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -244,6 +256,54 @@ def load_attr_vocabulary(ledger_path: str = OBS_LEDGER_PATH) -> frozenset:
                             and isinstance(e.value, str)
                         )
     raise RuntimeError(f"could not locate ATTR_VOCABULARY in {ledger_path}")
+
+
+def load_gate_env(registry_path: str = PLANNER_REGISTRY_PATH) -> frozenset:
+    """Parse the registered ``KEYSTONE_*`` environment variables out of
+    ``planner/registry.py`` WITHOUT importing it: every ``"env"`` value
+    in the ``GATES``/``KNOBS`` dict literals plus every member of the
+    ``OPERATIONAL_ENV`` set literal."""
+    with open(registry_path) as f:
+        tree = ast.parse(f.read(), filename=registry_path)
+    names: set = set()
+    found_gates = found_ops = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id in ("GATES", "KNOBS") and isinstance(
+                node.value, ast.Dict
+            ):
+                if t.id == "GATES":
+                    found_gates = True
+                for spec in node.value.values:
+                    if not isinstance(spec, ast.Dict):
+                        continue
+                    for k, v in zip(spec.keys, spec.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "env"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                        ):
+                            names.add(v.value)
+            elif t.id == "OPERATIONAL_ENV" and isinstance(
+                node.value, ast.Set
+            ):
+                found_ops = True
+                names.update(
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+    if not (found_gates and found_ops):
+        raise RuntimeError(
+            f"could not locate GATES/OPERATIONAL_ENV in {registry_path}"
+        )
+    return frozenset(names)
 
 
 def _allowed(lines: List[str], lineno: int, rule: str) -> bool:
@@ -429,6 +489,7 @@ def lint_source(
     attr_vocab: Optional[frozenset] = None,
     proc_fenced: Optional[bool] = None,
     socket_fenced: Optional[bool] = None,
+    gate_env: Optional[frozenset] = None,
 ) -> List[Violation]:
     """Lint one file's source.  ``metric_kinds`` accumulates
     name → (kind, path, line) across files for the metric-kind rule.
@@ -437,7 +498,9 @@ def lint_source(
     proc-spawn scoping, and ``socket_fenced`` the socket scoping
     (tests).  ``attr_vocab``: the registered span/event attribute
     vocabulary — None skips the ``attr`` rule (``lint_paths`` loads it
-    from obs/ledger.py)."""
+    from obs/ledger.py).  ``gate_env``: the registered ``KEYSTONE_*``
+    env names — None skips the ``gate`` rule (``lint_paths`` loads it
+    from planner/registry.py)."""
     out: List[Violation] = []
     lines = source.splitlines()
     try:
@@ -538,6 +601,67 @@ def lint_source(
                         "spawn/forkserver context)",
                     )
                 )
+
+    # ---- gate: literal KEYSTONE_* env reads vs the planner registry
+    if gate_env is not None:
+
+        def _is_environ(expr: ast.AST) -> bool:
+            return (
+                isinstance(expr, ast.Attribute) and expr.attr == "environ"
+            ) or (isinstance(expr, ast.Name) and expr.id == "environ")
+
+        def _keystone_name(expr: ast.AST) -> Optional[Tuple[str, int]]:
+            if isinstance(expr, ast.Constant) and isinstance(
+                expr.value, str
+            ) and expr.value.startswith("KEYSTONE_"):
+                return expr.value, expr.lineno
+            return None
+
+        def _check_gate(name_line: Optional[Tuple[str, int]]) -> None:
+            if name_line is None:
+                return
+            name, lineno = name_line
+            if name in gate_env or _allowed(lines, lineno, "gate"):
+                return
+            out.append(
+                Violation(
+                    rel_path,
+                    lineno,
+                    "gate",
+                    f"env {name!r} is not registered in the planner gate "
+                    "registry (planner/registry.py GATES env / "
+                    "OPERATIONAL_ENV) — an unregistered KEYSTONE_* read "
+                    "is a scattered gate the physical plan can never "
+                    "own; register it (or annotate '# lint: allow-gate' "
+                    "for a deliberate off-registry variable)",
+                )
+            )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("get", "setdefault", "pop")
+                    and _is_environ(f.value)
+                    and node.args
+                ):
+                    _check_gate(_keystone_name(node.args[0]))
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "getenv"
+                    and node.args
+                ):
+                    _check_gate(_keystone_name(node.args[0]))
+            elif isinstance(node, ast.Subscript) and _is_environ(
+                node.value
+            ):
+                _check_gate(_keystone_name(node.slice))
+            elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+            ):
+                if any(_is_environ(c) for c in node.comparators):
+                    _check_gate(_keystone_name(node.left))
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -813,11 +937,14 @@ def lint_paths(
     paths: List[str],
     sites: Optional[frozenset] = None,
     attr_vocab: Optional[frozenset] = None,
+    gate_env: Optional[frozenset] = None,
 ) -> List[Violation]:
     if sites is None:
         sites = load_registered_sites()
     if attr_vocab is None:
         attr_vocab = load_attr_vocabulary()
+    if gate_env is None:
+        gate_env = load_gate_env()
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -835,7 +962,14 @@ def lint_paths(
         with open(path) as f:
             source = f.read()
         violations.extend(
-            lint_source(rel, source, sites, metric_kinds, attr_vocab=attr_vocab)
+            lint_source(
+                rel,
+                source,
+                sites,
+                metric_kinds,
+                attr_vocab=attr_vocab,
+                gate_env=gate_env,
+            )
         )
     return violations
 

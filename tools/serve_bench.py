@@ -1801,6 +1801,236 @@ def run_restart_ab(
     )
 
 
+def run_plan_ab(
+    dim: int = 64,
+    classes: int = 16,
+    max_batch: int = 32,
+    qps: float = 300.0,
+    duration: float = 3.0,
+    deadline_ms: float = 500.0,
+    queue_bound: int = 256,
+    seed: int = 0,
+    drift_duration: float = 3.0,
+    drift_qps: float = 250.0,
+) -> dict:
+    """Planned-vs-static A/B (ISSUE 20): one fitted pipeline served
+    with the cost-based :class:`~keystone_tpu.planner.PhysicalPlan`
+    installed (sampled winners + derived serving knobs) against the
+    static defaults — on the raw forward leg and the open-loop serve
+    leg — plus a live :class:`~keystone_tpu.planner.PlanTuner` retune
+    under the zoo's ``drift`` scenario.  The acceptance gates:
+    ``speedup`` >= 1.0 (the plan matches or beats the defaults; off-TPU
+    both arms run identical physics, so ~1.0 is the honest expectation)
+    and the drift sub-check either improves windowed p99 or reverts via
+    the bake guard with ``lost_futures == 0``."""
+    import numpy as np
+
+    from keystone_tpu import planner
+    from keystone_tpu.serve import serve
+    from keystone_tpu.workflow.dataset import Dataset
+
+    fitted = build_pipeline(dim=dim, classes=classes, seed=seed).fit()
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(max(256, 4 * max_batch), dim)).astype(np.float32)
+    item_shape = (int(dim),)
+
+    # ---- forward A/B: static defaults vs the sampled plan.  The two
+    # appliers are timed in INTERLEAVED rounds (ambient CPU-clock drift
+    # would otherwise dominate a back-to-back pair of µs-scale arms)
+    # and each arm keeps its best round.
+    planner.clear_plan()
+    frozen_static = fitted.freeze()
+    plan = planner.build_plan(
+        fitted, example=X[: 2 * max_batch], max_batch=max_batch, seed=seed
+    )
+    frozen_planned = fitted.freeze(plan=plan)  # installs the plan
+
+    rows = min(X.shape[0], 4 * max_batch)
+    ds = Dataset(X[:rows], shard=False)
+    best = {"static": None, "planned": None}
+    arms = (("static", frozen_static), ("planned", frozen_planned))
+
+    def _enter_arm(name):
+        # mode gates (matmul) resolve at APPLY time through the
+        # registry, so the static arm must run with the plan cleared
+        if name == "planned":
+            planner.install_plan(plan, source="serve")
+        else:
+            planner.clear_plan()
+
+    for name, frozen in arms:  # warmup pays trace/compile
+        _enter_arm(name)
+        frozen(ds)
+    for _ in range(15):
+        for name, frozen in arms:
+            _enter_arm(name)
+            t0 = time.perf_counter()
+            frozen(ds)
+            dt = time.perf_counter() - t0
+            if best[name] is None or dt < best[name]:
+                best[name] = dt
+    planner.install_plan(plan, source="serve")
+    static_ips = float(rows) / best["static"] if best["static"] else 0.0
+    planned_ips = float(rows) / best["planned"] if best["planned"] else 0.0
+    forward = {
+        "static_ips": round(static_ips, 1),
+        "planned_ips": round(planned_ips, 1),
+        "speedup": (
+            round(planned_ips / static_ips, 2) if static_ips else None
+        ),
+    }
+
+    # ---- serve A/B: identical open-loop load; the planned arm leaves
+    # every knob unset so the plan tier resolves them, the static arm
+    # clears the plan so the static defaults resolve
+    def serve_arm(planned: bool) -> dict:
+        if planned:
+            planner.install_plan(plan, source="serve")
+        else:
+            planner.clear_plan()
+        svc = serve(
+            fitted,
+            max_batch=max_batch,
+            queue_bound=queue_bound,
+            deadline_ms=deadline_ms,
+            example=np.zeros(item_shape, np.float32),
+            name="plan_ab",
+        )
+        try:
+            return run_bench(
+                svc,
+                item_shape,
+                qps=qps,
+                duration=duration,
+                deadline_ms=deadline_ms,
+            )
+        finally:
+            svc.close()
+
+    static_serve = serve_arm(False)
+    planned_serve = serve_arm(True)
+    serve_ab = {
+        "static": {
+            k: static_serve.get(k)
+            for k in ("achieved_qps", "p50_ms", "p99_ms", "completed")
+        },
+        "planned": {
+            k: planned_serve.get(k)
+            for k in ("achieved_qps", "p50_ms", "p99_ms", "completed")
+        },
+        "speedup": (
+            round(
+                float(planned_serve["achieved_qps"])
+                / float(static_serve["achieved_qps"]),
+                2,
+            )
+            if static_serve.get("achieved_qps")
+            and planned_serve.get("achieved_qps")
+            else None
+        ),
+    }
+
+    # ---- drift retune: a live PlanTuner against the zoo's drift
+    # scenario — every retune is bake-guarded, so the sub-check is
+    # "p99 improved OR the retune reverted", with zero lost futures
+    from keystone_tpu.planner import PlanTuner
+    from keystone_tpu.utils import guard
+    from tools import workloads as zoo
+
+    planner.install_plan(plan, source="serve")
+    svc = serve(
+        fitted,
+        max_batch=max_batch,
+        queue_bound=queue_bound,
+        deadline_ms=deadline_ms,
+        example=np.zeros(item_shape, np.float32),
+        name="plan_drift",
+    )
+    tuner = PlanTuner(
+        svc, plan=plan, interval_s=0.2, bake_s=0.6, cooldown_s=0.5
+    )
+    scenario = zoo.make_scenario(
+        "drift", seed=seed, duration_s=drift_duration, qps=drift_qps,
+        dim=dim,
+    )
+    lock = threading.Lock()
+    lat: list = []
+    counts = {"completed": 0, "lost": 0, "shed": 0, "rejected": 0}
+    deadline_s = float(deadline_ms) / 1000.0
+
+    def record(fut, t0):
+        t1 = time.monotonic()
+        exc = fut.exception()
+        with lock:
+            if exc is None:
+                counts["completed"] += 1
+                lat.append((t0, t1 - t0))
+            elif isinstance(exc, guard.DeadlineExceeded):
+                counts["shed"] += 1
+            else:
+                counts["lost"] += 1
+
+    def _submit(event, rows):
+        t0 = time.monotonic()
+        try:
+            fs = svc.submit_many(rows, deadline=deadline_s)
+        except Exception:
+            with lock:
+                counts["rejected"] += int(rows.shape[0])
+            return 0
+        for f in fs:
+            f.add_done_callback(lambda fut, t0=t0: record(fut, t0))
+        return len(fs)
+
+    tuner.start()
+    t_start = time.monotonic()
+    try:
+        zoo.play(scenario, _submit, time_scale=1.0)
+        time.sleep(max(0.5, 2 * tuner.bake_s))  # let a pending bake land
+    finally:
+        tuner.stop()
+        svc.close()
+
+    def _p99(samples):
+        if not samples:
+            return None
+        vals = sorted(s for _, s in samples)
+        return round(
+            vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1000.0, 3
+        )
+
+    mid = t_start + (time.monotonic() - t_start) / 2.0
+    first = [s for s in lat if s[0] < mid]
+    second = [s for s in lat if s[0] >= mid]
+    tstat = tuner.status()
+    drift = {
+        "outcomes": counts,
+        "lost_futures": counts["lost"],
+        "p99_ms_first_half": _p99(first),
+        "p99_ms_second_half": _p99(second),
+        "retunes": tstat.get("retunes"),
+        "last_action": tstat.get("last_action"),
+    }
+
+    planner.clear_plan()
+    return {
+        "plan": {
+            "fingerprint": plan.fingerprint(),
+            "backend": plan.backend,
+            "stages": {s.gate: s.winner for s in plan.stages},
+            "knobs": plan.knobs,
+        },
+        "forward": forward,
+        "serve": serve_ab,
+        "drift_retune": drift,
+        # the headline acceptance number: the planned configuration
+        # matches or beats static on both legs (forward is the
+        # low-noise leg; serve rides open-loop achieved QPS)
+        "speedup": forward["speedup"],
+        "serve_speedup": serve_ab["speedup"],
+    }
+
+
 def run_scenario(
     name: str,
     seed: int = 0,
